@@ -1,0 +1,101 @@
+"""Benchmark: mesh-points/sec/chip on NS2d-1k (BASELINE.json metric).
+
+Runs the full jitted training step (forward + backward + AdamW) on the
+default JAX platform (the TPU chip under the driver) at the
+reference-default architecture on the NS2d ~1k-point config, counting
+REAL (unpadded) mesh points per second per chip. The baseline divisor is
+the same step measured on the host CPU backend in float32 — the
+reference's design point (torch CPU/GPU eager, f32) — so
+``vs_baseline`` is the TPU/CPU speedup ratio; the BASELINE.md gate wants
+>= 8.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def build(step_dtype: str):
+    from gnot_tpu.config import ModelConfig, OptimConfig
+    from gnot_tpu.data import datasets
+    from gnot_tpu.data.batch import Loader
+    from gnot_tpu.models.gnot import GNOT
+    from gnot_tpu.train.trainer import init_state, make_train_step
+
+    mc = ModelConfig(
+        input_dim=2,
+        theta_dim=1,
+        input_func_dim=3,
+        out_dim=1,
+        n_input_functions=1,
+        dtype=step_dtype,
+    )  # reference-default architecture (main.py:16-22)
+    samples = datasets.synth_ns2d(4, n_points=1024, seed=0)
+    batch = next(iter(Loader(samples, 4)))
+    model = GNOT(mc)
+    optim = OptimConfig()
+    state = init_state(model, optim, batch, seed=0)
+    step = make_train_step(model, optim, "rel_l2")
+    return step, state, batch
+
+
+def time_steps(step, state, batch, lr, n_warmup: int, n_steps: int, device) -> float:
+    """Returns real-mesh-points/sec for the train step on `device`."""
+    state = jax.device_put(state, device)
+    dbatch = jax.device_put(batch, device)
+    lr = jax.device_put(lr, device)
+    for _ in range(n_warmup):
+        state, loss = step(state, dbatch, lr)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        state, loss = step(state, dbatch, lr)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return batch.n_real_points * n_steps / dt
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--cpu_steps", type=int, default=3)
+    p.add_argument("--dtype", type=str, default="bfloat16", choices=["float32", "bfloat16"])
+    args = p.parse_args()
+
+    lr = jnp.asarray(1e-3, jnp.float32)
+    accel = jax.devices()[0]
+    cpu = jax.devices("cpu")[0]
+
+    step, state, batch = build(args.dtype)
+    value = time_steps(step, state, batch, lr, args.warmup, args.steps, accel)
+
+    if accel.platform == "cpu":
+        vs_baseline = 1.0
+    else:
+        # CPU baseline in f32 — the reference's numeric regime.
+        step_c, state_c, batch_c = build("float32")
+        cpu_value = time_steps(step_c, state_c, batch_c, lr, 1, args.cpu_steps, cpu)
+        vs_baseline = value / cpu_value
+
+    print(
+        json.dumps(
+            {
+                "metric": "ns2d_mesh_points_per_sec_per_chip",
+                "value": round(value, 1),
+                "unit": "points/sec/chip",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

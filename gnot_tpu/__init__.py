@@ -1,0 +1,29 @@
+"""GNOT-TPU: a TPU-native neural-operator framework.
+
+Capabilities of ``aloe101/GNOT-Replication`` (see SURVEY.md), rebuilt
+TPU-first on JAX/XLA/Flax: masked ragged-mesh batching, normalized linear
+attention as MXU einsums, geometry-gated soft-MoE FFNs as batched GEMMs,
+sharded training over a device mesh, Orbax checkpointing.
+"""
+
+from gnot_tpu.config import Config, DataConfig, MeshConfig, ModelConfig, OptimConfig, TrainConfig, make_config
+from gnot_tpu.data.batch import Loader, MeshBatch, MeshSample, collate
+from gnot_tpu.models.gnot import GNOT
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Config",
+    "DataConfig",
+    "MeshConfig",
+    "ModelConfig",
+    "OptimConfig",
+    "TrainConfig",
+    "make_config",
+    "Loader",
+    "MeshBatch",
+    "MeshSample",
+    "collate",
+    "GNOT",
+    "__version__",
+]

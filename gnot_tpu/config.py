@@ -1,0 +1,141 @@
+"""Configuration dataclasses for the GNOT-TPU framework.
+
+The reference configures everything through nine argparse flags plus
+hardcoded constants (``/root/reference/main.py:15-23,41,50``). Here the
+full surface is a set of dataclasses with CLI overrides; defaults
+reproduce the reference regime exactly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """GNOT architecture hyperparameters (reference main.py:16-22)."""
+
+    input_dim: int = 2
+    theta_dim: int = 1
+    input_func_dim: int = 1
+    out_dim: int = 1
+    n_input_functions: int = 1
+    n_attn_layers: int = 4
+    n_attn_hidden_dim: int = 256
+    n_mlp_num_layers: int = 4
+    n_mlp_hidden_dim: int = 256
+    n_input_hidden_dim: int = 256
+    n_expert: int = 3
+    n_head: int = 8
+    # --- TPU-native knobs (no reference equivalent) ---
+    # "parity": unmasked padding, pollution-faithful to the reference.
+    # "masked": correct masking; results independent of pad lengths.
+    attention_mode: str = "masked"
+    # Compute dtype for the encoder stack; params stay float32.
+    dtype: str = "float32"
+
+    def __post_init__(self) -> None:
+        if self.n_attn_hidden_dim % self.n_head:
+            raise ValueError("n_attn_hidden_dim must be divisible by n_head")
+        if self.attention_mode not in ("parity", "masked"):
+            raise ValueError(f"unknown attention_mode {self.attention_mode!r}")
+
+
+@dataclasses.dataclass(frozen=True)
+class OptimConfig:
+    """AdamW + OneCycle regime (reference main.py:50-52)."""
+
+    lr: float = 1e-3
+    # torch.optim.AdamW defaults, set explicitly because optax's differ.
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 0.01
+    # OneCycleLR defaults (torch): cos anneal, 3-phase off.
+    pct_start: float = 0.3
+    div_factor: float = 25.0
+    final_div_factor: float = 1e4
+    # The reference constructs OneCycleLR with steps_per_epoch but calls
+    # scheduler.step() once per EPOCH (main.py:52,106), so the LR never
+    # leaves the warm-up ramp. parity_schedule_bug=True reproduces that;
+    # False steps the schedule per optimizer update (the correct form).
+    parity_schedule_bug: bool = True
+    grad_clip_norm: float = 0.0  # 0 = off (reference has no clipping)
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    train_path: str = ""
+    test_path: str = ""
+    # Synthetic fallback so nothing blocks on data files; one of the five
+    # benchmark configs in BASELINE.json.
+    synthetic: str = "ns2d"  # darcy2d | ns2d | elasticity | inductor2d | heatsink3d
+    n_train: int = 64
+    n_test: int = 16
+    batch_size: int = 4  # reference main.py:41
+    shuffle_train: bool = True
+    seed: int = 0
+    # Pad ragged lengths up to the next bucket boundary (power of two) to
+    # bound XLA recompiles. 1 disables bucketing (per-batch max, as the
+    # reference does — parity mode needs this).
+    bucket: bool = True
+    drop_remainder: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Device-mesh layout. Axis sizes of 1 collapse that axis."""
+
+    data: int = -1  # -1: all remaining devices
+    seq: int = 1  # sequence (context) parallelism over mesh points
+    model: int = 1  # tensor parallelism over heads / FFN hidden
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    epochs: int = 100  # reference main.py:23
+    loss: str = "rel_l2"  # the reference trains AND evals on rel-L2
+    checkpoint_dir: str = ""
+    resume: bool = False
+    checkpoint_every: int = 0  # epochs; 0 = best-only (reference behavior)
+    log_every: int = 0  # steps; 0 = per-epoch only
+    metrics_path: str = ""  # JSONL sink; "" = console only
+    profile_dir: str = ""  # jax.profiler trace output
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
+class Config:
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
+    train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+
+
+def _apply_overrides(cfg: Any, overrides: dict[str, Any]) -> Any:
+    """Apply dotted-path overrides, e.g. {"model.n_head": 4}."""
+    for key, value in overrides.items():
+        parts = key.split(".")
+        if len(parts) == 1:
+            # Bare keys search sections for a unique match.
+            hits = [
+                f.name
+                for f in dataclasses.fields(cfg)
+                if any(g.name == key for g in dataclasses.fields(getattr(cfg, f.name)))
+            ]
+            if len(hits) != 1:
+                raise KeyError(f"ambiguous or unknown config key {key!r}: {hits}")
+            parts = [hits[0], key]
+        section_name, field_name = parts
+        section = getattr(cfg, section_name)
+        if not any(f.name == field_name for f in dataclasses.fields(section)):
+            raise KeyError(f"unknown config field {section_name}.{field_name}")
+        section = dataclasses.replace(section, **{field_name: value})
+        cfg = dataclasses.replace(cfg, **{section_name: section})
+    return cfg
+
+
+def make_config(**overrides: Any) -> Config:
+    return _apply_overrides(Config(), overrides)

@@ -1,0 +1,164 @@
+"""Dense batch container + ragged->dense batching.
+
+The reference carries ragged meshes as edge-less DGL graphs and pads them
+inline in the train loop (``/root/reference/main.py:37-39,63-82``). The
+TPU-native form is a single static-shaped pytree, ``MeshBatch``, with the
+ragged structure folded into 0/1 masks — XLA-friendly (no recompiles per
+shape when bucketing is on, no graph library, no host round trips).
+
+Reference-faithful padding semantics preserved:
+  * input functions are padded to the **single max length across ALL
+    functions of ALL samples in the batch** (main.py:63 — one shared
+    max, not per-function);
+  * coords/targets are padded to the per-batch max node count
+    (main.py:78-80);
+  * zero padding at the tail of the length axis (utils.py:3-4).
+
+On top, an optional bucketing scheme rounds pad lengths up to the next
+bucket boundary so XLA compiles O(log L) programs instead of one per
+distinct length. Bucketing changes numerics only in parity (unmasked)
+mode, so parity runs disable it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, Sequence
+
+import flax.struct
+import numpy as np
+
+
+@flax.struct.dataclass
+class MeshBatch:
+    """One padded batch of ragged PDE meshes. All arrays are dense.
+
+    Shapes: B batch, L max nodes, Lf max input-function points, F number
+    of input functions, dx/df/dy coordinate/function/output dims, T theta.
+    """
+
+    coords: np.ndarray  # [B, L, dx] mesh point coordinates
+    theta: np.ndarray  # [B, T] global (per-sample) parameters
+    y: np.ndarray  # [B, L, dy] padded targets
+    node_mask: np.ndarray  # [B, L] 1 for real nodes, 0 for padding
+    funcs: np.ndarray | None = None  # [F, B, Lf, df] padded input functions
+    func_mask: np.ndarray | None = None  # [F, B, Lf]
+
+    @property
+    def n_real_points(self) -> int:
+        """Total un-padded mesh points — the throughput denominator."""
+        return int(np.sum(np.asarray(self.node_mask)))
+
+
+@dataclasses.dataclass
+class MeshSample:
+    """One ragged sample: ``[X, Y, theta, (f1, f2, ...)]`` — the pickle
+    record schema of the reference (dataset.py:7)."""
+
+    coords: np.ndarray  # [n, dx]
+    y: np.ndarray  # [n, dy]
+    theta: np.ndarray  # [T]
+    funcs: tuple[np.ndarray, ...] = ()  # each [m_i, df]
+
+
+def bucket_length(n: int, *, min_size: int = 64) -> int:
+    """Round up to the next power-of-two-ish bucket (1, 1.5 mantissa)."""
+    size = min_size
+    while size < n:
+        if int(size * 1.5) >= n and (size & (size - 1)) == 0:
+            return int(size * 1.5)
+        size *= 2
+    return size
+
+
+def pad_rows(arr: np.ndarray, length: int) -> np.ndarray:
+    """Zero-pad axis 0 to ``length`` (reference utils.py:3-4)."""
+    if arr.shape[0] == length:
+        return arr
+    pad = [(0, length - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad)
+
+
+def collate(samples: Sequence[MeshSample], *, bucket: bool = True) -> MeshBatch:
+    """Pad and stack ragged samples into a dense MeshBatch."""
+    b = len(samples)
+    max_nodes = max(s.coords.shape[0] for s in samples)
+    if bucket:
+        max_nodes = bucket_length(max_nodes)
+
+    coords = np.stack([pad_rows(s.coords, max_nodes) for s in samples]).astype(
+        np.float32
+    )
+    y = np.stack([pad_rows(s.y, max_nodes) for s in samples]).astype(np.float32)
+    node_mask = np.zeros((b, max_nodes), np.float32)
+    for i, s in enumerate(samples):
+        node_mask[i, : s.coords.shape[0]] = 1.0
+    theta = np.stack([np.atleast_1d(np.asarray(s.theta, np.float32)) for s in samples])
+
+    n_funcs = len(samples[0].funcs)
+    funcs = func_mask = None
+    if n_funcs:
+        # Single shared max across every function of every sample
+        # (reference main.py:63).
+        max_f = max(f.shape[0] for s in samples for f in s.funcs)
+        if bucket:
+            max_f = bucket_length(max_f)
+        funcs = np.zeros(
+            (n_funcs, b, max_f, samples[0].funcs[0].shape[1]), np.float32
+        )
+        func_mask = np.zeros((n_funcs, b, max_f), np.float32)
+        for j in range(n_funcs):
+            for i, s in enumerate(samples):
+                m = s.funcs[j].shape[0]
+                funcs[j, i, :m] = s.funcs[j]
+                func_mask[j, i, :m] = 1.0
+
+    return MeshBatch(
+        coords=coords,
+        theta=theta,
+        y=y,
+        node_mask=node_mask,
+        funcs=funcs,
+        func_mask=func_mask,
+    )
+
+
+class Loader:
+    """Minimal epoch iterator: shuffle, batch, collate.
+
+    Replaces the reference's ``DataLoader(batch_size=4, shuffle=True,
+    collate_fn=unzip)`` (main.py:37-42) without a torch dependency.
+    """
+
+    def __init__(
+        self,
+        samples: Sequence[MeshSample],
+        batch_size: int,
+        *,
+        shuffle: bool = False,
+        seed: int = 0,
+        bucket: bool = True,
+        drop_remainder: bool = False,
+    ):
+        self.samples = list(samples)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.bucket = bucket
+        self.drop_remainder = drop_remainder
+        self._rng = np.random.default_rng(seed)
+
+    def __len__(self) -> int:
+        n = len(self.samples)
+        if self.drop_remainder:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def __iter__(self) -> Iterator[MeshBatch]:
+        order = np.arange(len(self.samples))
+        if self.shuffle:
+            self._rng.shuffle(order)
+        for start in range(0, len(order), self.batch_size):
+            idx = order[start : start + self.batch_size]
+            if self.drop_remainder and len(idx) < self.batch_size:
+                return
+            yield collate([self.samples[i] for i in idx], bucket=self.bucket)

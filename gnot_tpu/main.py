@@ -1,0 +1,207 @@
+"""CLI driver: ``python -m gnot_tpu.main [flags]``.
+
+Superset of the reference CLI (``/root/reference/main.py:12-156``): the
+reference's nine hyperparameter flags keep their names and defaults, and
+the hardcoded constants (data paths, batch size 4, lr 1e-3) become flags.
+A ``--backend {jax,torch}`` selector keeps the PyTorch reference runnable
+as the numerical oracle (BASELINE.json north star) when it is available
+on disk; the jax path is this framework.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+
+from gnot_tpu import config as config_lib
+from gnot_tpu.config import Config, ModelConfig
+from gnot_tpu.data import datasets
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description="GNOT-TPU")
+    # Reference flags (main.py:15-23), same names and defaults.
+    p.add_argument("--n_attn_layers", type=int, default=4)
+    p.add_argument("--n_attn_hidden_dim", type=int, default=256)
+    p.add_argument("--n_mlp_num_layers", type=int, default=4)
+    p.add_argument("--n_mlp_hidden_dim", type=int, default=256)
+    p.add_argument("--n_input_hidden_dim", type=int, default=256)
+    p.add_argument("--n_expert", type=int, default=3)
+    p.add_argument("--n_head", type=int, default=8)
+    p.add_argument("--epochs", type=int, default=100)
+    # Previously-hardcoded values, now flags.
+    p.add_argument("--train_data", type=str, default="", help="train pickle path")
+    p.add_argument("--test_data", type=str, default="", help="test pickle path")
+    p.add_argument(
+        "--synthetic",
+        type=str,
+        default="ns2d",
+        choices=sorted(datasets.SYNTHETIC),
+        help="synthetic benchmark config when no pickle paths are given",
+    )
+    p.add_argument("--n_train", type=int, default=64)
+    p.add_argument("--n_test", type=int, default=16)
+    p.add_argument("--batch_size", type=int, default=4)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--seed", type=int, default=0)
+    # Framework knobs.
+    p.add_argument("--backend", type=str, default="jax", choices=["jax", "torch"])
+    p.add_argument(
+        "--attention_mode", type=str, default="masked", choices=["masked", "parity"]
+    )
+    p.add_argument("--dtype", type=str, default="float32", choices=["float32", "bfloat16"])
+    p.add_argument("--loss", type=str, default="rel_l2", choices=["rel_l2", "mse"])
+    p.add_argument("--schedule", type=str, default="parity", choices=["parity", "per_step"],
+                   help="parity: per-epoch OneCycle stepping (the reference bug); per_step: correct")
+    p.add_argument("--checkpoint_dir", type=str, default="")
+    p.add_argument("--resume", action="store_true")
+    p.add_argument("--checkpoint_every", type=int, default=0)
+    p.add_argument("--metrics_path", type=str, default="")
+    p.add_argument("--profile_dir", type=str, default="")
+    p.add_argument("--no_bucket", action="store_true", help="pad to per-batch max (parity)")
+    p.add_argument("--mesh_data", type=int, default=-1)
+    p.add_argument("--mesh_seq", type=int, default=1)
+    p.add_argument("--mesh_model", type=int, default=1)
+    return p
+
+
+def config_from_args(args: argparse.Namespace) -> Config:
+    cfg = config_lib.make_config(
+        **{
+            "data.train_path": args.train_data,
+            "data.test_path": args.test_data,
+            "data.synthetic": args.synthetic,
+            "data.n_train": args.n_train,
+            "data.n_test": args.n_test,
+            "data.batch_size": args.batch_size,
+            "data.seed": args.seed,
+            "data.bucket": not args.no_bucket and args.attention_mode != "parity",
+            "optim.lr": args.lr,
+            "optim.parity_schedule_bug": args.schedule == "parity",
+            "train.epochs": args.epochs,
+            "train.loss": args.loss,
+            "train.checkpoint_dir": args.checkpoint_dir,
+            "train.resume": args.resume,
+            "train.checkpoint_every": args.checkpoint_every,
+            "train.metrics_path": args.metrics_path,
+            "train.profile_dir": args.profile_dir,
+            "train.seed": args.seed,
+            "mesh.data": args.mesh_data,
+            "mesh.seq": args.mesh_seq,
+            "mesh.model": args.mesh_model,
+        }
+    )
+    return cfg
+
+
+def model_config(cfg: Config, args: argparse.Namespace, train_samples) -> ModelConfig:
+    dims = datasets.infer_model_dims(train_samples)
+    return dataclasses.replace(
+        cfg.model,
+        n_attn_layers=args.n_attn_layers,
+        n_attn_hidden_dim=args.n_attn_hidden_dim,
+        n_mlp_num_layers=args.n_mlp_num_layers,
+        n_mlp_hidden_dim=args.n_mlp_hidden_dim,
+        n_input_hidden_dim=args.n_input_hidden_dim,
+        n_expert=args.n_expert,
+        n_head=args.n_head,
+        attention_mode=args.attention_mode,
+        dtype=args.dtype,
+        **dims,
+    )
+
+
+def run_torch_backend(args: argparse.Namespace) -> float:
+    """Oracle path: train the reference PyTorch model on the same data
+    pipeline (no DGL needed — our loader feeds it padded tensors)."""
+    import numpy as np
+    import torch
+
+    from gnot_tpu.data.batch import Loader
+    from gnot_tpu.interop.torch_oracle import build_reference_model
+
+    cfg = config_from_args(args)
+    train_samples, test_samples = datasets.load(cfg.data)
+    mc = model_config(cfg, args, train_samples)
+    model = build_reference_model(mc)
+    opt = torch.optim.AdamW(model.parameters(), lr=args.lr)
+    from torch.optim.lr_scheduler import OneCycleLR
+
+    train_loader = Loader(
+        train_samples, cfg.data.batch_size, shuffle=True, seed=cfg.data.seed, bucket=False
+    )
+    test_loader = Loader(test_samples, cfg.data.batch_size, bucket=False)
+    sched = OneCycleLR(
+        opt, max_lr=args.lr, steps_per_epoch=len(train_loader), epochs=args.epochs
+    )
+
+    def rel_l2(pred, target, mask):
+        num = ((pred - target) ** 2 * mask[..., None]).sum(1)
+        den = (target**2 * mask[..., None]).sum(1)
+        return ((num / den) ** 0.5).mean()
+
+    best = float("inf")
+    for epoch in range(args.epochs):
+        losses = []
+        for b in train_loader:
+            out = model(
+                torch.from_numpy(b.coords),
+                torch.from_numpy(b.theta),
+                [torch.from_numpy(f) for f in b.funcs] if b.funcs is not None else None,
+            )
+            loss = rel_l2(out, torch.from_numpy(b.y), torch.from_numpy(b.node_mask))
+            losses.append(loss.item())
+            opt.zero_grad()
+            loss.backward()
+            opt.step()
+        print(f"Epoch {epoch}, Loss: {np.mean(losses)}")
+        sched.step()
+        with torch.no_grad():
+            metrics = [
+                rel_l2(
+                    model(
+                        torch.from_numpy(b.coords),
+                        torch.from_numpy(b.theta),
+                        [torch.from_numpy(f) for f in b.funcs]
+                        if b.funcs is not None
+                        else None,
+                    ),
+                    torch.from_numpy(b.y),
+                    torch.from_numpy(b.node_mask),
+                ).item()
+                for b in test_loader
+            ]
+        res = float(np.mean(metrics))
+        print(f"Epoch {epoch}, Test Metric: {res}")
+        print("-----------------------------------")
+        best = min(best, res)
+    print(f"\nBest Test Metric: {best}")
+    return best
+
+
+def main(argv=None) -> float:
+    args = build_parser().parse_args(argv)
+    if args.backend == "torch":
+        return run_torch_backend(args)
+
+    from gnot_tpu.train.trainer import Trainer
+    from gnot_tpu.utils.metrics import MetricsSink
+
+    cfg = config_from_args(args)
+    train_samples, test_samples = datasets.load(cfg.data)
+    mc = model_config(cfg, args, train_samples)
+
+    sink = MetricsSink(cfg.train.metrics_path) if cfg.train.metrics_path else None
+    checkpointer = None
+    if cfg.train.checkpoint_dir:
+        from gnot_tpu.train.checkpoint import Checkpointer
+
+        checkpointer = Checkpointer(cfg.train.checkpoint_dir)
+    trainer = Trainer(
+        cfg, mc, train_samples, test_samples, metrics_sink=sink, checkpointer=checkpointer
+    )
+    return trainer.fit()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,182 @@
+"""GNOT — General Neural Operator Transformer (arXiv 2302.14376).
+
+TPU-native Flax implementation with the exact semantics of the reference
+(``/root/reference/model.py:118-172``), including its deliberate quirks:
+
+* geometry gating is computed on the **raw coordinates only** (before the
+  theta concat), softmaxed over experts, and reused by every block
+  (model.py:148,155-156,169);
+* there is **no LayerNorm anywhere** (a divergence from the GNOT paper
+  that the reference makes and we preserve for parity);
+* the residual inside attention adds the softmaxed q (see layers.py).
+
+Two operating modes (``ModelConfig.attention_mode``):
+* ``"parity"`` — unmasked padding, numerics faithful to the reference
+  (padding pollutes attention; results depend on batch composition);
+* ``"masked"`` — ragged structure carried as 0/1 masks folded into the
+  attention reductions and losses; results are pad-length invariant.
+  This is the default and the mode all performance numbers use.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from gnot_tpu.config import ModelConfig
+from gnot_tpu.models.layers import GatedExpertFfn, LinearAttention, Mlp
+
+Array = jax.Array
+
+
+class HNABlock(nn.Module):
+    """One Heterogeneous Normalized Attention encoder layer
+    (reference model.py:118-139): cross-attention -> gated expert FFN ->
+    residual, then self-attention -> gated expert FFN -> residual."""
+
+    n_attn_hidden_dim: int
+    n_mlp_num_layers: int
+    n_mlp_hidden_dim: int
+    n_input_hidden_dim: int
+    n_expert: int
+    n_head: int
+    n_input_functions: int = 0
+    dtype: Any = None
+    parity: bool = False
+
+    @nn.compact
+    def __call__(
+        self,
+        scores: Array,
+        query: Array,
+        input_functions: Array | None = None,
+        *,
+        node_mask: Array | None = None,
+        func_mask: Array | None = None,
+    ) -> Array:
+        cross = LinearAttention(
+            self.n_attn_hidden_dim,
+            self.n_head,
+            self.n_input_functions,
+            dtype=self.dtype,
+            parity=self.parity,
+            name="cross_attention",
+        )(query, input_functions, query_mask=node_mask, func_mask=func_mask)
+        ffn1 = GatedExpertFfn(
+            self.n_expert,
+            self.n_mlp_num_layers,
+            self.n_mlp_hidden_dim,
+            self.n_mlp_hidden_dim,
+            dtype=self.dtype,
+            name="ffn1",
+        )(cross, scores)
+        query = query + ffn1
+
+        self_out = LinearAttention(
+            self.n_attn_hidden_dim,
+            self.n_head,
+            0,
+            dtype=self.dtype,
+            parity=self.parity,
+            name="self_attention",
+        )(query, query_mask=node_mask)
+        ffn2 = GatedExpertFfn(
+            self.n_expert,
+            self.n_mlp_num_layers,
+            self.n_mlp_hidden_dim,
+            self.n_mlp_hidden_dim,
+            dtype=self.dtype,
+            name="ffn2",
+        )(self_out, scores)
+        return query + ffn2
+
+
+class GNOT(nn.Module):
+    """Full GNOT model (reference model.py:142-172)."""
+
+    config: ModelConfig
+
+    @nn.compact
+    def __call__(
+        self,
+        coords: Array,
+        theta: Array,
+        input_functions: Array | None = None,
+        *,
+        node_mask: Array | None = None,
+        func_mask: Array | None = None,
+    ) -> Array:
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype) if cfg.dtype != "float32" else None
+        if cfg.attention_mode == "parity":
+            node_mask = func_mask = None
+
+        # Geometry gating on raw coordinates, computed once (model.py:155-156).
+        scores = Mlp(
+            cfg.n_mlp_num_layers,
+            cfg.n_mlp_hidden_dim,
+            cfg.n_expert,
+            dtype=dtype,
+            name="gating",
+        )(coords)
+        scores = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+
+        # Query embedding: theta broadcast along L, concat to coords
+        # (model.py:158-161).
+        theta_b = jnp.broadcast_to(
+            theta[:, None, :], (coords.shape[0], coords.shape[1], theta.shape[-1])
+        )
+        x = jnp.concatenate([coords, theta_b], axis=-1)
+        query = Mlp(
+            cfg.n_mlp_num_layers,
+            cfg.n_input_hidden_dim,
+            cfg.n_input_hidden_dim,
+            dtype=dtype,
+            name="x_embed",
+        )(x)
+
+        # Per-input-function embedding MLPs (model.py:149,164-166),
+        # stacked over the function axis.
+        if cfg.n_input_functions > 0 and input_functions is not None:
+            embed = nn.vmap(
+                Mlp,
+                in_axes=0,
+                out_axes=0,
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+            )(
+                cfg.n_mlp_num_layers,
+                cfg.n_mlp_hidden_dim,
+                cfg.n_input_hidden_dim,
+                dtype,
+                name="input_func_mlps",
+            )
+            funcs = embed(input_functions)  # [F, B, Lf, D]
+        else:
+            funcs = None
+
+        for i in range(cfg.n_attn_layers):
+            query = HNABlock(
+                cfg.n_attn_hidden_dim,
+                cfg.n_mlp_num_layers,
+                cfg.n_mlp_hidden_dim,
+                cfg.n_input_hidden_dim,
+                cfg.n_expert,
+                cfg.n_head,
+                cfg.n_input_functions if funcs is not None else 0,
+                dtype=dtype,
+                parity=cfg.attention_mode == "parity",
+                name=f"block_{i}",
+            )(scores, query, funcs, node_mask=node_mask, func_mask=func_mask)
+
+        out = Mlp(
+            cfg.n_mlp_num_layers,
+            cfg.n_mlp_hidden_dim,
+            cfg.out_dim,
+            dtype=dtype,
+            name="out_mlp",
+        )(query)
+        return out.astype(jnp.float32)

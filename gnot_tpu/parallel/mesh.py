@@ -1,0 +1,181 @@
+"""Device mesh + sharding layout: the distributed backend.
+
+The reference has no parallelism or communication backend at all
+(SURVEY.md §2 rows 9-10: single ``cuda:{id}`` device, no
+torch.distributed). The TPU-native equivalent is declarative: pick a
+mesh, annotate shardings, and let XLA GSPMD insert the collectives
+(psum/all-gather/reduce-scatter) over ICI — nothing hand-built.
+
+Axes of the mesh:
+
+* ``data`` — batch sharding (DP). Gradient reduction becomes an
+  implicit psum emitted by XLA.
+* ``seq``  — sequence/context parallelism (SP) over mesh points. GNOT's
+  linear attention shards trivially over sequence: ``k_sum`` and
+  ``k^T v`` are segment-sums over L, so each shard contributes a partial
+  sum and XLA inserts one psum per attention (SURVEY.md §5 long-context
+  note). This is what makes Heatsink3d-scale point clouds fit.
+* ``model`` — tensor parallelism (TP): attention projections are
+  head-sharded (the embed axis factors as [head, head_dim] with head
+  leading), expert-FFN hidden layers are column/row-sharded.
+
+Soft-MoE note: GNOT's mixture is dense (every expert runs on every
+token, no routing — reference model.py:128-130), so classic expert
+parallelism with all-to-all does not apply; the expert dimension is a
+batched GEMM that TP shards instead.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gnot_tpu.config import MeshConfig
+from gnot_tpu.data.batch import MeshBatch
+
+AXES = ("data", "seq", "model")
+
+
+def make_mesh(cfg: MeshConfig, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    seq, model = cfg.seq, cfg.model
+    data = cfg.data if cfg.data > 0 else n // (seq * model)
+    if data * seq * model != n:
+        raise ValueError(
+            f"mesh {data}x{seq}x{model} does not cover {n} devices"
+        )
+    arr = np.asarray(devices).reshape(data, seq, model)
+    return Mesh(arr, AXES)
+
+
+def batch_pspecs() -> MeshBatch:
+    """PartitionSpecs for a MeshBatch: batch over ``data``, mesh-point
+    and function-point axes over ``seq``."""
+    return MeshBatch(
+        coords=P("data", "seq", None),
+        theta=P("data", None),
+        y=P("data", "seq", None),
+        node_mask=P("data", "seq"),
+        funcs=P(None, "data", "seq", None),
+        func_mask=P(None, "data", "seq"),
+    )
+
+
+def batch_shardings(mesh: Mesh, batch: MeshBatch) -> MeshBatch:
+    specs = batch_pspecs()
+    return jax.tree.map(
+        lambda spec, leaf: NamedSharding(mesh, spec) if leaf is not None else None,
+        specs,
+        batch,
+        is_leaf=lambda x: x is None or isinstance(x, P),
+    )
+
+
+def shard_batch(mesh: Mesh, batch: MeshBatch) -> MeshBatch:
+    """Host->device transfer with the batch layout applied."""
+    return jax.tree.map(
+        lambda leaf, sh: jax.device_put(leaf, sh),
+        batch,
+        batch_shardings(mesh, batch),
+    )
+
+
+def _param_pspec(path: str, leaf) -> P:
+    """Name-based TP rules for the GNOT param tree.
+
+    The embed axis E of every attention projection factors as
+    [n_head, head_dim] with head leading (split_heads), so sharding E
+    over ``model`` is head-parallelism. fc_out is row-parallel (its
+    input axis carries E), producing the usual column->row TP pair with
+    one psum at the block output. Expert-FFN hidden layers are
+    column-sharded on the way in, row-sharded on the way out.
+    """
+    ndim = np.ndim(leaf)
+    is_kernel = path.endswith("kernel")
+    if re.search(r"(query|key|value)/kernel$", path):
+        return P(*([None] * (ndim - 1) + ["model"]))  # column (head) parallel
+    if re.search(r"(query|key|value)/bias$", path):
+        return P(*([None] * (ndim - 1) + ["model"]))
+    if re.search(r"fc_out/kernel$", path):
+        return P("model", None)  # row parallel -> psum
+    if "experts/" in path or "input_func_mlps/" in path:
+        # Stacked MLPs [S, in, out]: shard the hidden axis.
+        if is_kernel and "dense_0" in path:
+            return P(None, None, "model")
+        if is_kernel:
+            return P(None, "model", None)
+        if "dense_0" in path and ndim == 2:
+            return P(None, "model")
+        return P(*([None] * ndim))
+    return P(*([None] * ndim))  # everything else replicated
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k)))) for k in path
+    )
+
+
+def param_shardings(mesh: Mesh, params) -> Any:
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, _param_pspec(_path_str(path), leaf)),
+        params,
+    )
+
+
+def state_shardings(mesh: Mesh, state) -> Any:
+    """Shardings for a full TrainState: optimizer moments follow their
+    parameters (their tree paths end with the same param path), scalars
+    replicate."""
+
+    def rule(path, leaf):
+        p = _path_str(path)
+        if np.ndim(leaf) == 0:
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, _param_pspec(p, leaf))
+
+    return jax.tree_util.tree_map_with_path(rule, state)
+
+
+def shard_state(mesh: Mesh, state):
+    return jax.tree.map(
+        lambda leaf, sh: jax.device_put(leaf, sh), state, state_shardings(mesh, state)
+    )
+
+
+def make_sharded_train_step(model, optim_cfg, loss_name: str, mesh: Mesh, state):
+    """jit the train step with explicit in/out shardings over the mesh.
+
+    All communication (DP gradient psum, SP partial-sum psums inside the
+    linear attention, TP collectives around the sharded GEMMs) is
+    emitted by XLA from these annotations.
+    """
+    import optax
+
+    from gnot_tpu.train.trainer import TrainState, batch_loss, make_optimizer
+
+    def step(state: TrainState, batch: MeshBatch, lr):
+        loss, grads = jax.value_and_grad(
+            lambda p: batch_loss(model, p, batch, loss_name)
+        )(state.params)
+        tx = make_optimizer(optim_cfg, lr)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+            loss,
+        )
+
+    st_sh = state_shardings(mesh, state)
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        step,
+        in_shardings=(st_sh, None, replicated),
+        out_shardings=(st_sh, replicated),
+        donate_argnums=(0,),
+    )

@@ -1,0 +1,59 @@
+"""Orbax checkpointing: a strict capability superset of the reference.
+
+The reference saves only ``state_dict`` of the best-eval model to a
+hardcoded ``best_model.pth`` and has no load path at all
+(``/root/reference/main.py:149-151``; SURVEY.md §5). Here:
+
+* ``best/`` — best-eval model (reference behavior), full train state;
+* ``latest/`` — periodic checkpoint for preemption-safe ``--resume``
+  (TPU VMs are preemptible; resumability is the minimal failure-recovery
+  story a TPU framework needs);
+* JSON sidecar with ``{epoch, best_metric, step}``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any
+
+import orbax.checkpoint as ocp
+
+
+class Checkpointer:
+    def __init__(self, directory: str):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._ckptr = ocp.StandardCheckpointer()
+
+    def _save(self, name: str, state: Any, epoch: int, best_metric: float) -> None:
+        path = os.path.join(self.directory, name)
+        self._ckptr.save(path, state, force=True)
+        self._ckptr.wait_until_finished()
+        meta = {"epoch": epoch, "best_metric": best_metric}
+        with open(os.path.join(self.directory, f"{name}.json"), "w") as f:
+            json.dump(meta, f)
+
+    def save_best(self, state: Any, epoch: int, best_metric: float) -> None:
+        self._save("best", state, epoch, best_metric)
+
+    def save_latest(self, state: Any, epoch: int, best_metric: float) -> None:
+        self._save("latest", state, epoch, best_metric)
+
+    def _restore(self, name: str, target: Any):
+        path = os.path.join(self.directory, name)
+        meta_path = f"{path}.json"
+        if not os.path.exists(meta_path):
+            return None
+        state = self._ckptr.restore(path, target)
+        with open(meta_path) as f:
+            meta = json.load(f)
+        return state, int(meta["epoch"]), float(meta["best_metric"])
+
+    def restore_latest(self, target: Any):
+        """Returns (state, epoch, best_metric) or None. Prefers the
+        periodic ``latest`` checkpoint, falls back to ``best``."""
+        return self._restore("latest", target) or self._restore("best", target)
+
+    def restore_best(self, target: Any):
+        return self._restore("best", target)

@@ -1,0 +1,31 @@
+"""Structured metrics sinks.
+
+The reference logs via three ``print`` lines per epoch
+(``/root/reference/main.py:105,147-148``). The trainer keeps those exact
+console lines for diffability; this module adds structured JSONL metrics
+(loss, LR, throughput, step time) on top — SURVEY.md §5 observability.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, TextIO
+
+
+class MetricsSink:
+    """Append-only JSONL metrics writer."""
+
+    def __init__(self, path: str):
+        self.path = path
+        if d := os.path.dirname(path):
+            os.makedirs(d, exist_ok=True)
+        self._fh: TextIO = open(path, "a", buffering=1)
+
+    def log(self, **record: Any) -> None:
+        record.setdefault("ts", time.time())
+        self._fh.write(json.dumps(record) + "\n")
+
+    def close(self) -> None:
+        self._fh.close()

@@ -1,0 +1,20 @@
+"""Test configuration: force an 8-device CPU JAX platform BEFORE jax
+initializes, so sharding/parallelism tests run without TPU hardware
+(SURVEY.md §4: the standard way to test multi-chip TPU code)."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Force CPU: the ambient environment may point JAX_PLATFORMS at a real
+# TPU tunnel, whose default bf16 matmuls would break numeric tolerances.
+# A sitecustomize may already have imported jax, so the env var alone is
+# not enough — update the live config too (backends init lazily).
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

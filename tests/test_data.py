@@ -1,0 +1,105 @@
+"""Data layer tests: reference-faithful padding semantics, pickle
+round-trip, bucketing, synthetic generators."""
+
+import numpy as np
+import pytest
+
+from gnot_tpu.data import datasets
+from gnot_tpu.data.batch import Loader, MeshSample, bucket_length, collate, pad_rows
+
+
+def ragged_samples():
+    rng = np.random.default_rng(0)
+    out = []
+    for n, m1, m2 in [(5, 3, 7), (9, 4, 2), (6, 8, 5)]:
+        out.append(
+            MeshSample(
+                coords=rng.normal(size=(n, 2)).astype(np.float32),
+                y=rng.normal(size=(n, 1)).astype(np.float32),
+                theta=np.array([1.0], np.float32),
+                funcs=(
+                    rng.normal(size=(m1, 3)).astype(np.float32),
+                    rng.normal(size=(m2, 3)).astype(np.float32),
+                ),
+            )
+        )
+    return out
+
+
+def test_collate_shared_func_max():
+    """Input functions pad to ONE max across all functions of all samples
+    (reference main.py:63), not per-function maxima."""
+    batch = collate(ragged_samples(), bucket=False)
+    assert batch.funcs.shape == (2, 3, 8, 3)  # shared max = 8
+    assert batch.coords.shape == (3, 9, 2)  # per-batch node max = 9
+    assert batch.func_mask.shape == (2, 3, 8)
+    # masks count real lengths
+    np.testing.assert_array_equal(batch.node_mask.sum(1), [5, 9, 6])
+    np.testing.assert_array_equal(batch.func_mask[0].sum(1), [3, 4, 8])
+    np.testing.assert_array_equal(batch.func_mask[1].sum(1), [7, 2, 5])
+
+
+def test_collate_zero_tail_padding():
+    batch = collate(ragged_samples(), bucket=False)
+    assert (batch.coords[0, 5:] == 0).all()  # zero pad at tail (utils.py:3-4)
+    assert (batch.y[2, 6:] == 0).all()
+
+
+def test_bucketing_bounds_recompiles():
+    ls = [bucket_length(n) for n in range(1, 3000, 37)]
+    assert len(set(ls)) <= 12  # O(log L) distinct shapes
+    for n in range(1, 3000, 37):
+        assert bucket_length(n) >= n
+
+
+def test_pad_rows_noop_when_equal():
+    x = np.ones((4, 2), np.float32)
+    assert pad_rows(x, 4) is x
+
+
+def test_pickle_roundtrip(tmp_path):
+    samples = ragged_samples()
+    path = str(tmp_path / "data.pkl")
+    datasets.save_pickle(samples, path)
+    loaded = datasets.load_pickle(path)
+    assert len(loaded) == len(samples)
+    for a, b in zip(samples, loaded):
+        np.testing.assert_array_equal(a.coords, b.coords)
+        np.testing.assert_array_equal(a.y, b.y)
+        np.testing.assert_array_equal(a.theta, b.theta)
+        for fa, fb in zip(a.funcs, b.funcs):
+            np.testing.assert_array_equal(fa, fb)
+
+
+@pytest.mark.parametrize("name", sorted(datasets.SYNTHETIC))
+def test_synthetic_generators(name):
+    samples = datasets.SYNTHETIC[name](4, seed=1)
+    assert len(samples) == 4
+    dims = datasets.infer_model_dims(samples)
+    assert dims["out_dim"] >= 1
+    batch = collate(samples)
+    assert np.isfinite(batch.coords).all() and np.isfinite(batch.y).all()
+    # determinism
+    again = datasets.SYNTHETIC[name](4, seed=1)
+    np.testing.assert_array_equal(samples[0].coords, again[0].coords)
+
+
+def test_infer_dims_matches_reference_shape_inference():
+    """Shape inference from sample 0 (reference main.py:30-35)."""
+    samples = ragged_samples()
+    dims = datasets.infer_model_dims(samples)
+    assert dims == dict(
+        input_dim=2, theta_dim=1, input_func_dim=3, out_dim=1, n_input_functions=2
+    )
+
+
+def test_loader_shuffle_deterministic_by_seed():
+    samples = ragged_samples() * 4
+    l1 = [b.coords.sum() for b in Loader(samples, 4, shuffle=True, seed=7)]
+    l2 = [b.coords.sum() for b in Loader(samples, 4, shuffle=True, seed=7)]
+    assert l1 == l2
+    # different epochs reshuffle
+    loader = Loader(samples, 4, shuffle=True, seed=7)
+    e1 = [b.coords.sum() for b in loader]
+    e2 = [b.coords.sum() for b in loader]
+    assert e1 != e2

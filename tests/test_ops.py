@@ -1,0 +1,136 @@
+"""Unit tests for the core ops against naive per-sample oracles."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gnot_tpu.ops.attention import (
+    feature_softmax,
+    merge_heads,
+    normalized_linear_attention,
+    split_heads,
+)
+from gnot_tpu.ops.segment import masked_segment_mean, masked_segment_sum, mse_loss, rel_l2_loss
+
+
+def naive_normalized_attention(q, k, v):
+    """O(L^2) per-sample oracle: explicit attention weights.
+
+    alpha * q @ (k^T v) == (q k^T / normalizer) @ v — the linear form is
+    just a reassociation of an explicit (unnormalized-softmax-free)
+    attention matrix; verify against that direct form.
+    """
+    b, h, lq, d = q.shape
+    out = np.zeros_like(q)
+    for bi in range(b):
+        for hi in range(h):
+            qm, km, vm = q[bi, hi], k[bi, hi], v[bi, hi]
+            attn = qm @ km.T  # [Lq, Lk]
+            norm = attn.sum(axis=1, keepdims=True)
+            out[bi, hi] = (attn / norm) @ vm
+    return out
+
+
+def test_attention_matches_quadratic_oracle():
+    rng = np.random.default_rng(0)
+    q = rng.normal(size=(2, 3, 17, 8)).astype(np.float32)
+    k = rng.normal(size=(2, 3, 29, 8)).astype(np.float32)
+    v = rng.normal(size=(2, 3, 29, 8)).astype(np.float32)
+    qs = np.asarray(feature_softmax(jnp.asarray(q)))
+    ks = np.asarray(feature_softmax(jnp.asarray(k)))
+    got = normalized_linear_attention(jnp.asarray(qs), jnp.asarray(ks), jnp.asarray(v))
+    want = naive_normalized_attention(qs, ks, v)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
+
+
+def test_attention_mask_equals_shorter_sequence():
+    """Masked attention over padded k/v == unmasked over the real rows."""
+    rng = np.random.default_rng(1)
+    lk_real, lk_pad = 13, 24
+    q = feature_softmax(jnp.asarray(rng.normal(size=(2, 2, 11, 8)), jnp.float32))
+    k_real = rng.normal(size=(2, 2, lk_real, 8)).astype(np.float32)
+    v_real = rng.normal(size=(2, 2, lk_real, 8)).astype(np.float32)
+    k_pad = np.concatenate(
+        [k_real, rng.normal(size=(2, 2, lk_pad - lk_real, 8)).astype(np.float32)], axis=2
+    )
+    v_pad = np.concatenate(
+        [v_real, rng.normal(size=(2, 2, lk_pad - lk_real, 8)).astype(np.float32)], axis=2
+    )
+    mask = np.zeros((2, lk_pad), np.float32)
+    mask[:, :lk_real] = 1.0
+    want = normalized_linear_attention(
+        q, feature_softmax(jnp.asarray(k_real)), jnp.asarray(v_real)
+    )
+    got = normalized_linear_attention(
+        q,
+        feature_softmax(jnp.asarray(k_pad)),
+        jnp.asarray(v_pad),
+        kv_mask=jnp.asarray(mask),
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-5)
+
+
+def test_feature_softmax_axis():
+    x = jnp.asarray(np.random.default_rng(2).normal(size=(2, 2, 5, 8)), jnp.float32)
+    s = feature_softmax(x)
+    np.testing.assert_allclose(np.asarray(s.sum(axis=-1)), 1.0, rtol=1e-6)
+
+
+def test_split_merge_heads_roundtrip():
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(2, 7, 24)), jnp.float32)
+    np.testing.assert_array_equal(np.asarray(merge_heads(split_heads(x, 4))), np.asarray(x))
+
+
+def test_segment_reductions_match_manual_segments():
+    rng = np.random.default_rng(4)
+    lengths = [5, 9, 3]
+    l_max = 12
+    vals = rng.normal(size=(3, l_max, 2)).astype(np.float32)
+    mask = np.zeros((3, l_max), np.float32)
+    for i, n in enumerate(lengths):
+        mask[i, :n] = 1.0
+    got_sum = np.asarray(masked_segment_sum(jnp.asarray(vals), jnp.asarray(mask)))
+    got_mean = np.asarray(masked_segment_mean(jnp.asarray(vals), jnp.asarray(mask)))
+    for i, n in enumerate(lengths):
+        np.testing.assert_allclose(got_sum[i], vals[i, :n].sum(0), rtol=1e-5)
+        np.testing.assert_allclose(got_mean[i], vals[i, :n].mean(0), rtol=1e-5)
+
+
+def test_losses_match_dgl_style_pooling():
+    """rel-L2 / MSE equal the reference formulas computed segment-wise:
+    mean over graphs AND channels of per-graph pooled values
+    (reference loss.py:9-12,19-23)."""
+    rng = np.random.default_rng(5)
+    lengths = [6, 4]
+    l_max = 8
+    p = rng.normal(size=(2, l_max, 3)).astype(np.float32)
+    t = rng.normal(size=(2, l_max, 3)).astype(np.float32)
+    mask = np.zeros((2, l_max), np.float32)
+    for i, n in enumerate(lengths):
+        mask[i, :n] = 1.0
+
+    rel, mse = [], []
+    for i, n in enumerate(lengths):
+        num = ((p[i, :n] - t[i, :n]) ** 2).sum(0)
+        den = (t[i, :n] ** 2).sum(0)
+        rel.append(np.sqrt(num / den))
+        mse.append(((p[i, :n] - t[i, :n]) ** 2).mean(0))
+    np.testing.assert_allclose(
+        float(rel_l2_loss(jnp.asarray(p), jnp.asarray(t), jnp.asarray(mask))),
+        np.mean(rel),
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        float(mse_loss(jnp.asarray(p), jnp.asarray(t), jnp.asarray(mask))),
+        np.mean(mse),
+        rtol=1e-6,
+    )
+
+
+def test_loss_grads_finite():
+    p = jnp.ones((2, 4, 1)) * 0.5
+    t = jnp.ones((2, 4, 1))
+    mask = jnp.ones((2, 4))
+    g = jax.grad(lambda x: rel_l2_loss(x, t, mask))(p)
+    assert np.isfinite(np.asarray(g)).all()

@@ -1,0 +1,25 @@
+"""OneCycle schedule vs torch.optim.lr_scheduler.OneCycleLR."""
+
+import numpy as np
+import pytest
+
+from gnot_tpu.train.schedule import onecycle_lr
+
+
+@pytest.mark.parametrize("steps_per_epoch,epochs", [(7, 13), (250, 100), (3, 2)])
+def test_onecycle_matches_torch(steps_per_epoch, epochs):
+    torch = pytest.importorskip("torch")
+    from torch.optim.lr_scheduler import OneCycleLR
+
+    max_lr = 1e-3
+    total = steps_per_epoch * epochs
+    opt = torch.optim.AdamW([torch.nn.Parameter(torch.zeros(1))], lr=max_lr)
+    sched = OneCycleLR(opt, max_lr=max_lr, steps_per_epoch=steps_per_epoch, epochs=epochs)
+
+    got = [onecycle_lr(0, max_lr=max_lr, total_steps=total)]
+    want = [opt.param_groups[0]["lr"]]
+    for step in range(1, total):
+        sched.step()
+        want.append(opt.param_groups[0]["lr"])
+        got.append(onecycle_lr(step, max_lr=max_lr, total_steps=total))
+    np.testing.assert_allclose(got, want, rtol=1e-10)
