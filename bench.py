@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 
-def build(step_dtype: str):
+def build(step_dtype: str, attention_impl: str = "xla", n_points: int = 1024, batch_size: int = 4):
     from gnot_tpu.config import ModelConfig, OptimConfig
     from gnot_tpu.data import datasets
     from gnot_tpu.data.batch import Loader
@@ -36,9 +36,10 @@ def build(step_dtype: str):
         out_dim=1,
         n_input_functions=1,
         dtype=step_dtype,
+        attention_impl=attention_impl,
     )  # reference-default architecture (main.py:16-22)
-    samples = datasets.synth_ns2d(4, n_points=1024, seed=0)
-    batch = next(iter(Loader(samples, 4)))
+    samples = datasets.synth_ns2d(batch_size, n_points=n_points, seed=0)
+    batch = next(iter(Loader(samples, batch_size)))
     model = GNOT(mc)
     optim = OptimConfig()
     state = init_state(model, optim, batch, seed=0)
@@ -68,20 +69,28 @@ def main():
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument("--cpu_steps", type=int, default=3)
     p.add_argument("--dtype", type=str, default="bfloat16", choices=["float32", "bfloat16"])
+    p.add_argument("--attention_impl", type=str, default="xla", choices=["xla", "pallas"])
+    p.add_argument("--n_points", type=int, default=1024)
+    p.add_argument("--batch_size", type=int, default=4)
     args = p.parse_args()
 
     lr = jnp.asarray(1e-3, jnp.float32)
     accel = jax.devices()[0]
     cpu = jax.devices("cpu")[0]
 
-    step, state, batch = build(args.dtype)
+    step, state, batch = build(
+        args.dtype, args.attention_impl, args.n_points, args.batch_size
+    )
     value = time_steps(step, state, batch, lr, args.warmup, args.steps, accel)
 
     if accel.platform == "cpu":
         vs_baseline = 1.0
     else:
-        # CPU baseline in f32 — the reference's numeric regime.
-        step_c, state_c, batch_c = build("float32")
+        # CPU baseline in f32 — the reference's numeric regime — at the
+        # SAME workload, so vs_baseline is purely a hardware ratio.
+        step_c, state_c, batch_c = build(
+            "float32", "xla", args.n_points, args.batch_size
+        )
         cpu_value = time_steps(step_c, state_c, batch_c, lr, 1, args.cpu_steps, cpu)
         vs_baseline = value / cpu_value
 

@@ -32,6 +32,10 @@ class ModelConfig:
     # "parity": unmasked padding, pollution-faithful to the reference.
     # "masked": correct masking; results independent of pad lengths.
     attention_mode: str = "masked"
+    # "xla": attention as fused einsums (GSPMD-shardable, the mesh path).
+    # "pallas": fused single-pass VMEM kernel (ops/pallas_attention.py);
+    # single-device / DP only — pallas_call is not GSPMD-partitionable.
+    attention_impl: str = "xla"
     # Compute dtype for the encoder stack; params stay float32.
     dtype: str = "float32"
 
@@ -40,6 +44,8 @@ class ModelConfig:
             raise ValueError("n_attn_hidden_dim must be divisible by n_head")
         if self.attention_mode not in ("parity", "masked"):
             raise ValueError(f"unknown attention_mode {self.attention_mode!r}")
+        if self.attention_impl not in ("xla", "pallas"):
+            raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
 
 
 @dataclasses.dataclass(frozen=True)

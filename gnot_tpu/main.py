@@ -49,12 +49,20 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--attention_mode", type=str, default="masked", choices=["masked", "parity"]
     )
+    p.add_argument(
+        "--attention_impl", type=str, default="xla", choices=["xla", "pallas"],
+        help="pallas: fused VMEM attention kernel (single-device / DP)"
+    )
     p.add_argument("--dtype", type=str, default="float32", choices=["float32", "bfloat16"])
     p.add_argument("--loss", type=str, default="rel_l2", choices=["rel_l2", "mse"])
     p.add_argument("--schedule", type=str, default="parity", choices=["parity", "per_step"],
                    help="parity: per-epoch OneCycle stepping (the reference bug); per_step: correct")
     p.add_argument("--checkpoint_dir", type=str, default="")
     p.add_argument("--resume", action="store_true")
+    p.add_argument(
+        "--eval_only", action="store_true",
+        help="restore the best checkpoint and evaluate (no training)"
+    )
     p.add_argument("--checkpoint_every", type=int, default=0)
     p.add_argument("--metrics_path", type=str, default="")
     p.add_argument("--profile_dir", type=str, default="")
@@ -106,6 +114,7 @@ def model_config(cfg: Config, args: argparse.Namespace, train_samples) -> ModelC
         n_expert=args.n_expert,
         n_head=args.n_head,
         attention_mode=args.attention_mode,
+        attention_impl=args.attention_impl,
         dtype=args.dtype,
         **dims,
     )
@@ -200,6 +209,8 @@ def main(argv=None) -> float:
     trainer = Trainer(
         cfg, mc, train_samples, test_samples, metrics_sink=sink, checkpointer=checkpointer
     )
+    if args.eval_only:
+        return trainer.evaluate_from_checkpoint()
     return trainer.fit()
 
 

@@ -46,6 +46,7 @@ class HNABlock(nn.Module):
     n_input_functions: int = 0
     dtype: Any = None
     parity: bool = False
+    attention_impl: str = "xla"
 
     @nn.compact
     def __call__(
@@ -63,6 +64,7 @@ class HNABlock(nn.Module):
             self.n_input_functions,
             dtype=self.dtype,
             parity=self.parity,
+            attention_impl=self.attention_impl,
             name="cross_attention",
         )(query, input_functions, query_mask=node_mask, func_mask=func_mask)
         ffn1 = GatedExpertFfn(
@@ -81,6 +83,7 @@ class HNABlock(nn.Module):
             0,
             dtype=self.dtype,
             parity=self.parity,
+            attention_impl=self.attention_impl,
             name="self_attention",
         )(query, query_mask=node_mask)
         ffn2 = GatedExpertFfn(
@@ -169,6 +172,7 @@ class GNOT(nn.Module):
                 cfg.n_input_functions if funcs is not None else 0,
                 dtype=dtype,
                 parity=cfg.attention_mode == "parity",
+                attention_impl=cfg.attention_impl,
                 name=f"block_{i}",
             )(scores, query, funcs, node_mask=node_mask, func_mask=func_mask)
 

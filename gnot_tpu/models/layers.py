@@ -22,6 +22,7 @@ from gnot_tpu.ops.attention import (
     normalized_linear_attention,
     split_heads,
 )
+from gnot_tpu.ops.pallas_attention import fused_nla
 
 Array = jax.Array
 
@@ -131,6 +132,9 @@ class LinearAttention(nn.Module):
     # pad-invariance in masked mode, since the interleaved merge leaks
     # padded-row garbage into real rows).
     parity: bool = False
+    # "xla": einsum formulation; "pallas": fused VMEM kernel
+    # (ops/pallas_attention.py). Numerically equivalent.
+    attention_impl: str = "xla"
 
     def _merge(self, x: Array) -> Array:
         if self.parity:
@@ -148,8 +152,13 @@ class LinearAttention(nn.Module):
         func_mask: Array | None = None,
     ) -> Array:
         e, h = self.n_embed, self.n_head
-        q = torch_dense(e, query.shape[-1], name="query", dtype=self.dtype)(query)
-        q = feature_softmax(split_heads(q, h))
+        use_pallas = self.attention_impl == "pallas"
+        if use_pallas and self.parity:
+            # Parity mode replicates the reference's interleaved head
+            # merge (see above); the fused kernel produces the correct
+            # merge, so parity runs stay on the XLA path.
+            raise ValueError("attention_impl='pallas' is incompatible with parity mode")
+        q_proj = torch_dense(e, query.shape[-1], name="query", dtype=self.dtype)(query)
 
         if self.n_input_functions > 0:
             if input_functions is None:
@@ -158,29 +167,49 @@ class LinearAttention(nn.Module):
                 )
             # input_functions: [F, B, Lf, E]; stacked K/V -> one batched GEMM.
             fan_in = input_functions.shape[-1]
-            k = _stacked_dense(e, fan_in, name="key", dtype=self.dtype)(
+            k_proj = _stacked_dense(e, fan_in, name="key", dtype=self.dtype)(
                 input_functions
             )
-            v = _stacked_dense(e, fan_in, name="value", dtype=self.dtype)(
+            v_proj = _stacked_dense(e, fan_in, name="value", dtype=self.dtype)(
                 input_functions
             )
-            k = feature_softmax(
-                jax.vmap(lambda t: split_heads(t, h))(k)
-            )  # [F, B, H, Lf, D]
-            v = jax.vmap(lambda t: split_heads(t, h))(v)
-            mask_axis = None if func_mask is None else 0
-            out = jax.vmap(_nla_positional, in_axes=(None, 0, 0, mask_axis))(
-                q, k, v, func_mask
-            )  # [F, B, H, Lq, D]
-            out = jnp.mean(out, axis=0)
+            if use_pallas:
+                mask = func_mask
+                if mask is None:
+                    mask = jnp.ones(k_proj.shape[:3], k_proj.dtype)
+                out_f, res_q = fused_nla(q_proj, k_proj, v_proj, mask, h)
+                res = res_q + jnp.mean(out_f, axis=0)
+            else:
+                q = feature_softmax(split_heads(q_proj, h))
+                k = feature_softmax(jax.vmap(lambda t: split_heads(t, h))(k_proj))
+                v = jax.vmap(lambda t: split_heads(t, h))(v_proj)
+                mask_axis = None if func_mask is None else 0
+                out = jax.vmap(_nla_positional, in_axes=(None, 0, 0, mask_axis))(
+                    q, k, v, func_mask
+                )  # [F, B, H, Lq, D]
+                res = self._merge(q) + self._merge(jnp.mean(out, axis=0))
         else:
-            k = torch_dense(e, query.shape[-1], name="key", dtype=self.dtype)(query)
-            v = torch_dense(e, query.shape[-1], name="value", dtype=self.dtype)(query)
-            k = feature_softmax(split_heads(k, h))
-            v = split_heads(v, h)
-            out = normalized_linear_attention(q, k, v, kv_mask=query_mask)
+            k_proj = torch_dense(e, query.shape[-1], name="key", dtype=self.dtype)(
+                query
+            )
+            v_proj = torch_dense(e, query.shape[-1], name="value", dtype=self.dtype)(
+                query
+            )
+            if use_pallas:
+                mask = query_mask
+                if mask is None:
+                    mask = jnp.ones(k_proj.shape[:2], k_proj.dtype)
+                out_f, res_q = fused_nla(
+                    q_proj, k_proj[None], v_proj[None], mask[None], h
+                )
+                res = res_q + out_f[0]
+            else:
+                q = feature_softmax(split_heads(q_proj, h))
+                k = feature_softmax(split_heads(k_proj, h))
+                v = split_heads(v_proj, h)
+                out = normalized_linear_attention(q, k, v, kv_mask=query_mask)
+                res = self._merge(q) + self._merge(out)
 
-        res = self._merge(q) + self._merge(out)
         return torch_dense(e, e, name="fc_out", dtype=self.dtype)(res)
 
 

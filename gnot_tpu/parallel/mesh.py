@@ -159,6 +159,12 @@ def make_sharded_train_step(model, optim_cfg, loss_name: str, mesh: Mesh, state)
 
     from gnot_tpu.train.trainer import TrainState, batch_loss, make_optimizer
 
+    if getattr(model.config, "attention_impl", "xla") == "pallas":
+        raise ValueError(
+            "attention_impl='pallas' is single-device/DP only: pallas_call "
+            "is not GSPMD-partitionable; use attention_impl='xla' on a mesh"
+        )
+
     def step(state: TrainState, batch: MeshBatch, lr):
         loss, grads = jax.value_and_grad(
             lambda p: batch_loss(model, p, batch, loss_name)

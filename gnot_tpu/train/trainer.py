@@ -30,6 +30,7 @@ from gnot_tpu.data.batch import Loader, MeshBatch
 from gnot_tpu.models.gnot import GNOT
 from gnot_tpu.ops.segment import LOSSES
 from gnot_tpu.train.schedule import make_lr_fn
+from gnot_tpu.utils import profiling
 
 
 @flax.struct.dataclass
@@ -168,26 +169,52 @@ class Trainer:
         ]
         return float(np.mean(metrics))
 
+    def evaluate_from_checkpoint(self) -> float:
+        """Restore the best checkpoint and run eval only — the load path
+        the reference never had (it writes best_model.pth and never
+        reads it back, main.py:149-151)."""
+        if self.checkpointer is None:
+            raise ValueError("eval-only mode needs --checkpoint_dir")
+        if self.state is None:
+            self.initialize()
+        restored = self.checkpointer.restore_best(self.state)
+        if restored is None:
+            raise FileNotFoundError(
+                f"no best checkpoint under {self.checkpointer.directory}"
+            )
+        self.state, epoch, best = restored
+        res = self.evaluate()
+        print(f"Eval (best checkpoint from epoch {epoch}): {res}")
+        return res
+
     def fit(self) -> float:
         if self.state is None:
             self.initialize()
         cfg = self.config
+        # Trace the second executed epoch (warm jit caches), or the only
+        # one if the run has a single epoch.
+        trace_at = min(self.start_epoch + 1, cfg.train.epochs - 1)
         for epoch in range(self.start_epoch, cfg.train.epochs):
             t0 = time.perf_counter()
             losses, points = [], 0
-            for batch in self.train_loader:
-                lr = self.lr_fn(int(self.state.step), epoch)
-                self.state, loss = self.train_step(
-                    self.state, batch, jnp.asarray(lr, jnp.float32)
-                )
-                losses.append(loss)
-                points += batch.n_real_points
-            train_loss = float(np.mean([np.asarray(l) for l in losses]))
-            dt = time.perf_counter() - t0
-            # Reference's exact console line (main.py:105).
-            print(f"Epoch {epoch}, Loss: {train_loss}")
+            with profiling.trace_epoch(
+                cfg.train.profile_dir, epoch, trace_at=trace_at
+            ):
+                with profiling.annotate("train_epoch"):
+                    for batch in self.train_loader:
+                        lr = self.lr_fn(int(self.state.step), epoch)
+                        self.state, loss = self.train_step(
+                            self.state, batch, jnp.asarray(lr, jnp.float32)
+                        )
+                        losses.append(loss)
+                        points += batch.n_real_points
+                train_loss = float(np.mean([np.asarray(l) for l in losses]))
+                dt = time.perf_counter() - t0
+                # Reference's exact console line (main.py:105).
+                print(f"Epoch {epoch}, Loss: {train_loss}")
 
-            res = self.evaluate()
+                with profiling.annotate("eval_epoch"):
+                    res = self.evaluate()
             print(f"Epoch {epoch}, Test Metric: {res}")
             print("-----------------------------------")
 

@@ -1,0 +1,31 @@
+"""Debug-build numeric guards (SURVEY.md §5 race-detection note: the
+reference is single-threaded with nothing to race; the TPU-native
+equivalent of sanitizers is ``checkify`` for NaN/inf/OOB inside jit).
+
+``checked(fn)`` wraps a jittable function so every NaN/inf/div-by-zero
+and out-of-bounds index inside it raises with a location, instead of
+silently propagating through the compiled program. Debug builds only —
+the checks block fusion and cost real throughput.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax.experimental import checkify
+
+
+def checked(fn: Callable, *, jit: bool = True) -> Callable:
+    """Returns ``fn`` instrumented with float + index + div checks; the
+    wrapper raises ``checkify.JaxRuntimeError`` on the first violation."""
+    err_fn = checkify.checkify(fn, errors=checkify.all_checks)
+    if jit:
+        err_fn = jax.jit(err_fn)
+
+    def wrapper(*args, **kwargs):
+        err, out = err_fn(*args, **kwargs)
+        checkify.check_error(err)
+        return out
+
+    return wrapper

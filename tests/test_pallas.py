@@ -1,0 +1,200 @@
+"""Fused pallas attention kernel vs the einsum formulation.
+
+Runs in pallas interpreter mode on the CPU test platform (the kernel
+auto-selects interpret off-TPU); the same code path compiles on TPU.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gnot_tpu.config import ModelConfig
+from gnot_tpu.data import datasets
+from gnot_tpu.data.batch import Loader
+from gnot_tpu.models.gnot import GNOT
+from gnot_tpu.ops.pallas_attention import _reference_impl, fused_nla
+
+
+def _rand(key, *shape):
+    return jax.random.normal(key, shape, jnp.float32)
+
+
+@pytest.mark.parametrize(
+    "n_funcs,masked,l,lk",
+    [
+        (1, False, 24, 16),
+        (2, True, 24, 16),
+        (3, True, 40, 24),
+        (1, True, 300, 280),  # > TILE after padding checks the seq tiling
+    ],
+)
+def test_fused_matches_einsum_cross(n_funcs, masked, l, lk):
+    b, h, e = 2, 4, 32
+    keys = jax.random.split(jax.random.key(0), 4)
+    q = _rand(keys[0], b, l, e)
+    k = _rand(keys[1], n_funcs, b, lk, e)
+    v = _rand(keys[2], n_funcs, b, lk, e)
+    if masked:
+        mask = (
+            jax.random.uniform(keys[3], (n_funcs, b, lk)) > 0.3
+        ).astype(jnp.float32)
+        mask = mask.at[:, :, 0].set(1.0)  # at least one real row
+    else:
+        mask = jnp.ones((n_funcs, b, lk), jnp.float32)
+
+    out, qs = fused_nla(q, k, v, mask, h)
+    out_ref, qs_ref = _reference_impl(q, k, v, mask, h)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_ref), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(qs), np.asarray(qs_ref), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_grads_match_einsum():
+    b, h, l, lk, e = 2, 2, 12, 10, 16
+    keys = jax.random.split(jax.random.key(1), 4)
+    q = _rand(keys[0], b, l, e)
+    k = _rand(keys[1], 1, b, lk, e)
+    v = _rand(keys[2], 1, b, lk, e)
+    mask = (jax.random.uniform(keys[3], (1, b, lk)) > 0.3).astype(jnp.float32)
+    mask = mask.at[:, :, 0].set(1.0)
+
+    def loss_fused(q, k, v):
+        out, qs = fused_nla(q, k, v, mask, h)
+        return jnp.sum(out**2) + jnp.sum(qs * 0.5)
+
+    def loss_ref(q, k, v):
+        out, qs = _reference_impl(q, k, v, mask, h)
+        return jnp.sum(out**2) + jnp.sum(qs * 0.5)
+
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_fused, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-5, atol=1e-6)
+
+
+def test_reference_impl_matches_xla_ops():
+    """The merged-layout einsum oracle == the split-head XLA ops path."""
+    from gnot_tpu.ops.attention import (
+        feature_softmax,
+        merge_heads,
+        normalized_linear_attention,
+        split_heads,
+    )
+
+    b, h, l, lk, e = 2, 4, 12, 10, 32
+    keys = jax.random.split(jax.random.key(2), 4)
+    q = _rand(keys[0], b, l, e)
+    k = _rand(keys[1], 1, b, lk, e)
+    v = _rand(keys[2], 1, b, lk, e)
+    mask = (jax.random.uniform(keys[3], (1, b, lk)) > 0.3).astype(jnp.float32)
+    mask = mask.at[:, :, 0].set(1.0)
+
+    out_m, qs_m = _reference_impl(q, k, v, mask, h)
+    qh = feature_softmax(split_heads(q, h))
+    kh = feature_softmax(split_heads(k[0], h))
+    vh = split_heads(v[0], h)
+    out_h = normalized_linear_attention(qh, kh, vh, kv_mask=mask[0])
+    np.testing.assert_allclose(
+        np.asarray(out_m[0]), np.asarray(merge_heads(out_h)), rtol=1e-5, atol=1e-6
+    )
+    np.testing.assert_allclose(
+        np.asarray(qs_m), np.asarray(merge_heads(qh)), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_model_forward_pallas_matches_xla():
+    """Full GNOT forward: pallas attention == xla attention."""
+    mc = ModelConfig(
+        input_dim=2,
+        theta_dim=2,
+        input_func_dim=3,
+        out_dim=2,
+        n_input_functions=1,
+        n_attn_layers=2,
+        n_attn_hidden_dim=32,
+        n_mlp_num_layers=2,
+        n_mlp_hidden_dim=32,
+        n_input_hidden_dim=32,
+        n_expert=2,
+        n_head=4,
+    )
+    samples = datasets.synth_elasticity(4, base_points=40)  # ragged -> real masks
+    batch = next(iter(Loader(samples, 4)))
+
+    model_xla = GNOT(mc)
+    params = model_xla.init(
+        jax.random.key(0),
+        batch.coords,
+        batch.theta,
+        batch.funcs,
+        node_mask=batch.node_mask,
+        func_mask=batch.func_mask,
+    )["params"]
+    model_pallas = GNOT(dataclasses.replace(mc, attention_impl="pallas"))
+
+    args = (batch.coords, batch.theta, batch.funcs)
+    kw = dict(node_mask=batch.node_mask, func_mask=batch.func_mask)
+    out_xla = model_xla.apply({"params": params}, *args, **kw)
+    out_pallas = model_pallas.apply({"params": params}, *args, **kw)
+    np.testing.assert_allclose(
+        np.asarray(out_pallas), np.asarray(out_xla), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_pallas_rejects_parity():
+    mc = ModelConfig(
+        input_dim=2,
+        theta_dim=1,
+        input_func_dim=3,
+        out_dim=1,
+        n_input_functions=1,
+        n_attn_layers=1,
+        n_attn_hidden_dim=16,
+        n_mlp_num_layers=1,
+        n_mlp_hidden_dim=16,
+        n_input_hidden_dim=16,
+        n_expert=2,
+        n_head=2,
+        attention_mode="parity",
+        attention_impl="pallas",
+    )
+    samples = datasets.synth_ns2d(2, n_points=16)
+    batch = next(iter(Loader(samples, 2, bucket=False)))
+    model = GNOT(mc)
+    with pytest.raises(ValueError, match="parity"):
+        model.init(
+            jax.random.key(0), batch.coords, batch.theta, batch.funcs
+        )
+
+
+def test_sharded_step_rejects_pallas():
+    from gnot_tpu.config import MeshConfig, OptimConfig
+    from gnot_tpu.parallel import mesh as mesh_lib
+    from gnot_tpu.train.trainer import init_state
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple devices")
+    mc = ModelConfig(
+        input_dim=2,
+        theta_dim=1,
+        input_func_dim=3,
+        out_dim=1,
+        n_input_functions=1,
+        n_attn_layers=1,
+        n_attn_hidden_dim=16,
+        n_mlp_num_layers=1,
+        n_mlp_hidden_dim=16,
+        n_input_hidden_dim=16,
+        n_expert=2,
+        n_head=2,
+        attention_impl="pallas",
+    )
+    samples = datasets.synth_ns2d(2, n_points=16)
+    batch = next(iter(Loader(samples, 2)))
+    model = GNOT(mc)
+    state = init_state(model, OptimConfig(), batch, seed=0)
+    mesh = mesh_lib.make_mesh(MeshConfig(data=2, seq=1, model=1), jax.devices()[:2])
+    with pytest.raises(ValueError, match="pallas"):
+        mesh_lib.make_sharded_train_step(model, OptimConfig(), "rel_l2", mesh, state)

@@ -1,0 +1,59 @@
+"""Aux subsystems: checkify guards, profiler hooks, eval-only path."""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gnot_tpu.utils import profiling
+from gnot_tpu.utils.debug import checked
+
+
+def test_checked_passes_clean_fn():
+    fn = checked(lambda x: jnp.sqrt(x) + 1.0)
+    np.testing.assert_allclose(np.asarray(fn(jnp.asarray(4.0))), 3.0)
+
+
+def test_checked_catches_nan():
+    from jax.experimental import checkify
+
+    fn = checked(lambda x: jnp.log(x))  # log(-1) -> nan
+    with pytest.raises(checkify.JaxRuntimeError):
+        fn(jnp.asarray(-1.0))
+
+
+def test_trace_epoch_writes_profile(tmp_path):
+    d = str(tmp_path / "prof")
+    with profiling.trace_epoch(d, epoch=1):
+        with profiling.annotate("span"):
+            jnp.ones((8, 8)).sum().block_until_ready()
+    assert os.path.isdir(d) and os.listdir(d)
+
+
+def test_trace_epoch_noop_for_other_epochs(tmp_path):
+    d = str(tmp_path / "prof2")
+    with profiling.trace_epoch(d, epoch=0):
+        pass
+    assert not os.path.exists(d)
+    with profiling.trace_epoch("", epoch=1):
+        pass
+
+
+def test_eval_only_roundtrip(tmp_path):
+    """Train 2 epochs with checkpointing, then eval-only from the best
+    checkpoint reproduces the best metric."""
+    from gnot_tpu import main as cli
+
+    args = [
+        "--synthetic", "darcy2d",
+        "--n_train", "8", "--n_test", "4",
+        "--epochs", "2",
+        "--n_attn_layers", "1", "--n_attn_hidden_dim", "16",
+        "--n_mlp_num_layers", "1", "--n_mlp_hidden_dim", "16",
+        "--n_input_hidden_dim", "16", "--n_expert", "2", "--n_head", "2",
+        "--checkpoint_dir", str(tmp_path / "ckpt"),
+    ]
+    best = cli.main(args)
+    res = cli.main(args + ["--eval_only"])
+    np.testing.assert_allclose(res, best, rtol=1e-6)
