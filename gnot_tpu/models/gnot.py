@@ -47,6 +47,7 @@ class HNABlock(nn.Module):
     dtype: Any = None
     parity: bool = False
     attention_impl: str = "xla"
+    mesh: Any = None
 
     @nn.compact
     def __call__(
@@ -65,6 +66,7 @@ class HNABlock(nn.Module):
             dtype=self.dtype,
             parity=self.parity,
             attention_impl=self.attention_impl,
+            mesh=self.mesh,
             name="cross_attention",
         )(query, input_functions, query_mask=node_mask, func_mask=func_mask)
         ffn1 = GatedExpertFfn(
@@ -84,6 +86,7 @@ class HNABlock(nn.Module):
             dtype=self.dtype,
             parity=self.parity,
             attention_impl=self.attention_impl,
+            mesh=self.mesh,
             name="self_attention",
         )(query, query_mask=node_mask)
         ffn2 = GatedExpertFfn(
@@ -98,9 +101,16 @@ class HNABlock(nn.Module):
 
 
 class GNOT(nn.Module):
-    """Full GNOT model (reference model.py:142-172)."""
+    """Full GNOT model (reference model.py:142-172).
+
+    ``mesh``: optional device mesh for attention_impl='pallas' on
+    multi-device runs — attention dispatches through shard_map (DP/SP/TP;
+    see ops/pallas_attention.fused_nla_sp). Requires batch % data,
+    sequence lengths % seq, and n_head % model divisibility.
+    """
 
     config: ModelConfig
+    mesh: Any = None
 
     @nn.compact
     def __call__(
@@ -173,6 +183,7 @@ class GNOT(nn.Module):
                 dtype=dtype,
                 parity=cfg.attention_mode == "parity",
                 attention_impl=cfg.attention_impl,
+                mesh=self.mesh,
                 name=f"block_{i}",
             )(scores, query, funcs, node_mask=node_mask, func_mask=func_mask)
 

@@ -22,7 +22,7 @@ from gnot_tpu.ops.attention import (
     normalized_linear_attention,
     split_heads,
 )
-from gnot_tpu.ops.pallas_attention import fused_nla
+from gnot_tpu.ops.pallas_attention import fused_nla, fused_nla_sp
 
 Array = jax.Array
 
@@ -106,6 +106,25 @@ def _stacked_dense(features: int, fan_in: int, *, name: str, dtype=None):
     )
 
 
+def _dispatch_fused_nla(q, k, v, mask, n_head, mesh):
+    """Route to the single-device kernel or the shard_map'd distributed
+    form, mapping the standard mesh axis names (parallel/mesh.py AXES)."""
+    if mesh is None:
+        return fused_nla(q, k, v, mask, n_head)
+    axes = mesh.axis_names
+    return fused_nla_sp(
+        q,
+        k,
+        v,
+        mask,
+        n_head,
+        mesh,
+        data_axis="data" if "data" in axes else None,
+        seq_axis="seq" if "seq" in axes else None,
+        model_axis="model" if "model" in axes else None,
+    )
+
+
 class LinearAttention(nn.Module):
     """Heterogeneous normalized linear attention (model.py:33-107).
 
@@ -135,6 +154,10 @@ class LinearAttention(nn.Module):
     # "xla": einsum formulation; "pallas": fused VMEM kernel
     # (ops/pallas_attention.py). Numerically equivalent.
     attention_impl: str = "xla"
+    # Device mesh for the pallas impl on multi-device runs: attention is
+    # dispatched through shard_map (DP over "data", SP psum over "seq",
+    # head-group TP over "model"). None = single-device pallas_call.
+    mesh: Any = None
 
     def _merge(self, x: Array) -> Array:
         if self.parity:
@@ -177,7 +200,9 @@ class LinearAttention(nn.Module):
                 mask = func_mask
                 if mask is None:
                     mask = jnp.ones(k_proj.shape[:3], k_proj.dtype)
-                out_f, res_q = fused_nla(q_proj, k_proj, v_proj, mask, h)
+                out_f, res_q = _dispatch_fused_nla(
+                    q_proj, k_proj, v_proj, mask, h, self.mesh
+                )
                 res = res_q + jnp.mean(out_f, axis=0)
             else:
                 q = feature_softmax(split_heads(q_proj, h))
@@ -199,8 +224,8 @@ class LinearAttention(nn.Module):
                 mask = query_mask
                 if mask is None:
                     mask = jnp.ones(k_proj.shape[:2], k_proj.dtype)
-                out_f, res_q = fused_nla(
-                    q_proj, k_proj[None], v_proj[None], mask[None], h
+                out_f, res_q = _dispatch_fused_nla(
+                    q_proj, k_proj[None], v_proj[None], mask[None], h, self.mesh
                 )
                 res = res_q + out_f[0]
             else:

@@ -1,4 +1,4 @@
-"""Pallas TPU kernel: fused normalized linear attention.
+"""Pallas TPU kernels: fused normalized linear attention.
 
 The XLA path (``gnot_tpu.ops.attention``) splits heads into a
 ``[B, H, L, D]`` layout (D = 32 at reference defaults) and materializes
@@ -7,8 +7,8 @@ normalizer between fused regions. On TPU that layout is hostile: D=32
 in the lane axis wastes 3/4 of every 128-lane tile (VMEM and VPU), and
 the transposes for split/merge are extra HBM passes.
 
-This kernel keeps the **merged-head layout** ``[L, E]`` (E = H*D, 256 at
-defaults) end-to-end and expresses every per-head operation as a
+These kernels keep the **merged-head layout** ``[L, E]`` (E = H*D, 256
+at defaults) end-to-end and express every per-head operation as a
 lane-group operation:
 
 * per-head feature softmax == softmax within each D-lane group. A
@@ -17,33 +17,39 @@ lane-group operation:
   matmul with a block-diagonal ones matrix — an MXU op, not a lane
   shuffle;
 * per-head ``k^T v`` == the block-diagonal part of the full ``[E, E]``
-  contraction. We compute the full Gram matrix (perfectly MXU-shaped)
-  and mask off the cross-head blocks;
+  contraction. We accumulate the full Gram matrix (perfectly
+  MXU-shaped) and mask off the cross-head blocks at apply time;
 * the ``1/<q, k_sum>`` normalizer per head broadcasts to its lane group
   through the same block-diagonal matmul.
 
-Two kernels pipeline over sequence tiles so VMEM stays bounded at any
-length (Heatsink3d-scale point clouds included):
+The op is split into two composable stages, each a pallas kernel with a
+``custom_vjp`` (backward recomputes in einsum form — the standard TPU
+rematerialization trade of FLOPs for HBM):
 
-1. ``_reduce_kernel`` — grid ``(B, F, Lk/TILE)``: accumulates the masked
+1. ``nla_reduce`` — grid ``(B, F, Lk/TILE)``: accumulates the masked
    ``k^T v`` Gram matrix ``[E, E]`` and ``k_sum [1, E]`` per (batch,
    input-function) into revisited output blocks.
-2. ``_apply_kernel`` — grid ``(B, L/TILE, F)``: softmaxes the query tile
+2. ``nla_apply`` — grid ``(B, L/TILE, F)``: softmaxes the query tile
    (the tile's HBM fetch is shared across the F innermost steps; the
    cheap softmax itself is recomputed per F), applies the Gram matrix
    and normalizer, and emits both the attention output and softmax(q) —
    GNOT's residual adds the *softmaxed* query (reference
    ``/root/reference/model.py:86,104``), so downstream needs it.
 
+``fused_nla`` composes them on one device. ``fused_nla_sp`` is the
+long-context / sequence-parallel form: because linear attention's
+sequence reduction is a sum, SP needs exactly ONE ``psum`` of the
+``[E, E]`` Gram accumulators over the sequence mesh axis — a fixed-size
+collective independent of sequence length, strictly cheaper than ring
+attention's O(steps) rotation of K/V blocks (SURVEY.md §5 long-context
+note). Autodiff flows through ``shard_map`` + ``psum`` and the
+per-stage VJPs compose correctly.
+
 Semantics match ``feature_softmax`` + ``normalized_linear_attention``
 composed over heads (reference ``/root/reference/model.py:53-107``);
 outputs come back head-merged exactly as ``merge_heads`` would produce
 (the non-parity merge — parity mode's interleaved merge stays on the
 XLA path).
-
-The backward pass recomputes the forward in einsum form and
-differentiates that (rematerialization — the standard TPU trade of
-FLOPs for HBM bandwidth).
 """
 
 from __future__ import annotations
@@ -53,16 +59,18 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.sharding import PartitionSpec as P
 
 Array = jax.Array
 
-TILE = 256  # sequence tile: M dim of every matmul, multiple of all buckets
+TILE = 256  # preferred sequence tile (matmul M dim); _seq_pad may drop
+# to 128 so the 1.5x buckets (384, 768, 1536, ...) don't re-pad by 33%.
 
 
 def _interpret_default() -> bool:
     """Compiled on TPU; interpreter on CPU (tests). Other backends must
-    opt in explicitly — silently emulating on, say, GPU would be an
-    orders-of-magnitude perf trap."""
+    not silently fall into interpret mode — an orders-of-magnitude perf
+    trap."""
     backend = jax.default_backend()
     if backend == "tpu":
         return False
@@ -99,6 +107,28 @@ def _group_softmax(x: Array, n_head: int) -> Array:
     return ex / gsum
 
 
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def _seq_pad(n: int) -> tuple[int, int]:
+    """(padded_length, tile): tile the sequence dim, sublane-aligned.
+
+    Prefers TILE; falls back to TILE/2 when that avoids re-padding
+    (Loader buckets include 1.5x-of-power-of-two lengths like 384)."""
+    if n >= TILE:
+        lp = _round_up(n, TILE // 2)
+        tile = TILE if lp % TILE == 0 else TILE // 2
+        return lp, tile
+    t = _round_up(n, 8)
+    return t, t
+
+
+# --------------------------------------------------------------------------
+# Stage 1: reduce — masked group-softmax(k)^T v Gram + k_sum accumulation.
+# --------------------------------------------------------------------------
+
+
 def _reduce_kernel(k_ref, v_ref, m_ref, kv_ref, ksum_ref, *, n_head):
     lk_i = pl.program_id(2)
 
@@ -115,6 +145,80 @@ def _reduce_kernel(k_ref, v_ref, m_ref, kv_ref, ksum_ref, *, n_head):
         ks, v, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
     ksum_ref[0, 0] += jnp.sum(ks, axis=0, keepdims=True)
+
+
+def _reduce_call(k, v, mask, n_head: int, interpret: bool):
+    f, b, lk, e = k.shape
+    lkp, tlk = _seq_pad(lk)
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, lkp - lk), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, lkp - lk), (0, 0)))
+    # Padded key rows get mask 0, so they vanish from the reductions.
+    mp = jnp.pad(mask, ((0, 0), (0, 0), (0, lkp - lk)))[..., None]  # [F,B,Lkp,1]
+
+    return pl.pallas_call(
+        functools.partial(_reduce_kernel, n_head=n_head),
+        grid=(b, f, lkp // tlk),
+        in_specs=[
+            pl.BlockSpec((1, 1, tlk, e), lambda bi, fi, li: (fi, bi, li, 0)),
+            pl.BlockSpec((1, 1, tlk, e), lambda bi, fi, li: (fi, bi, li, 0)),
+            pl.BlockSpec((1, 1, tlk, 1), lambda bi, fi, li: (fi, bi, li, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, e, e), lambda bi, fi, li: (fi, bi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, e), lambda bi, fi, li: (fi, bi, 0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((f, b, e, e), jnp.float32),
+            jax.ShapeDtypeStruct((f, b, 1, e), jnp.float32),
+        ),
+        interpret=interpret,
+    )(kp, vp, mp)
+
+
+def _reduce_ref(k, v, mask, n_head: int):
+    """Einsum form of the reduce stage (backward source + test oracle)."""
+
+    def gsm(x):
+        shaped = x.reshape(*x.shape[:-1], n_head, x.shape[-1] // n_head)
+        sm = jax.nn.softmax(shaped.astype(jnp.float32), axis=-1)
+        return sm.reshape(x.shape)
+
+    ks = gsm(k) * mask[..., None]  # [F, B, Lk, E]
+    kv = jnp.einsum("fbld,fble->fbde", ks, v.astype(jnp.float32))
+    ksum = jnp.sum(ks, axis=2, keepdims=True)  # [F, B, 1, E]
+    return kv, ksum
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def nla_reduce(k: Array, v: Array, mask: Array, n_head: int, interpret: bool | None = None):
+    """Masked Gram accumulation: ``(kv [F,B,E,E], k_sum [F,B,1,E])`` in f32.
+
+    Sequence-parallel note: ``kv``/``k_sum`` are plain sums over Lk, so
+    partial results from sequence shards combine with one ``psum``.
+    """
+    interpret = _interpret_default() if interpret is None else interpret
+    return _reduce_call(k, v, mask, n_head, interpret)
+
+
+def _nla_reduce_fwd(k, v, mask, n_head, interpret):
+    interpret = _interpret_default() if interpret is None else interpret
+    return _reduce_call(k, v, mask, n_head, interpret), (k, v, mask)
+
+
+def _nla_reduce_bwd(n_head, interpret, residuals, cotangents):
+    del interpret
+    k, v, mask = residuals
+    _, vjp = jax.vjp(lambda k_, v_: _reduce_ref(k_, v_, mask, n_head), k, v)
+    dk, dv = vjp(cotangents)
+    return dk, dv, jnp.zeros_like(mask)
+
+
+nla_reduce.defvjp(_nla_reduce_fwd, _nla_reduce_bwd)
+
+
+# --------------------------------------------------------------------------
+# Stage 2: apply — softmax(q), normalizer, Gram application.
+# --------------------------------------------------------------------------
 
 
 def _apply_kernel(q_ref, kv_ref, ksum_ref, out_ref, qs_ref, *, n_head):
@@ -134,55 +238,15 @@ def _apply_kernel(q_ref, kv_ref, ksum_ref, out_ref, qs_ref, *, n_head):
     denom = jax.lax.dot_general(
         qs * ksum, bd, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
-    out = (
-        jnp.dot(qs, kv, preferred_element_type=jnp.float32) / denom
-    )
+    out = jnp.dot(qs, kv, preferred_element_type=jnp.float32) / denom
     out_ref[0, 0] = out.astype(out_ref.dtype)
 
 
-def _round_up(n: int, m: int) -> int:
-    return (n + m - 1) // m * m
-
-
-def _seq_pad(n: int) -> tuple[int, int]:
-    """(padded_length, tile): tile the sequence dim, sublane-aligned."""
-    if n >= TILE:
-        return _round_up(n, TILE), TILE
-    t = _round_up(n, 8)
-    return t, t
-
-
-def _fused_nla_call(q, k, v, mask, n_head: int, interpret: bool):
+def _apply_call(q, kv, ksum, n_head: int, interpret: bool):
     b, l, e = q.shape
-    f, _, lk, _ = k.shape
+    f = kv.shape[0]
     lp, tl = _seq_pad(l)
-    lkp, tlk = _seq_pad(lk)
-
-    # Pad sequence dims to tile multiples. Padded key rows get mask 0, so
-    # they vanish from the reductions; padded query rows are sliced off.
     qp = jnp.pad(q, ((0, 0), (0, lp - l), (0, 0)))
-    kp = jnp.pad(k, ((0, 0), (0, 0), (0, lkp - lk), (0, 0)))
-    vp = jnp.pad(v, ((0, 0), (0, 0), (0, lkp - lk), (0, 0)))
-    mp = jnp.pad(mask, ((0, 0), (0, 0), (0, lkp - lk)))[..., None]  # [F,B,Lkp,1]
-
-    kv, ksum = pl.pallas_call(
-        functools.partial(_reduce_kernel, n_head=n_head),
-        grid=(b, f, lkp // tlk),
-        in_specs=[
-            pl.BlockSpec((1, 1, tlk, e), lambda bi, fi, li: (fi, bi, li, 0)),
-            pl.BlockSpec((1, 1, tlk, e), lambda bi, fi, li: (fi, bi, li, 0)),
-            pl.BlockSpec((1, 1, tlk, 1), lambda bi, fi, li: (fi, bi, li, 0)),
-        ],
-        out_specs=(
-            pl.BlockSpec((1, 1, e, e), lambda bi, fi, li: (fi, bi, 0, 0)),
-            pl.BlockSpec((1, 1, 1, e), lambda bi, fi, li: (fi, bi, 0, 0)),
-        ),
-        out_shape=(
-            jax.ShapeDtypeStruct((f, b, e, e), jnp.float32),
-            jax.ShapeDtypeStruct((f, b, 1, e), jnp.float32),
-        ),
-        interpret=interpret,
-    )(kp, vp, mp)
 
     out, qs = pl.pallas_call(
         functools.partial(_apply_kernel, n_head=n_head),
@@ -202,30 +266,54 @@ def _fused_nla_call(q, k, v, mask, n_head: int, interpret: bool):
         ),
         interpret=interpret,
     )(qp, kv, ksum)
-
     return out[:, :, :l], qs[:, :l]
 
 
-def _reference_impl(q, k, v, mask, n_head: int):
-    """Einsum formulation in the merged-head layout with the kernel's f32
-    semantics — backward-pass source and test oracle."""
-
-    def gsm(x):
-        shaped = x.reshape(*x.shape[:-1], n_head, x.shape[-1] // n_head)
-        return jax.nn.softmax(shaped.astype(jnp.float32), axis=-1)
-
-    qs = gsm(q)  # [B, L, H, D]
-    ks = gsm(k) * mask[..., None, None]  # [F, B, Lk, H, D]
-    vh = v.reshape(*v.shape[:-1], n_head, v.shape[-1] // n_head).astype(jnp.float32)
-    k_sum = jnp.sum(ks, axis=2)  # [F, B, H, D]
-    denom = jnp.einsum("blhd,fbhd->fblh", qs, k_sum)
-    kv = jnp.einsum("fblhd,fblhe->fbhde", ks, vh)
-    out = jnp.einsum("blhd,fbhde->fblhe", qs, kv) / denom[..., None]
-    out = out.reshape(*out.shape[:-2], -1)  # merge heads: [F, B, L, E]
-    return out.astype(q.dtype), qs.reshape(*q.shape).astype(q.dtype)
+def _apply_ref(q, kv, ksum, n_head: int):
+    """Einsum form of the apply stage (backward source + test oracle)."""
+    e = q.shape[-1]
+    shaped = q.reshape(*q.shape[:-1], n_head, e // n_head)
+    qs = jax.nn.softmax(shaped.astype(jnp.float32), axis=-1).reshape(q.shape)
+    bd = _block_diag_mask(e, e // n_head)
+    kvm = kv * bd
+    # Per-head <q, k_sum>, broadcast to the head's lanes via bd.
+    denom = jnp.einsum("fble,ed->fbld", qs[None] * ksum, bd)
+    out = jnp.einsum("bld,fbde->fble", qs, kvm) / denom
+    return out.astype(q.dtype), qs.astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def nla_apply(q: Array, kv: Array, ksum: Array, n_head: int, interpret: bool | None = None):
+    """Apply the (psum-combined) Gram accumulators to the query stream.
+
+    Returns ``(out [F,B,L,E], q_softmaxed [B,L,E])``, head-merged.
+    """
+    interpret = _interpret_default() if interpret is None else interpret
+    return _apply_call(q, kv, ksum, n_head, interpret)
+
+
+def _nla_apply_fwd(q, kv, ksum, n_head, interpret):
+    interpret = _interpret_default() if interpret is None else interpret
+    return _apply_call(q, kv, ksum, n_head, interpret), (q, kv, ksum)
+
+
+def _nla_apply_bwd(n_head, interpret, residuals, cotangents):
+    del interpret
+    q, kv, ksum = residuals
+    _, vjp = jax.vjp(
+        lambda q_, kv_, ks_: _apply_ref(q_, kv_, ks_, n_head), q, kv, ksum
+    )
+    return vjp(cotangents)
+
+
+nla_apply.defvjp(_nla_apply_fwd, _nla_apply_bwd)
+
+
+# --------------------------------------------------------------------------
+# Composed forms.
+# --------------------------------------------------------------------------
+
+
 def fused_nla(
     q: Array,
     k: Array,
@@ -249,23 +337,76 @@ def fused_nla(
     Returns:
       ``(out [F, B, L, E], q_softmaxed [B, L, E])``, both head-merged.
     """
-    interpret = _interpret_default() if interpret is None else interpret
-    return _fused_nla_call(q, k, v, mask, n_head, interpret)
+    kv, ksum = nla_reduce(k, v, mask, n_head, interpret)
+    return nla_apply(q, kv, ksum, n_head, interpret)
 
 
-def _fused_nla_fwd(q, k, v, mask, n_head, interpret):
-    interpret = _interpret_default() if interpret is None else interpret
-    return _fused_nla_call(q, k, v, mask, n_head, interpret), (q, k, v, mask)
+def fused_nla_sp(
+    q: Array,
+    k: Array,
+    v: Array,
+    mask: Array,
+    n_head: int,
+    mesh,
+    *,
+    data_axis: str | None = None,
+    seq_axis: str | None = "seq",
+    model_axis: str | None = None,
+    interpret: bool | None = None,
+):
+    """Distributed fused attention over a DP x SP x TP device mesh.
+
+    Per-axis layout (any subset of the axes may be None/size-1):
+
+    * ``data_axis`` — batch dim B sharded; no communication.
+    * ``seq_axis`` — L and Lk sharded. Each device reduces its local
+      Gram accumulators; one ``psum`` (fixed ``[F, B, E, E]`` payload,
+      independent of sequence length) combines them — strictly cheaper
+      than ring attention's O(steps) K/V rotation for this op.
+    * ``model_axis`` — the embed dim E sharded by WHOLE head groups
+      (requires ``n_head %% model_size == 0``). Heads never mix in
+      normalized linear attention (the Gram matrix is head-block
+      diagonal), so each shard runs the kernel on its local heads with
+      no communication at all.
+
+    Differentiable end-to-end (psum transposes to psum through the
+    per-stage custom VJPs).
+    """
+    from jax import shard_map
+
+    model_size = mesh.shape[model_axis] if model_axis else 1
+    if n_head % model_size:
+        raise ValueError(
+            f"n_head={n_head} must be divisible by the model axis size "
+            f"{model_size} (TP shards whole head groups)"
+        )
+    local_heads = n_head // model_size
+
+    def local_fn(q_l, k_l, v_l, m_l):
+        kv_l, ksum_l = nla_reduce(k_l, v_l, m_l, local_heads, interpret)
+        if seq_axis:
+            kv_l = jax.lax.psum(kv_l, seq_axis)
+            ksum_l = jax.lax.psum(ksum_l, seq_axis)
+        return nla_apply(q_l, kv_l, ksum_l, local_heads, interpret)
+
+    return shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(
+            P(data_axis, seq_axis, model_axis),
+            P(None, data_axis, seq_axis, model_axis),
+            P(None, data_axis, seq_axis, model_axis),
+            P(None, data_axis, seq_axis),
+        ),
+        out_specs=(
+            P(None, data_axis, seq_axis, model_axis),
+            P(data_axis, seq_axis, model_axis),
+        ),
+        check_vma=False,  # pallas_call outputs don't declare varying-axes
+    )(q, k, v, mask)
 
 
-def _fused_nla_bwd(n_head, interpret, residuals, cotangents):
-    del interpret
-    q, k, v, mask = residuals
-    _, vjp = jax.vjp(
-        lambda q_, k_, v_: _reference_impl(q_, k_, v_, mask, n_head), q, k, v
-    )
-    dq, dk, dv = vjp(cotangents)
-    return dq, dk, dv, jnp.zeros_like(mask)
-
-
-fused_nla.defvjp(_fused_nla_fwd, _fused_nla_bwd)
+def _reference_impl(q, k, v, mask, n_head: int):
+    """Full einsum oracle in the merged-head layout (tests)."""
+    kv, ksum = _reduce_ref(k, v, mask, n_head)
+    return _apply_ref(q, kv, ksum, n_head)

@@ -160,10 +160,16 @@ def make_sharded_train_step(model, optim_cfg, loss_name: str, mesh: Mesh, state)
     from gnot_tpu.train.trainer import TrainState, batch_loss, make_optimizer
 
     if getattr(model.config, "attention_impl", "xla") == "pallas":
-        raise ValueError(
-            "attention_impl='pallas' is single-device/DP only: pallas_call "
-            "is not GSPMD-partitionable; use attention_impl='xla' on a mesh"
-        )
+        # pallas_call is not GSPMD-partitionable, but the model can run
+        # it distributed through shard_map when built with this mesh
+        # (GNOT(cfg, mesh=mesh) -> ops/pallas_attention.fused_nla_sp).
+        if getattr(model, "mesh", None) is not mesh:
+            raise ValueError(
+                "attention_impl='pallas' on a mesh requires the model to "
+                "be constructed with that mesh (GNOT(cfg, mesh=mesh)) so "
+                "attention dispatches through shard_map; or use "
+                "attention_impl='xla'"
+            )
 
     def step(state: TrainState, batch: MeshBatch, lr):
         loss, grads = jax.value_and_grad(

@@ -143,6 +143,44 @@ def test_model_forward_pallas_matches_xla():
     )
 
 
+def test_fused_nla_sp_matches_single_device():
+    """Sequence-parallel fused attention (reduce -> psum -> apply) over
+    an 8-way seq mesh == the single-device op, forward and backward."""
+    from jax.sharding import Mesh
+
+    from gnot_tpu.ops.pallas_attention import fused_nla_sp
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("seq",))
+
+    b, h, l, lk, e, f = 2, 4, 64, 32, 32, 2
+    keys = jax.random.split(jax.random.key(3), 4)
+    q = _rand(keys[0], b, l, e)
+    k = _rand(keys[1], f, b, lk, e)
+    v = _rand(keys[2], f, b, lk, e)
+    mask = (jax.random.uniform(keys[3], (f, b, lk)) > 0.3).astype(jnp.float32)
+    mask = mask.at[:, :, 0].set(1.0)
+
+    out_sp, qs_sp = fused_nla_sp(q, k, v, mask, h, mesh)
+    out_1, qs_1 = fused_nla(q, k, v, mask, h)
+    np.testing.assert_allclose(np.asarray(out_sp), np.asarray(out_1), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(qs_sp), np.asarray(qs_1), rtol=1e-5, atol=1e-6)
+
+    def loss_sp(q, k, v):
+        out, qs = fused_nla_sp(q, k, v, mask, h, mesh)
+        return jnp.sum(out**2) + jnp.sum(qs * 0.5)
+
+    def loss_1(q, k, v):
+        out, qs = fused_nla(q, k, v, mask, h)
+        return jnp.sum(out**2) + jnp.sum(qs * 0.5)
+
+    g_sp = jax.grad(loss_sp, argnums=(0, 1, 2))(q, k, v)
+    g_1 = jax.grad(loss_1, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_sp, g_1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-5, atol=1e-6)
+
+
 def test_pallas_rejects_parity():
     mc = ModelConfig(
         input_dim=2,
@@ -169,32 +207,68 @@ def test_pallas_rejects_parity():
         )
 
 
-def test_sharded_step_rejects_pallas():
+SMALL_PALLAS = ModelConfig(
+    input_dim=2,
+    theta_dim=1,
+    input_func_dim=3,
+    out_dim=1,
+    n_input_functions=1,
+    n_attn_layers=2,
+    n_attn_hidden_dim=32,
+    n_mlp_num_layers=2,
+    n_mlp_hidden_dim=32,
+    n_input_hidden_dim=32,
+    n_expert=3,
+    n_head=4,
+    attention_impl="pallas",
+)
+
+
+def test_sharded_train_step_with_pallas_matches_single_device():
+    """Full sharded train step on a DP x SP x TP mesh with the pallas
+    attention dispatched through shard_map == single-device xla step."""
+    from gnot_tpu.config import MeshConfig, OptimConfig
+    from gnot_tpu.parallel import mesh as mesh_lib
+    from gnot_tpu.train.trainer import init_state, make_train_step
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    optim = OptimConfig()
+    samples = datasets.synth_ns2d(8, n_points=64)
+    batch = next(iter(Loader(samples, 8)))
+
+    ref_model = GNOT(dataclasses.replace(SMALL_PALLAS, attention_impl="xla"))
+    state = init_state(ref_model, optim, batch, seed=0)
+    single = make_train_step(ref_model, optim, "rel_l2")
+    state1, loss1 = single(
+        jax.tree.map(jnp.copy, state), batch, jnp.asarray(1e-3, jnp.float32)
+    )
+
+    mesh = mesh_lib.make_mesh(MeshConfig(data=2, seq=2, model=2))
+    model = GNOT(SMALL_PALLAS, mesh=mesh)
+    sharded_state = mesh_lib.shard_state(mesh, state)
+    step = mesh_lib.make_sharded_train_step(model, optim, "rel_l2", mesh, sharded_state)
+    sharded_batch = mesh_lib.shard_batch(mesh, batch)
+    state2, loss2 = step(sharded_state, sharded_batch, jnp.asarray(1e-3, jnp.float32))
+
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(state1.params), jax.tree.leaves(state2.params)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(jax.device_get(b)), rtol=2e-4, atol=2e-5
+        )
+
+
+def test_sharded_step_pallas_requires_mesh_on_model():
     from gnot_tpu.config import MeshConfig, OptimConfig
     from gnot_tpu.parallel import mesh as mesh_lib
     from gnot_tpu.train.trainer import init_state
 
     if len(jax.devices()) < 2:
         pytest.skip("needs multiple devices")
-    mc = ModelConfig(
-        input_dim=2,
-        theta_dim=1,
-        input_func_dim=3,
-        out_dim=1,
-        n_input_functions=1,
-        n_attn_layers=1,
-        n_attn_hidden_dim=16,
-        n_mlp_num_layers=1,
-        n_mlp_hidden_dim=16,
-        n_input_hidden_dim=16,
-        n_expert=2,
-        n_head=2,
-        attention_impl="pallas",
-    )
     samples = datasets.synth_ns2d(2, n_points=16)
     batch = next(iter(Loader(samples, 2)))
-    model = GNOT(mc)
+    model = GNOT(SMALL_PALLAS)  # no mesh attached
     state = init_state(model, OptimConfig(), batch, seed=0)
     mesh = mesh_lib.make_mesh(MeshConfig(data=2, seq=1, model=1), jax.devices()[:2])
-    with pytest.raises(ValueError, match="pallas"):
+    with pytest.raises(ValueError, match="mesh"):
         mesh_lib.make_sharded_train_step(model, OptimConfig(), "rel_l2", mesh, state)
