@@ -102,6 +102,9 @@ class MeshConfig:
 class TrainConfig:
     epochs: int = 100  # reference main.py:23
     loss: str = "rel_l2"  # the reference trains AND evals on rel-L2
+    # Train over the MeshConfig device mesh (sharded jit steps; on
+    # multi-process runs the mesh spans hosts). False = single device.
+    distributed: bool = False
     checkpoint_dir: str = ""
     resume: bool = False
     checkpoint_every: int = 0  # epochs; 0 = best-only (reference behavior)

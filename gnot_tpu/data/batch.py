@@ -23,6 +23,8 @@ mode, so parity runs disable it.
 from __future__ import annotations
 
 import dataclasses
+import queue
+import threading
 from typing import Iterator, Sequence
 
 import flax.struct
@@ -122,10 +124,13 @@ def collate(samples: Sequence[MeshSample], *, bucket: bool = True) -> MeshBatch:
 
 
 class Loader:
-    """Minimal epoch iterator: shuffle, batch, collate.
+    """Epoch iterator: shuffle, batch, collate, background prefetch.
 
     Replaces the reference's ``DataLoader(batch_size=4, shuffle=True,
     collate_fn=unzip)`` (main.py:37-42) without a torch dependency.
+    With ``prefetch > 0`` (default), collation runs in a background
+    thread so the host packs batch N+1 while the device executes batch
+    N — the host->device pipeline never stalls on the packer.
     """
 
     def __init__(
@@ -137,12 +142,14 @@ class Loader:
         seed: int = 0,
         bucket: bool = True,
         drop_remainder: bool = False,
+        prefetch: int = 2,
     ):
         self.samples = list(samples)
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.bucket = bucket
         self.drop_remainder = drop_remainder
+        self.prefetch = prefetch
         self._rng = np.random.default_rng(seed)
 
     def __len__(self) -> int:
@@ -151,12 +158,60 @@ class Loader:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
 
-    def __iter__(self) -> Iterator[MeshBatch]:
+    def _epoch_indices(self) -> list[np.ndarray]:
         order = np.arange(len(self.samples))
         if self.shuffle:
             self._rng.shuffle(order)
+        chunks = []
         for start in range(0, len(order), self.batch_size):
             idx = order[start : start + self.batch_size]
             if self.drop_remainder and len(idx) < self.batch_size:
-                return
-            yield collate([self.samples[i] for i in idx], bucket=self.bucket)
+                break
+            chunks.append(idx)
+        return chunks
+
+    def _collate_at(self, idx: np.ndarray) -> MeshBatch:
+        return collate([self.samples[i] for i in idx], bucket=self.bucket)
+
+    def __iter__(self) -> Iterator[MeshBatch]:
+        chunks = self._epoch_indices()
+        if self.prefetch <= 0 or len(chunks) <= 1:
+            for idx in chunks:
+                yield self._collate_at(idx)
+            return
+
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+        _END = object()
+
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for idx in chunks:
+                    if not put(self._collate_at(idx)):
+                        return  # consumer abandoned the epoch
+                put(_END)
+            except BaseException as e:  # surface worker errors to the consumer
+                put(e)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            t.join()

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 
 from gnot_tpu import config as config_lib
 from gnot_tpu.config import Config, ModelConfig
@@ -67,6 +68,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--metrics_path", type=str, default="")
     p.add_argument("--profile_dir", type=str, default="")
     p.add_argument("--no_bucket", action="store_true", help="pad to per-batch max (parity)")
+    p.add_argument(
+        "--distributed", action="store_true",
+        help="train over the device mesh (sharded jit; spans hosts when "
+             "launched one process per host)"
+    )
     p.add_argument("--mesh_data", type=int, default=-1)
     p.add_argument("--mesh_seq", type=int, default=1)
     p.add_argument("--mesh_model", type=int, default=1)
@@ -94,6 +100,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "train.metrics_path": args.metrics_path,
             "train.profile_dir": args.profile_dir,
             "train.seed": args.seed,
+            "train.distributed": args.distributed,
             "mesh.data": args.mesh_data,
             "mesh.seq": args.mesh_seq,
             "mesh.model": args.mesh_model,
@@ -193,12 +200,43 @@ def main(argv=None) -> float:
     if args.backend == "torch":
         return run_torch_backend(args)
 
+    # Honor JAX_PLATFORMS even when a site hook already imported jax
+    # (backends initialize lazily, so the live-config update works).
+    if os.environ.get("JAX_PLATFORMS"):
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    if args.distributed:
+        from gnot_tpu.parallel import multihost
+
+        multihost.initialize()  # no-op single-process
+
     from gnot_tpu.train.trainer import Trainer
     from gnot_tpu.utils.metrics import MetricsSink
 
     cfg = config_from_args(args)
     train_samples, test_samples = datasets.load(cfg.data)
     mc = model_config(cfg, args, train_samples)
+
+    if args.distributed:
+        import jax
+
+        if jax.process_count() > 1:
+            # Each host keeps only its shard; batches are per-host and
+            # concatenate across processes (multihost.global_batch).
+            # Equal shard sizes keep the SPMD step counts aligned.
+            from gnot_tpu.parallel import multihost
+
+            p = jax.process_count()
+            for name, n in (("n_train", len(train_samples)), ("n_test", len(test_samples))):
+                if n % p:
+                    raise ValueError(
+                        f"{name}={n} must be divisible by the {p} processes "
+                        "(every host must run the same number of steps)"
+                    )
+            train_samples = multihost.shard_samples(train_samples)
+            test_samples = multihost.shard_samples(test_samples)
 
     sink = MetricsSink(cfg.train.metrics_path) if cfg.train.metrics_path else None
     checkpointer = None

@@ -191,3 +191,17 @@ def make_sharded_train_step(model, optim_cfg, loss_name: str, mesh: Mesh, state)
         out_shardings=(st_sh, replicated),
         donate_argnums=(0,),
     )
+
+
+def make_sharded_eval_step(model, loss_name: str, mesh: Mesh, state):
+    """jit the eval (loss-only) step over the mesh; the scalar metric
+    comes back replicated."""
+    from gnot_tpu.train.trainer import batch_loss
+
+    p_sh = state_shardings(mesh, state).params
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        lambda params, batch: batch_loss(model, params, batch, loss_name),
+        in_shardings=(p_sh, None),
+        out_shardings=replicated,
+    )

@@ -1,0 +1,134 @@
+"""Multi-host (multi-process / DCN) scale-out.
+
+The reference is strictly single-process, single-device — no
+NCCL/MPI/torch.distributed anywhere (SURVEY.md §2 rows 9-10, §5). The
+TPU-native scale-out story is JAX's multi-controller runtime: one
+process per host, ``jax.distributed.initialize`` for the coordination
+service, and ONE global mesh spanning every chip; jitted code is
+identical to single-host — XLA routes collectives over ICI inside a
+slice and DCN across slices.
+
+Layout policy (the scaling-book recipe): put **data parallelism on the
+DCN axis** — the only cross-host collective is then the gradient psum,
+once per step, which DCN bandwidth handles — and keep SP/TP, whose
+collectives are per-layer, inside the ICI domain. ``make_hybrid_mesh``
+encodes exactly that: the leading ``data`` axis is (hosts x local-data),
+``seq``/``model`` never cross a host boundary.
+
+Data feeding is per-host: each process loads only its shard of the
+samples (``shard_samples``) and assembles globally-sharded device arrays
+from process-local batches (``global_batch``) via
+``jax.make_array_from_process_local_data``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+
+from gnot_tpu.config import MeshConfig
+from gnot_tpu.data.batch import MeshBatch
+from gnot_tpu.parallel.mesh import AXES, batch_pspecs, make_mesh
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Bring up the multi-controller runtime.
+
+    With no arguments, attempts ``jax.distributed.initialize()``'s
+    environment auto-detection (TPU pods, SLURM, Open MPI); if the
+    process is not part of a managed multi-process job the attempt
+    fails and this degrades to a single-process no-op, so drivers can
+    call it unconditionally."""
+    if (
+        coordinator_address is None
+        and num_processes is None
+        and process_id is None
+    ):
+        try:
+            jax.distributed.initialize()
+        except (RuntimeError, ValueError):
+            return  # not a managed multi-process environment
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_hybrid_mesh(cfg: MeshConfig) -> Mesh:
+    """Global ``data x seq x model`` mesh over all hosts.
+
+    ``cfg.data`` is the TOTAL data-parallel degree (same meaning as
+    ``make_mesh`` / ``--mesh_data``), factored as hosts x per-host; the
+    host factor rides DCN, seq/model stay inside each host's ICI
+    domain. Single-process runs degenerate to ``make_mesh``."""
+    n_proc = jax.process_count()
+    if n_proc == 1:
+        return make_mesh(cfg)
+    from jax.experimental import mesh_utils
+
+    local = jax.local_device_count()
+    if local % (cfg.seq * cfg.model):
+        raise ValueError(
+            f"seq*model={cfg.seq * cfg.model} must divide the {local} "
+            "local devices (SP/TP must not cross hosts)"
+        )
+    if cfg.data > 0:
+        if cfg.data % n_proc:
+            raise ValueError(
+                f"total data degree {cfg.data} must be divisible by the "
+                f"{n_proc} processes"
+            )
+        ici_data = cfg.data // n_proc
+    else:
+        ici_data = local // (cfg.seq * cfg.model)
+    if ici_data * cfg.seq * cfg.model != local:
+        raise ValueError(
+            f"per-host mesh {ici_data}x{cfg.seq}x{cfg.model} does not "
+            f"cover {local} local devices"
+        )
+    devices = mesh_utils.create_hybrid_device_mesh(
+        mesh_shape=(ici_data, cfg.seq, cfg.model),
+        dcn_mesh_shape=(n_proc, 1, 1),
+    )
+    return Mesh(devices, AXES)
+
+
+def shard_samples(
+    samples: Sequence,
+    *,
+    process_index: int | None = None,
+    process_count: int | None = None,
+) -> list:
+    """This host's strided shard of the dataset (every host must call
+    with the same ``samples`` order — seed the shuffle identically)."""
+    i = jax.process_index() if process_index is None else process_index
+    n = jax.process_count() if process_count is None else process_count
+    return list(samples)[i::n]
+
+
+def global_batch(mesh: Mesh, local_batch: MeshBatch) -> MeshBatch:
+    """Assemble a globally-sharded MeshBatch from this process's local
+    batch (the batch axis concatenates across hosts in process order)."""
+    specs = batch_pspecs()
+
+    def put(spec, leaf):
+        if leaf is None:
+            return None
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), leaf
+        )
+
+    return jax.tree.map(
+        put,
+        specs,
+        local_batch,
+        is_leaf=lambda x: x is None or not isinstance(x, MeshBatch),
+    )

@@ -127,20 +127,57 @@ class Trainer:
         checkpointer=None,
     ):
         self.config = config
-        self.model = GNOT(model_cfg)
+        self.mesh = None
+        drop_remainder = config.data.drop_remainder
+        if config.train.distributed:
+            from gnot_tpu.parallel import multihost
+
+            self.mesh = multihost.make_hybrid_mesh(config.mesh)
+            # Fail at startup, not mid-epoch: every batch must split
+            # over the mesh axes.
+            local_data = self.mesh.shape["data"] // max(1, jax.process_count())
+            if config.data.batch_size % max(1, local_data):
+                raise ValueError(
+                    f"batch_size={config.data.batch_size} must be divisible "
+                    f"by the per-host data axis ({local_data})"
+                )
+            if self.mesh.shape["seq"] > 1 and not config.data.bucket:
+                raise ValueError(
+                    "sequence parallelism (mesh seq>1) requires bucketed "
+                    "padding (lengths divisible by the seq axis); drop "
+                    "--no_bucket"
+                )
+            if len(train_samples) % config.data.batch_size:
+                drop_remainder = True  # partial batches can't shard
+            if len(test_samples) % config.data.batch_size:
+                raise ValueError(
+                    f"distributed eval needs n_test ({len(test_samples)}) "
+                    f"divisible by batch_size ({config.data.batch_size})"
+                )
+        pallas_mesh = (
+            self.mesh if model_cfg.attention_impl == "pallas" else None
+        )
+        self.model = GNOT(model_cfg, mesh=pallas_mesh)
         self.train_loader = Loader(
             train_samples,
             config.data.batch_size,
             shuffle=config.data.shuffle_train,
             seed=config.data.seed,
             bucket=config.data.bucket,
-            drop_remainder=config.data.drop_remainder,
+            drop_remainder=drop_remainder,
         )
         self.test_loader = Loader(
             test_samples, config.data.batch_size, shuffle=False, bucket=config.data.bucket
         )
-        self.train_step = make_train_step(self.model, config.optim, config.train.loss)
-        self.eval_step = make_eval_step(self.model, config.train.loss)
+        if self.mesh is None:
+            self.train_step = make_train_step(
+                self.model, config.optim, config.train.loss
+            )
+            self.eval_step = make_eval_step(self.model, config.train.loss)
+        else:
+            # Built lazily in initialize(): the sharded jits need the
+            # state's sharding layout.
+            self.train_step = self.eval_step = None
         self.lr_fn = make_lr_fn(
             config.optim,
             steps_per_epoch=len(self.train_loader),
@@ -161,12 +198,38 @@ class Trainer:
             restored = self.checkpointer.restore_latest(self.state)
             if restored is not None:
                 self.state, self.start_epoch, self.best_metric = restored
+        if self.mesh is not None:
+            from gnot_tpu.parallel import mesh as mesh_lib
+
+            self.state = mesh_lib.shard_state(self.mesh, self.state)
+            self.train_step = mesh_lib.make_sharded_train_step(
+                self.model, self.config.optim, self.config.train.loss,
+                self.mesh, self.state,
+            )
+            self.eval_step = mesh_lib.make_sharded_eval_step(
+                self.model, self.config.train.loss, self.mesh, self.state
+            )
         return self.state
+
+    def _device_batch(self, batch: MeshBatch) -> MeshBatch:
+        """Place a host batch for the step: sharded over the mesh when
+        distributed (cross-host assembly on multi-process runs)."""
+        if self.mesh is None:
+            return batch
+        from gnot_tpu.parallel import mesh as mesh_lib, multihost
+
+        if jax.process_count() > 1:
+            return multihost.global_batch(self.mesh, batch)
+        return mesh_lib.shard_batch(self.mesh, batch)
 
     def evaluate(self) -> float:
         metrics = [
-            np.asarray(self.eval_step(self.state.params, b)) for b in self.test_loader
+            np.asarray(self.eval_step(self.state.params, self._device_batch(b)))
+            for b in self.test_loader
         ]
+        # In multi-process mode each batch is assembled globally
+        # (_device_batch -> global_batch), so every process computes the
+        # same full-test metric — no cross-host aggregation needed.
         return float(np.mean(metrics))
 
     def evaluate_from_checkpoint(self) -> float:
@@ -204,7 +267,9 @@ class Trainer:
                     for batch in self.train_loader:
                         lr = self.lr_fn(int(self.state.step), epoch)
                         self.state, loss = self.train_step(
-                            self.state, batch, jnp.asarray(lr, jnp.float32)
+                            self.state,
+                            self._device_batch(batch),
+                            jnp.asarray(lr, jnp.float32),
                         )
                         losses.append(loss)
                         points += batch.n_real_points

@@ -103,3 +103,37 @@ def test_loader_shuffle_deterministic_by_seed():
     e1 = [b.coords.sum() for b in loader]
     e2 = [b.coords.sum() for b in loader]
     assert e1 != e2
+
+
+def test_loader_prefetch_matches_sync():
+    """Prefetching yields identical batches in identical order."""
+    samples = ragged_samples() * 6
+    sync = list(Loader(samples, 4, shuffle=True, seed=3, prefetch=0))
+    pre = list(Loader(samples, 4, shuffle=True, seed=3, prefetch=2))
+    assert len(sync) == len(pre)
+    for a, b in zip(sync, pre):
+        np.testing.assert_array_equal(a.coords, b.coords)
+        np.testing.assert_array_equal(a.node_mask, b.node_mask)
+        np.testing.assert_array_equal(a.funcs, b.funcs)
+
+
+def test_loader_prefetch_abandoned_epoch_no_deadlock():
+    samples = ragged_samples() * 20
+    loader = Loader(samples, 2, prefetch=1)
+    it = iter(loader)
+    next(it)
+    it.close()  # abandon mid-epoch; producer must shut down cleanly
+    # a fresh epoch still works
+    assert len(list(loader)) == len(loader)
+
+
+def test_loader_prefetch_propagates_worker_errors():
+    samples = ragged_samples()
+    loader = Loader(samples, 2)
+    broken = Loader(samples, 2)
+    broken._collate_at = lambda idx: (_ for _ in ()).throw(RuntimeError("boom"))
+    import pytest
+
+    with pytest.raises(RuntimeError, match="boom"):
+        list(broken)
+    assert len(list(loader)) == len(loader)
