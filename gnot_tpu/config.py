@@ -87,6 +87,10 @@ class DataConfig:
     # reference does — parity mode needs this).
     bucket: bool = True
     drop_remainder: bool = False
+    # Fixed pad lengths (0 = per-batch). Distributed runs fill these in
+    # from dataset-wide maxima so every host pads identically (SPMD).
+    pad_nodes: int = 0
+    pad_funcs: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

@@ -73,6 +73,20 @@ def bucket_length(n: int, *, min_size: int = 64) -> int:
     return size
 
 
+def fixed_pad_lengths(
+    samples: Sequence[MeshSample], *, bucket: bool = True
+) -> tuple[int, int]:
+    """Dataset-wide ``(pad_nodes, pad_funcs)`` targets: the maxima over
+    ALL samples (bucketed). With these, every batch has one static
+    shape — multi-host SPMD safe, zero recompiles."""
+    pn = max(s.coords.shape[0] for s in samples)
+    pf = max((f.shape[0] for s in samples for f in s.funcs), default=0)
+    if bucket:
+        pn = bucket_length(pn)
+        pf = bucket_length(pf) if pf else 0
+    return pn, pf
+
+
 def pad_rows(arr: np.ndarray, length: int) -> np.ndarray:
     """Zero-pad axis 0 to ``length`` (reference utils.py:3-4)."""
     if arr.shape[0] == length:
@@ -81,8 +95,20 @@ def pad_rows(arr: np.ndarray, length: int) -> np.ndarray:
     return np.pad(arr, pad)
 
 
-def collate(samples: Sequence[MeshSample], *, bucket: bool = True) -> MeshBatch:
+def collate(
+    samples: Sequence[MeshSample],
+    *,
+    bucket: bool = True,
+    pad_nodes: int = 0,
+    pad_funcs: int = 0,
+) -> MeshBatch:
     """Pad and stack ragged samples into a dense MeshBatch.
+
+    ``pad_nodes``/``pad_funcs`` force fixed pad lengths (0 = per-batch
+    max, optionally bucketed). Fixed lengths give every batch one static
+    shape — required for multi-host SPMD (every process must assemble
+    identically-shaped global arrays regardless of its local samples)
+    and they eliminate XLA recompiles outright.
 
     The packing hot loop runs in the native C++ packer
     (``gnot_tpu/native/ragged_pack.cpp``) when available: one
@@ -90,9 +116,12 @@ def collate(samples: Sequence[MeshSample], *, bucket: bool = True) -> MeshBatch:
     pass; pure-numpy fallback otherwise (identical output)."""
     from gnot_tpu import native
 
-    max_nodes = max(s.coords.shape[0] for s in samples)
-    if bucket:
-        max_nodes = bucket_length(max_nodes)
+    if pad_nodes:
+        max_nodes = pad_nodes
+    else:
+        max_nodes = max(s.coords.shape[0] for s in samples)
+        if bucket:
+            max_nodes = bucket_length(max_nodes)
 
     coords, node_mask = native.pack_rows([s.coords for s in samples], max_nodes)
     y, _ = native.pack_rows([s.y for s in samples], max_nodes)
@@ -101,11 +130,14 @@ def collate(samples: Sequence[MeshSample], *, bucket: bool = True) -> MeshBatch:
     n_funcs = len(samples[0].funcs)
     funcs = func_mask = None
     if n_funcs:
-        # Single shared max across every function of every sample
-        # (reference main.py:63).
-        max_f = max(f.shape[0] for s in samples for f in s.funcs)
-        if bucket:
-            max_f = bucket_length(max_f)
+        if pad_funcs:
+            max_f = pad_funcs
+        else:
+            # Single shared max across every function of every sample
+            # (reference main.py:63).
+            max_f = max(f.shape[0] for s in samples for f in s.funcs)
+            if bucket:
+                max_f = bucket_length(max_f)
         packed = [
             native.pack_rows([s.funcs[j] for s in samples], max_f)
             for j in range(n_funcs)
@@ -143,6 +175,8 @@ class Loader:
         bucket: bool = True,
         drop_remainder: bool = False,
         prefetch: int = 2,
+        pad_nodes: int = 0,
+        pad_funcs: int = 0,
     ):
         self.samples = list(samples)
         self.batch_size = batch_size
@@ -150,6 +184,8 @@ class Loader:
         self.bucket = bucket
         self.drop_remainder = drop_remainder
         self.prefetch = prefetch
+        self.pad_nodes = pad_nodes
+        self.pad_funcs = pad_funcs
         self._rng = np.random.default_rng(seed)
 
     def __len__(self) -> int:
@@ -171,7 +207,12 @@ class Loader:
         return chunks
 
     def _collate_at(self, idx: np.ndarray) -> MeshBatch:
-        return collate([self.samples[i] for i in idx], bucket=self.bucket)
+        return collate(
+            [self.samples[i] for i in idx],
+            bucket=self.bucket,
+            pad_nodes=self.pad_nodes,
+            pad_funcs=self.pad_funcs,
+        )
 
     def __iter__(self) -> Iterator[MeshBatch]:
         chunks = self._epoch_indices()

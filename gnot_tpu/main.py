@@ -235,6 +235,17 @@ def main(argv=None) -> float:
                         f"{name}={n} must be divisible by the {p} processes "
                         "(every host must run the same number of steps)"
                     )
+            # Fix pad lengths from the PRE-shard dataset so every host
+            # pads to identical shapes (SPMD global-batch assembly).
+            from gnot_tpu.data.batch import fixed_pad_lengths
+
+            pn, pf = fixed_pad_lengths(
+                list(train_samples) + list(test_samples), bucket=cfg.data.bucket
+            )
+            cfg = dataclasses.replace(
+                cfg,
+                data=dataclasses.replace(cfg.data, pad_nodes=pn, pad_funcs=pf),
+            )
             train_samples = multihost.shard_samples(train_samples)
             test_samples = multihost.shard_samples(test_samples)
 

@@ -27,12 +27,21 @@ class Checkpointer:
         self._ckptr = ocp.StandardCheckpointer()
 
     def _save(self, name: str, state: Any, epoch: int, best_metric: float) -> None:
+        """Async save: waits for the PREVIOUS save, then returns while
+        this one commits in the background — training overlaps the
+        checkpoint write. Orbax finalizes atomically (tmp dir + rename),
+        so a crash mid-save never leaves a torn checkpoint at ``path``;
+        ``_restore`` tolerates a meta file whose directory never landed."""
         path = os.path.join(self.directory, name)
-        self._ckptr.save(path, state, force=True)
         self._ckptr.wait_until_finished()
+        self._ckptr.save(path, state, force=True)
         meta = {"epoch": epoch, "best_metric": best_metric}
         with open(os.path.join(self.directory, f"{name}.json"), "w") as f:
             json.dump(meta, f)
+
+    def wait(self) -> None:
+        """Block until any in-flight save has committed."""
+        self._ckptr.wait_until_finished()
 
     def save_best(self, state: Any, epoch: int, best_metric: float) -> None:
         self._save("best", state, epoch, best_metric)
@@ -41,9 +50,12 @@ class Checkpointer:
         self._save("latest", state, epoch, best_metric)
 
     def _restore(self, name: str, target: Any):
+        self._ckptr.wait_until_finished()
         path = os.path.join(self.directory, name)
         meta_path = f"{path}.json"
-        if not os.path.exists(meta_path):
+        # Require both the meta sidecar and the committed directory: an
+        # async save interrupted before finalize leaves meta without path.
+        if not os.path.exists(meta_path) or not os.path.isdir(path):
             return None
         state = self._ckptr.restore(path, target)
         with open(meta_path) as f:

@@ -129,10 +129,24 @@ class Trainer:
         self.config = config
         self.mesh = None
         drop_remainder = config.data.drop_remainder
+        pad_nodes = config.data.pad_nodes
+        pad_funcs = config.data.pad_funcs
         if config.train.distributed:
+            from gnot_tpu.data.batch import fixed_pad_lengths
             from gnot_tpu.parallel import multihost
 
             self.mesh = multihost.make_hybrid_mesh(config.mesh)
+            if not pad_nodes:
+                # Distributed batches need one fixed shape: per-batch
+                # padding would diverge across hosts (different local
+                # samples -> different bucketed maxima -> SPMD shape
+                # mismatch). Multi-process drivers set these from the
+                # PRE-shard dataset (main.py); computing from local
+                # samples here covers the single-process case.
+                pad_nodes, pad_funcs = fixed_pad_lengths(
+                    list(train_samples) + list(test_samples),
+                    bucket=config.data.bucket,
+                )
             # Fail at startup, not mid-epoch: every batch must split
             # over the mesh axes.
             local_data = self.mesh.shape["data"] // max(1, jax.process_count())
@@ -165,9 +179,16 @@ class Trainer:
             seed=config.data.seed,
             bucket=config.data.bucket,
             drop_remainder=drop_remainder,
+            pad_nodes=pad_nodes,
+            pad_funcs=pad_funcs,
         )
         self.test_loader = Loader(
-            test_samples, config.data.batch_size, shuffle=False, bucket=config.data.bucket
+            test_samples,
+            config.data.batch_size,
+            shuffle=False,
+            bucket=config.data.bucket,
+            pad_nodes=pad_nodes,
+            pad_funcs=pad_funcs,
         )
         if self.mesh is None:
             self.train_step = make_train_step(
@@ -188,9 +209,16 @@ class Trainer:
         self.state: TrainState | None = None
         self.best_metric = float("inf")
         self.start_epoch = 0
+        # Host-side mirror of state.step: reading the device counter every
+        # batch would force a blocking transfer per step.
+        self.host_step = 0
 
     def initialize(self) -> TrainState:
-        sample = next(iter(self.test_loader or self.train_loader))
+        # Shape probe: collate one batch directly — going through the
+        # loader would spin up its prefetch thread and collate batches
+        # that get thrown away.
+        probe = self.test_loader if len(self.test_loader) else self.train_loader
+        sample = probe._collate_at(np.arange(min(probe.batch_size, len(probe.samples))))
         self.state = init_state(
             self.model, self.config.optim, sample, self.config.train.seed
         )
@@ -198,6 +226,7 @@ class Trainer:
             restored = self.checkpointer.restore_latest(self.state)
             if restored is not None:
                 self.state, self.start_epoch, self.best_metric = restored
+                self.host_step = int(self.state.step)  # one-time sync
         if self.mesh is not None:
             from gnot_tpu.parallel import mesh as mesh_lib
 
@@ -265,12 +294,13 @@ class Trainer:
             ):
                 with profiling.annotate("train_epoch"):
                     for batch in self.train_loader:
-                        lr = self.lr_fn(int(self.state.step), epoch)
+                        lr = self.lr_fn(self.host_step, epoch)
                         self.state, loss = self.train_step(
                             self.state,
                             self._device_batch(batch),
                             jnp.asarray(lr, jnp.float32),
                         )
+                        self.host_step += 1
                         losses.append(loss)
                         points += batch.n_real_points
                 train_loss = float(np.mean([np.asarray(l) for l in losses]))
@@ -288,7 +318,7 @@ class Trainer:
                     epoch=epoch,
                     train_loss=train_loss,
                     test_metric=res,
-                    lr=self.lr_fn(int(self.state.step), epoch),
+                    lr=self.lr_fn(self.host_step, epoch),
                     points_per_sec=points / dt,
                     epoch_seconds=dt,
                 )
@@ -302,5 +332,7 @@ class Trainer:
             ):
                 self.checkpointer.save_latest(self.state, epoch + 1, self.best_metric)
 
+        if self.checkpointer is not None:
+            self.checkpointer.wait()  # flush in-flight async saves
         print(f"\nBest Test Metric: {self.best_metric}")
         return self.best_metric

@@ -137,3 +137,20 @@ def test_loader_prefetch_propagates_worker_errors():
     with pytest.raises(RuntimeError, match="boom"):
         list(broken)
     assert len(list(loader)) == len(loader)
+
+
+def test_fixed_pad_lengths_static_shapes():
+    """Fixed pads give every batch one shape regardless of composition."""
+    from gnot_tpu.data.batch import fixed_pad_lengths
+
+    samples = datasets.synth_elasticity(12, base_points=64, seed=9)
+    pn, pf = fixed_pad_lengths(samples)
+    shapes = set()
+    for b in Loader(samples, 4, pad_nodes=pn, pad_funcs=pf, prefetch=0):
+        shapes.add((b.coords.shape, b.funcs.shape))
+        assert b.coords.shape[1] == pn and b.funcs.shape[2] == pf
+    assert len(shapes) == 1
+    # masks still reflect the true lengths
+    total = sum(s.coords.shape[0] for s in samples)
+    masked = sum(b.n_real_points for b in Loader(samples, 4, pad_nodes=pn, pad_funcs=pf))
+    assert masked == total
