@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 
 
-def build(step_dtype: str, attention_impl: str = "xla", n_points: int = 1024, batch_size: int = 4):
+def build(step_dtype: str, attention_impl: str = "xla", n_points: int = 1024, batch_size: int = 4, ffn_impl: str = "xla"):
     from gnot_tpu.config import ModelConfig, OptimConfig
     from gnot_tpu.data import datasets
     from gnot_tpu.data.batch import Loader
@@ -37,6 +37,7 @@ def build(step_dtype: str, attention_impl: str = "xla", n_points: int = 1024, ba
         n_input_functions=1,
         dtype=step_dtype,
         attention_impl=attention_impl,
+        ffn_impl=ffn_impl,
     )  # reference-default architecture (main.py:16-22)
     samples = datasets.synth_ns2d(batch_size, n_points=n_points, seed=0)
     batch = next(iter(Loader(samples, batch_size)))
@@ -70,6 +71,7 @@ def main():
     p.add_argument("--cpu_steps", type=int, default=3)
     p.add_argument("--dtype", type=str, default="bfloat16", choices=["float32", "bfloat16"])
     p.add_argument("--attention_impl", type=str, default="xla", choices=["xla", "pallas"])
+    p.add_argument("--ffn_impl", type=str, default="xla", choices=["xla", "pallas"])
     p.add_argument("--n_points", type=int, default=1024)
     p.add_argument("--batch_size", type=int, default=4)
     args = p.parse_args()
@@ -79,7 +81,8 @@ def main():
     cpu = jax.devices("cpu")[0]
 
     step, state, batch = build(
-        args.dtype, args.attention_impl, args.n_points, args.batch_size
+        args.dtype, args.attention_impl, args.n_points, args.batch_size,
+        args.ffn_impl,
     )
     value = time_steps(step, state, batch, lr, args.warmup, args.steps, accel)
 

@@ -34,8 +34,12 @@ class ModelConfig:
     attention_mode: str = "masked"
     # "xla": attention as fused einsums (GSPMD-shardable, the mesh path).
     # "pallas": fused single-pass VMEM kernel (ops/pallas_attention.py);
-    # single-device / DP only — pallas_call is not GSPMD-partitionable.
+    # on a mesh it dispatches through shard_map (model must carry the mesh).
     attention_impl: str = "xla"
+    # "xla": batched-GEMM expert FFN (GSPMD-shardable). "pallas": whole
+    # expert stack tile-resident in VMEM (ops/pallas_ffn.py);
+    # single-device / DP only.
+    ffn_impl: str = "xla"
     # Compute dtype for the encoder stack; params stay float32.
     dtype: str = "float32"
 
@@ -46,6 +50,8 @@ class ModelConfig:
             raise ValueError(f"unknown attention_mode {self.attention_mode!r}")
         if self.attention_impl not in ("xla", "pallas"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+        if self.ffn_impl not in ("xla", "pallas"):
+            raise ValueError(f"unknown ffn_impl {self.ffn_impl!r}")
 
 
 @dataclasses.dataclass(frozen=True)

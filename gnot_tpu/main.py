@@ -52,7 +52,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--attention_impl", type=str, default="xla", choices=["xla", "pallas"],
-        help="pallas: fused VMEM attention kernel (single-device / DP)"
+        help="pallas: fused VMEM attention kernel (shard_map'd on a mesh)"
+    )
+    p.add_argument(
+        "--ffn_impl", type=str, default="xla", choices=["xla", "pallas"],
+        help="pallas: VMEM-resident fused expert FFN (single-device / DP)"
     )
     p.add_argument("--dtype", type=str, default="float32", choices=["float32", "bfloat16"])
     p.add_argument("--loss", type=str, default="rel_l2", choices=["rel_l2", "mse"])
@@ -122,6 +126,7 @@ def model_config(cfg: Config, args: argparse.Namespace, train_samples) -> ModelC
         n_head=args.n_head,
         attention_mode=args.attention_mode,
         attention_impl=args.attention_impl,
+        ffn_impl=args.ffn_impl,
         dtype=args.dtype,
         **dims,
     )

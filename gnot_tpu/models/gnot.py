@@ -47,6 +47,7 @@ class HNABlock(nn.Module):
     dtype: Any = None
     parity: bool = False
     attention_impl: str = "xla"
+    ffn_impl: str = "xla"
     mesh: Any = None
 
     @nn.compact
@@ -75,6 +76,7 @@ class HNABlock(nn.Module):
             self.n_mlp_hidden_dim,
             self.n_mlp_hidden_dim,
             dtype=self.dtype,
+            ffn_impl=self.ffn_impl,
             name="ffn1",
         )(cross, scores)
         query = query + ffn1
@@ -95,6 +97,7 @@ class HNABlock(nn.Module):
             self.n_mlp_hidden_dim,
             self.n_mlp_hidden_dim,
             dtype=self.dtype,
+            ffn_impl=self.ffn_impl,
             name="ffn2",
         )(self_out, scores)
         return query + ffn2
@@ -183,6 +186,7 @@ class GNOT(nn.Module):
                 dtype=dtype,
                 parity=cfg.attention_mode == "parity",
                 attention_impl=cfg.attention_impl,
+                ffn_impl=cfg.ffn_impl,
                 mesh=self.mesh,
                 name=f"block_{i}",
             )(scores, query, funcs, node_mask=node_mask, func_mask=func_mask)

@@ -23,6 +23,7 @@ from gnot_tpu.ops.attention import (
     split_heads,
 )
 from gnot_tpu.ops.pallas_attention import fused_nla, fused_nla_sp
+from gnot_tpu.ops.pallas_ffn import fits_vmem, fused_gated_ffn
 
 Array = jax.Array
 
@@ -250,6 +251,11 @@ class GatedExpertFfn(nn.Module):
     is a *soft* mixture); outputs are combined with the geometry-gating
     ``scores``. The E expert MLPs are stacked so each Linear becomes one
     batched ``[E, ...]`` GEMM on the MXU instead of an E-way Python loop.
+
+    ``ffn_impl='pallas'`` runs the whole expert stack tile-resident in
+    VMEM (ops/pallas_ffn.py) — no ``[E, B, L, hidden]`` HBM slabs
+    between layers — when the weight set fits the VMEM budget;
+    otherwise it falls back to the XLA path.
     """
 
     n_expert: int
@@ -257,6 +263,7 @@ class GatedExpertFfn(nn.Module):
     hidden_dim: int
     output_dim: int
     dtype: Any = None
+    ffn_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x: Array, scores: Array) -> Array:
@@ -268,6 +275,16 @@ class GatedExpertFfn(nn.Module):
             split_rngs={"params": True},
             axis_size=self.n_expert,
         )(self.num_layers, self.hidden_dim, self.output_dim, self.dtype, name="experts")
+
+        if self.ffn_impl == "pallas" and not self.is_initializing():
+            p = self.variables["params"]["experts"]
+            kernels = [
+                p[f"dense_{i}"]["kernel"] for i in range(self.num_layers + 1)
+            ]
+            biases = [p[f"dense_{i}"]["bias"] for i in range(self.num_layers + 1)]
+            if fits_vmem(kernels):
+                return fused_gated_ffn(x, scores, kernels, biases)
+
         out = experts(x)  # [E, B, L, D]
         # scores: [B, L, E]; gate-weighted sum over experts (model.py:130).
         return jnp.einsum("ebld,ble->bld", out, scores.astype(out.dtype))

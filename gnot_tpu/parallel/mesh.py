@@ -159,6 +159,11 @@ def make_sharded_train_step(model, optim_cfg, loss_name: str, mesh: Mesh, state)
 
     from gnot_tpu.train.trainer import TrainState, batch_loss, make_optimizer
 
+    if getattr(model.config, "ffn_impl", "xla") == "pallas":
+        raise ValueError(
+            "ffn_impl='pallas' is single-device/DP only (no shard_map "
+            "form yet); use ffn_impl='xla' on a mesh"
+        )
     if getattr(model.config, "attention_impl", "xla") == "pallas":
         # pallas_call is not GSPMD-partitionable, but the model can run
         # it distributed through shard_map when built with this mesh

@@ -1,0 +1,150 @@
+"""Fused gated expert FFN kernel vs the einsum formulation."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gnot_tpu.config import ModelConfig
+from gnot_tpu.data import datasets
+from gnot_tpu.data.batch import Loader
+from gnot_tpu.models.gnot import GNOT
+from gnot_tpu.ops.pallas_ffn import _reference_impl, fits_vmem, fused_gated_ffn
+
+
+def _setup(key, b=2, l=20, din=16, hid=24, dout=16, e=3, n_layers=2):
+    keys = jax.random.split(key, 2 * (n_layers + 1) + 2)
+    dims = [din] + [hid] * n_layers + [dout]
+    kernels = [
+        jax.random.normal(keys[i], (e, dims[i], dims[i + 1]), jnp.float32) * 0.3
+        for i in range(n_layers + 1)
+    ]
+    biases = [
+        jax.random.normal(keys[n_layers + 1 + i], (e, dims[i + 1]), jnp.float32)
+        for i in range(n_layers + 1)
+    ]
+    x = jax.random.normal(keys[-2], (b, l, din), jnp.float32)
+    scores = jax.nn.softmax(jax.random.normal(keys[-1], (b, l, e)), axis=-1)
+    return x, scores, kernels, biases
+
+
+@pytest.mark.parametrize("l", [20, 300])  # 300 exercises the seq tiling
+def test_fused_ffn_matches_einsum(l):
+    x, scores, kernels, biases = _setup(jax.random.key(0), l=l)
+    out = fused_gated_ffn(x, scores, kernels, biases)
+    ref = _reference_impl(x, scores, kernels, biases)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-6)
+
+
+def test_fused_ffn_grads_match_einsum():
+    x, scores, kernels, biases = _setup(jax.random.key(1), l=12)
+
+    def loss_fused(x, s, k, b):
+        return jnp.sum(fused_gated_ffn(x, s, k, b) ** 2)
+
+    def loss_ref(x, s, k, b):
+        return jnp.sum(_reference_impl(x, s, k, b) ** 2)
+
+    g1 = jax.grad(loss_fused, argnums=(0, 1, 2, 3))(x, scores, kernels, biases)
+    g2 = jax.grad(loss_ref, argnums=(0, 1, 2, 3))(x, scores, kernels, biases)
+    for a, b_ in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-5, atol=1e-6)
+
+
+def test_ffn_reference_matches_xla_module_math():
+    """The kernel's einsum oracle == GatedExpertFfn's batched-GEMM math."""
+    import flax.linen as nn
+
+    from gnot_tpu.models.layers import GatedExpertFfn
+
+    x, scores, kernels, biases = _setup(jax.random.key(2), l=16, din=16, dout=16)
+    mod = GatedExpertFfn(n_expert=3, num_layers=2, hidden_dim=24, output_dim=16)
+    params = {
+        "experts": {
+            f"dense_{i}": {"kernel": kernels[i], "bias": biases[i]}
+            for i in range(3)
+        }
+    }
+    out_mod = mod.apply({"params": params}, x, scores)
+    out_ref = _reference_impl(x, scores, kernels, biases)
+    np.testing.assert_allclose(
+        np.asarray(out_ref), np.asarray(out_mod), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_model_forward_ffn_pallas_matches_xla():
+    mc = ModelConfig(
+        input_dim=2,
+        theta_dim=2,
+        input_func_dim=3,
+        out_dim=2,
+        n_input_functions=1,
+        n_attn_layers=2,
+        n_attn_hidden_dim=32,
+        n_mlp_num_layers=2,
+        n_mlp_hidden_dim=32,
+        n_input_hidden_dim=32,
+        n_expert=2,
+        n_head=4,
+    )
+    samples = datasets.synth_elasticity(4, base_points=40)
+    batch = next(iter(Loader(samples, 4)))
+
+    model_xla = GNOT(mc)
+    params = model_xla.init(
+        jax.random.key(0),
+        batch.coords,
+        batch.theta,
+        batch.funcs,
+        node_mask=batch.node_mask,
+        func_mask=batch.func_mask,
+    )["params"]
+    model_pallas = GNOT(dataclasses.replace(mc, ffn_impl="pallas"))
+
+    args = (batch.coords, batch.theta, batch.funcs)
+    kw = dict(node_mask=batch.node_mask, func_mask=batch.func_mask)
+    out_xla = model_xla.apply({"params": params}, *args, **kw)
+    out_pallas = model_pallas.apply({"params": params}, *args, **kw)
+    np.testing.assert_allclose(
+        np.asarray(out_pallas), np.asarray(out_xla), rtol=1e-4, atol=1e-5
+    )
+
+
+def test_fits_vmem_gate():
+    big = [jnp.zeros((4, 2048, 2048))]  # 64 MB > budget
+    small = [jnp.zeros((3, 256, 256))]
+    assert not fits_vmem(big)
+    assert fits_vmem(small)
+
+
+def test_sharded_step_rejects_ffn_pallas():
+    from gnot_tpu.config import MeshConfig, OptimConfig
+    from gnot_tpu.parallel import mesh as mesh_lib
+    from gnot_tpu.train.trainer import init_state
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multiple devices")
+    mc = ModelConfig(
+        input_dim=2,
+        theta_dim=1,
+        input_func_dim=3,
+        out_dim=1,
+        n_input_functions=1,
+        n_attn_layers=1,
+        n_attn_hidden_dim=16,
+        n_mlp_num_layers=1,
+        n_mlp_hidden_dim=16,
+        n_input_hidden_dim=16,
+        n_expert=2,
+        n_head=2,
+        ffn_impl="pallas",
+    )
+    samples = datasets.synth_ns2d(2, n_points=16)
+    batch = next(iter(Loader(samples, 2)))
+    model = GNOT(mc)
+    state = init_state(model, OptimConfig(), batch, seed=0)
+    mesh = mesh_lib.make_mesh(MeshConfig(data=2, seq=1, model=1), jax.devices()[:2])
+    with pytest.raises(ValueError, match="ffn_impl"):
+        mesh_lib.make_sharded_train_step(model, OptimConfig(), "rel_l2", mesh, state)
