@@ -282,7 +282,7 @@ class GatedExpertFfn(nn.Module):
                 p[f"dense_{i}"]["kernel"] for i in range(self.num_layers + 1)
             ]
             biases = [p[f"dense_{i}"]["bias"] for i in range(self.num_layers + 1)]
-            if fits_vmem(kernels):
+            if fits_vmem(kernels, biases):
                 return fused_gated_ffn(x, scores, kernels, biases)
 
         out = experts(x)  # [E, B, L, D]

@@ -11,11 +11,9 @@ These kernels keep the **merged-head layout** ``[L, E]`` (E = H*D, 256
 at defaults) end-to-end and express every per-head operation as a
 lane-group operation:
 
-* per-head feature softmax == softmax within each D-lane group. A
-  shared per-row max is subtracted (any per-row constant cancels inside
-  each group's ratio), then group sums come from one ``[L,E] x [E,E]``
-  matmul with a block-diagonal ones matrix — an MXU op, not a lane
-  shuffle;
+* per-head feature softmax == softmax within each D-lane group,
+  statically unrolled over head lane-slices with a per-group max (so
+  every group's exps are anchored at 1 — no cross-head underflow);
 * per-head ``k^T v`` == the block-diagonal part of the full ``[E, E]``
   contraction. We accumulate the full Gram matrix (perfectly
   MXU-shaped) and mask off the cross-head blocks at apply time;
@@ -92,19 +90,23 @@ def _block_diag_mask(e: int, d: int, dtype=jnp.float32) -> Array:
 def _group_softmax(x: Array, n_head: int) -> Array:
     """Per-head (lane-group) softmax of ``[T, E]`` rows.
 
-    Subtracting the shared per-row max is safe: within each head's group
-    the constant cancels from the exp ratio. Group sums are computed by
-    one MXU matmul with the block-diagonal ones matrix.
+    The max is computed per group, not per row: a shared row max cancels
+    in exact arithmetic, but a head whose logits sit ~87+ below another
+    head's spike would underflow every exp in its group to 0 and divide
+    0/0. With the per-group max each group contains an exact
+    ``exp(0) == 1``, so the group sum is always >= 1. Statically
+    unrolled over head lane-slices (a ``[T,E]->[T,H,D]`` reshape does
+    not lower in Mosaic; D-lane slices do), with the sum and divide kept
+    per slice too — no cross-head matmul needed.
     """
     e = x.shape[-1]
-    ex = jnp.exp(x - jnp.max(x, axis=-1, keepdims=True))
-    gsum = jax.lax.dot_general(
-        ex,
-        _block_diag_mask(e, e // n_head),
-        (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32,
-    )
-    return ex / gsum
+    d = e // n_head
+    parts = []
+    for i in range(n_head):
+        s = x[:, i * d : (i + 1) * d]
+        ex = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+        parts.append(ex / jnp.sum(ex, axis=-1, keepdims=True))
+    return jnp.concatenate(parts, axis=-1)
 
 
 def _round_up(n: int, m: int) -> int:

@@ -36,7 +36,8 @@ from jax.experimental import pallas as pl
 Array = jax.Array
 
 TILE = 256
-VMEM_WEIGHT_BUDGET = 10 * 1024 * 1024  # leave room for activations
+VMEM_BYTES = 16 * 1024 * 1024  # per-core VMEM on current TPUs
+VMEM_RESERVE = 2 * 1024 * 1024  # scheduler / spill slack
 
 
 def _interpret_default() -> bool:
@@ -51,9 +52,30 @@ def _interpret_default() -> bool:
     )
 
 
-def fits_vmem(kernels: list[Array]) -> bool:
-    """Whether the full expert weight set fits the kernel's VMEM budget."""
-    return sum(4 * k.size for k in kernels) <= VMEM_WEIGHT_BUDGET
+def fits_vmem(kernels: list[Array], biases: list[Array] | None = None) -> bool:
+    """Whether the kernel's whole working set fits the VMEM budget.
+
+    Budgets the resident weights AND biases plus the per-tile activation
+    working set (double-buffered x/scores/out tiles, the live hidden
+    buffer and its matmul input, the f32 accumulator), not just the
+    kernels — a large hidden_dim can fail to compile or spill even when
+    the weights alone fit.
+    """
+    weights = sum(4 * k.size for k in kernels)
+    if biases is not None:
+        weights += sum(4 * b.size for b in biases)
+    else:
+        weights += sum(4 * k.shape[-1] * k.shape[0] for k in kernels)
+    d_in = kernels[0].shape[1]
+    d_out = kernels[-1].shape[-1]
+    n_expert = kernels[0].shape[0]
+    widest = max(k.shape[-1] for k in kernels)
+    # Live [TILE, *] f32 buffers: x + scores + out (x2 for pipeline
+    # double-buffering), hidden in + hidden out, accumulator.
+    act = 4 * TILE * (
+        2 * (d_in + n_expert + d_out) + 2 * widest + d_out
+    )
+    return weights + act <= VMEM_BYTES - VMEM_RESERVE
 
 
 def _ffn_kernel(x_ref, s_ref, *refs, n_expert: int, n_linears: int):

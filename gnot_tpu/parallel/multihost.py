@@ -44,7 +44,11 @@ def initialize(
     environment auto-detection (TPU pods, SLURM, Open MPI); if the
     process is not part of a managed multi-process job the attempt
     fails and this degrades to a single-process no-op, so drivers can
-    call it unconditionally."""
+    call it unconditionally. If the environment LOOKS like a managed
+    multi-process job (SLURM/Open MPI/TPU-pod env vars present) the
+    failure re-raises instead: silently degrading there would launch p
+    duplicate single-process trainings racing on the same checkpoint
+    and metrics paths."""
     if (
         coordinator_address is None
         and num_processes is None
@@ -52,14 +56,45 @@ def initialize(
     ):
         try:
             jax.distributed.initialize()
-        except (RuntimeError, ValueError):
-            return  # not a managed multi-process environment
+        except (RuntimeError, ValueError) as exc:
+            managed = _managed_job_hint()
+            if managed:
+                raise RuntimeError(
+                    f"jax.distributed auto-detection failed but the "
+                    f"environment advertises a multi-process job "
+                    f"({managed}); refusing to degrade to p independent "
+                    f"single-process runs"
+                ) from exc
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "jax.distributed.initialize() auto-detection failed (%s); "
+                "continuing single-process",
+                exc,
+            )
+            return
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
     )
+
+
+def _managed_job_hint() -> str | None:
+    """Name the env evidence of a multi-process job, or None."""
+    import os
+
+    ntasks = os.environ.get("SLURM_NTASKS")
+    if ntasks and int(ntasks) > 1:
+        return f"SLURM_NTASKS={ntasks}"
+    world = os.environ.get("OMPI_COMM_WORLD_SIZE")
+    if world and int(world) > 1:
+        return f"OMPI_COMM_WORLD_SIZE={world}"
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES")
+    if hosts and "," in hosts:
+        return f"TPU_WORKER_HOSTNAMES={hosts}"
+    return None
 
 
 def make_hybrid_mesh(cfg: MeshConfig) -> Mesh:

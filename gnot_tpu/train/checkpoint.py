@@ -4,19 +4,32 @@ The reference saves only ``state_dict`` of the best-eval model to a
 hardcoded ``best_model.pth`` and has no load path at all
 (``/root/reference/main.py:149-151``; SURVEY.md §5). Here:
 
-* ``best/`` — best-eval model (reference behavior), full train state;
-* ``latest/`` — periodic checkpoint for preemption-safe ``--resume``
-  (TPU VMs are preemptible; resumability is the minimal failure-recovery
-  story a TPU framework needs);
-* JSON sidecar with ``{epoch, best_metric, step}``.
+* ``best.<epoch>/`` — best-eval model (reference behavior), full train
+  state;
+* ``latest.<epoch>/`` — periodic checkpoint for preemption-safe
+  ``--resume`` (TPU VMs are preemptible; resumability is the minimal
+  failure-recovery story a TPU framework needs);
+* ``best.json`` / ``latest.json`` sidecars with
+  ``{epoch, best_metric, dir}`` naming the committed directory.
+
+Crash-safety protocol: each save goes to a fresh epoch-suffixed
+directory (never overwriting the previous committed one), and the meta
+sidecar is written — atomically, via tmp + ``os.replace``, by process 0
+only — strictly AFTER the async commit finalizes (at the next
+wait/save). A crash anywhere in the window therefore leaves the old
+meta pointing at the old, still-intact checkpoint; superseded
+directories are pruned only once the new one is committed and named by
+the sidecar.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import shutil
 from typing import Any
 
+import jax
 import orbax.checkpoint as ocp
 
 
@@ -25,23 +38,53 @@ class Checkpointer:
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self._ckptr = ocp.StandardCheckpointer()
+        # Saves kicked off but whose meta is not yet committed:
+        # (name, meta dict, committed dir basename).
+        self._pending: list[tuple[str, dict, str]] = []
+
+    # -- commit protocol ---------------------------------------------------
+
+    def _flush_pending(self) -> None:
+        """Commit sidecars for finished saves; prune superseded dirs.
+
+        Call only after ``wait_until_finished()``: at that point every
+        pending save's directory is finalized on disk.
+        """
+        if jax.process_index() == 0:
+            for name, meta, dirname in self._pending:
+                meta_path = os.path.join(self.directory, f"{name}.json")
+                tmp = f"{meta_path}.tmp"
+                with open(tmp, "w") as f:
+                    json.dump(meta, f)
+                os.replace(tmp, meta_path)
+                for d in os.listdir(self.directory):
+                    full = os.path.join(self.directory, d)
+                    # d == name: a pre-upgrade unsuffixed checkpoint dir.
+                    if (
+                        (d == name or d.startswith(f"{name}."))
+                        and d != dirname
+                        and os.path.isdir(full)
+                    ):
+                        shutil.rmtree(full, ignore_errors=True)
+        self._pending.clear()
 
     def _save(self, name: str, state: Any, epoch: int, best_metric: float) -> None:
-        """Async save: waits for the PREVIOUS save, then returns while
-        this one commits in the background — training overlaps the
-        checkpoint write. Orbax finalizes atomically (tmp dir + rename),
-        so a crash mid-save never leaves a torn checkpoint at ``path``;
-        ``_restore`` tolerates a meta file whose directory never landed."""
-        path = os.path.join(self.directory, name)
+        """Async save: waits for the PREVIOUS save (then publishes its
+        sidecar), kicks off this one, and returns while it commits in
+        the background — training overlaps the checkpoint write."""
         self._ckptr.wait_until_finished()
-        self._ckptr.save(path, state, force=True)
-        meta = {"epoch": epoch, "best_metric": best_metric}
-        with open(os.path.join(self.directory, f"{name}.json"), "w") as f:
-            json.dump(meta, f)
+        self._flush_pending()
+        dirname = f"{name}.{epoch}"
+        self._ckptr.save(os.path.join(self.directory, dirname), state, force=True)
+        self._pending.append(
+            (name, {"epoch": epoch, "best_metric": best_metric, "dir": dirname}, dirname)
+        )
 
     def wait(self) -> None:
-        """Block until any in-flight save has committed."""
+        """Block until any in-flight save has committed (and publish its
+        sidecar)."""
         self._ckptr.wait_until_finished()
+        self._flush_pending()
 
     def save_best(self, state: Any, epoch: int, best_metric: float) -> None:
         self._save("best", state, epoch, best_metric)
@@ -49,17 +92,20 @@ class Checkpointer:
     def save_latest(self, state: Any, epoch: int, best_metric: float) -> None:
         self._save("latest", state, epoch, best_metric)
 
+    # -- restore -----------------------------------------------------------
+
     def _restore(self, name: str, target: Any):
-        self._ckptr.wait_until_finished()
-        path = os.path.join(self.directory, name)
-        meta_path = f"{path}.json"
-        # Require both the meta sidecar and the committed directory: an
-        # async save interrupted before finalize leaves meta without path.
-        if not os.path.exists(meta_path) or not os.path.isdir(path):
+        self.wait()
+        meta_path = os.path.join(self.directory, f"{name}.json")
+        if not os.path.exists(meta_path):
             return None
-        state = self._ckptr.restore(path, target)
         with open(meta_path) as f:
             meta = json.load(f)
+        # Older checkpoints used an unsuffixed directory and no "dir" key.
+        path = os.path.join(self.directory, meta.get("dir", name))
+        if not os.path.isdir(path):
+            return None
+        state = self._ckptr.restore(path, target)
         return state, int(meta["epoch"]), float(meta["best_metric"])
 
     def restore_latest(self, target: Any):

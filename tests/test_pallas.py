@@ -51,6 +51,27 @@ def test_fused_matches_einsum_cross(n_funcs, masked, l, lk):
     np.testing.assert_allclose(np.asarray(qs), np.asarray(qs_ref), rtol=1e-5, atol=1e-6)
 
 
+def test_group_softmax_outlier_head_no_nan():
+    """One head's logits spiking ~200 above another's must not underflow
+    the quiet head's group to 0/0 (the max is per group, not per row)."""
+    b, h, l, lk, e = 1, 4, 16, 16, 32
+    keys = jax.random.split(jax.random.key(7), 3)
+    q = _rand(keys[0], b, l, e)
+    k = _rand(keys[1], 1, b, lk, e)
+    v = _rand(keys[2], 1, b, lk, e)
+    # Spike head 0's lanes (first e//h lanes) of both q and k.
+    q = q.at[..., : e // h].add(200.0)
+    k = k.at[..., : e // h].add(200.0)
+    mask = jnp.ones((1, b, lk), jnp.float32)
+
+    out, qs = fused_nla(q, k, v, mask, h)
+    assert np.isfinite(np.asarray(out)).all()
+    assert np.isfinite(np.asarray(qs)).all()
+    out_ref, qs_ref = _reference_impl(q, k, v, mask, h)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_ref), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(qs), np.asarray(qs_ref), rtol=1e-5, atol=1e-6)
+
+
 def test_fused_grads_match_einsum():
     b, h, l, lk, e = 2, 2, 12, 10, 16
     keys = jax.random.split(jax.random.key(1), 4)

@@ -1,6 +1,8 @@
 """End-to-end training tests: loss decreases, eval is deterministic,
 checkpoint round-trips, CLI runs."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -72,6 +74,41 @@ def test_checkpoint_resume(tmp_path):
     leaves2 = [np.asarray(x) for x in __import__("jax").tree.leaves(t2.state.params)]
     for a, b in zip(leaves1, leaves2):
         np.testing.assert_array_equal(a, b)
+
+
+def test_checkpoint_crash_window_keeps_old_state(tmp_path):
+    """A save whose sidecar never got published (crash between commit
+    and the next wait) must leave the previous checkpoint restorable."""
+    from gnot_tpu.train.checkpoint import Checkpointer
+
+    cfg, mc, train, test = small_setup(epochs=1)
+    t = Trainer(cfg, mc, train, test)
+    t.initialize()
+
+    ck = Checkpointer(str(tmp_path / "ckpt"))
+    ck.save_latest(t.state, epoch=1, best_metric=0.5)
+    ck.wait()  # epoch-1 committed + sidecar published
+    # Second save commits but its sidecar is never published ("crash"
+    # before the next wait): a fresh Checkpointer must restore epoch 1.
+    ck.save_latest(t.state, epoch=2, best_metric=0.4)
+    ck._ckptr.wait_until_finished()  # data committed, sidecar NOT flushed
+
+    ck2 = Checkpointer(str(tmp_path / "ckpt"))
+    restored = ck2.restore_latest(t.state)
+    assert restored is not None
+    _, epoch, best = restored
+    assert (epoch, best) == (1, 0.5)
+
+    # After a proper wait the new save becomes the restore target and the
+    # superseded directory is pruned.
+    ck.wait()
+    restored = Checkpointer(str(tmp_path / "ckpt")).restore_latest(t.state)
+    assert restored is not None and restored[1:] == (2, 0.4)
+    dirs = sorted(
+        d for d in os.listdir(tmp_path / "ckpt")
+        if (tmp_path / "ckpt" / d).is_dir()
+    )
+    assert dirs == ["latest.2"]
 
 
 def test_cli_smoke(capsys):
