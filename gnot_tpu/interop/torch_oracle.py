@@ -101,6 +101,74 @@ def _stacked_mlp(sd, prefixes: list[str], num_layers: int) -> dict:
     }
 
 
+def flax_to_state_dict(params, cfg: ModelConfig) -> dict:
+    """Inverse of ``state_dict_to_flax``: map this framework's params to
+    a reference-compatible torch ``state_dict`` (numpy tensors wrapped
+    as ``torch.Tensor``). Lets models trained here run under the
+    reference's torch code — interop in both directions."""
+    import torch
+
+    out: dict = {}
+
+    def put_linear(prefix: str, leaf: dict) -> None:
+        out[f"{prefix}.weight"] = torch.from_numpy(
+            np.asarray(leaf["kernel"]).T.copy()
+        )
+        out[f"{prefix}.bias"] = torch.from_numpy(np.asarray(leaf["bias"]).copy())
+
+    def put_mlp(prefix: str, tree: dict, num_layers: int) -> None:
+        for i in range(num_layers + 1):
+            put_linear(f"{prefix}.layers.{2 * i}", tree[f"dense_{i}"])
+
+    def put_stacked_mlp(prefixes: list[str], tree: dict, num_layers: int) -> None:
+        for s, prefix in enumerate(prefixes):
+            for i in range(num_layers + 1):
+                leaf = tree[f"dense_{i}"]
+                put_linear(
+                    f"{prefix}.layers.{2 * i}",
+                    {"kernel": np.asarray(leaf["kernel"])[s], "bias": np.asarray(leaf["bias"])[s]},
+                )
+
+    n = cfg.n_mlp_num_layers
+    put_mlp("x", params["x_embed"], n)
+    put_mlp("gating", params["gating"], n)
+    put_mlp("out", params["out_mlp"], n)
+    if cfg.n_input_functions > 0:
+        put_stacked_mlp(
+            [f"input_func_mlps.{f}" for f in range(cfg.n_input_functions)],
+            params["input_func_mlps"],
+            n,
+        )
+    for b in range(cfg.n_attn_layers):
+        pb, blk = f"blocks.{b}", params[f"block_{b}"]
+        cross = blk["cross_attention"]
+        put_linear(f"{pb}.cross_attention.query", cross["query"])
+        put_linear(f"{pb}.cross_attention.fc_out", cross["fc_out"])
+        if cfg.n_input_functions > 0:
+            for f in range(cfg.n_input_functions):
+                for kind in ("key", "value"):
+                    leaf = cross[kind]
+                    put_linear(
+                        f"{pb}.cross_attention.{kind}.{f}",
+                        {
+                            "kernel": np.asarray(leaf["kernel"])[f],
+                            "bias": np.asarray(leaf["bias"])[f],
+                        },
+                    )
+        else:
+            put_linear(f"{pb}.cross_attention.key", cross["key"])
+            put_linear(f"{pb}.cross_attention.value", cross["value"])
+        for k in ("query", "key", "value", "fc_out"):
+            put_linear(f"{pb}.self_attention.{k}", blk["self_attention"][k])
+        for ffn in ("ffn1", "ffn2"):
+            put_stacked_mlp(
+                [f"{pb}.{ffn}.{e}" for e in range(cfg.n_expert)],
+                blk[ffn]["experts"],
+                n,
+            )
+    return out
+
+
 def state_dict_to_flax(state_dict, cfg: ModelConfig) -> dict:
     """Map a reference torch GNOT state_dict to this framework's params."""
     sd = state_dict

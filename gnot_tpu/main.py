@@ -70,6 +70,10 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--checkpoint_every", type=int, default=0)
     p.add_argument("--metrics_path", type=str, default="")
+    p.add_argument(
+        "--log_every", type=int, default=0,
+        help="per-step JSONL metric cadence (0 = per-epoch only; needs --metrics_path)"
+    )
     p.add_argument("--profile_dir", type=str, default="")
     p.add_argument("--no_bucket", action="store_true", help="pad to per-batch max (parity)")
     p.add_argument(
@@ -102,6 +106,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "train.resume": args.resume,
             "train.checkpoint_every": args.checkpoint_every,
             "train.metrics_path": args.metrics_path,
+            "train.log_every": args.log_every,
             "train.profile_dir": args.profile_dir,
             "train.seed": args.seed,
             "train.distributed": args.distributed,
@@ -201,7 +206,10 @@ def run_torch_backend(args: argparse.Namespace) -> float:
 
 
 def main(argv=None) -> float:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.log_every and not args.metrics_path:
+        parser.error("--log_every needs --metrics_path (step records are JSONL-only)")
     if args.backend == "torch":
         return run_torch_backend(args)
 
@@ -254,7 +262,17 @@ def main(argv=None) -> float:
             train_samples = multihost.shard_samples(train_samples)
             test_samples = multihost.shard_samples(test_samples)
 
-    sink = MetricsSink(cfg.train.metrics_path) if cfg.train.metrics_path else None
+    # Metrics are process-0-only: on multi-process runs every host
+    # computes the same global metrics, and p writers on one JSONL path
+    # would interleave duplicates (and the per-step float() sync would
+    # hit every host).
+    import jax
+
+    sink = (
+        MetricsSink(cfg.train.metrics_path)
+        if cfg.train.metrics_path and jax.process_index() == 0
+        else None
+    )
     checkpointer = None
     if cfg.train.checkpoint_dir:
         from gnot_tpu.train.checkpoint import Checkpointer

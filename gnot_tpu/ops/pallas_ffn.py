@@ -78,6 +78,36 @@ def fits_vmem(kernels: list[Array], biases: list[Array] | None = None) -> bool:
     return weights + act <= VMEM_BYTES - VMEM_RESERVE
 
 
+def _erf_f32(x: Array) -> Array:
+    """float32 erf as a rational polynomial (Eigen's
+    ``generic_fast_erf_float``, ~1 ulp over the clamped range — the same
+    approximation XLA lowers ``erf`` to for f32). Mosaic TPU has no
+    ``erf``/``erfc`` primitive, so the exact-GELU inside the kernel
+    needs its own erf."""
+    x = jnp.clip(x, -3.832506856900711, 3.832506856900711)
+    z = x * x
+    alpha = jnp.float32(-2.72614225801306e-10)
+    alpha = alpha * z + jnp.float32(2.77068142495902e-08)
+    alpha = alpha * z + jnp.float32(-2.10102402082508e-06)
+    alpha = alpha * z + jnp.float32(-5.69250639462346e-05)
+    alpha = alpha * z + jnp.float32(-7.34990630326855e-04)
+    alpha = alpha * z + jnp.float32(-2.95459980854025e-03)
+    alpha = alpha * z + jnp.float32(-1.60960333262415e-02)
+    beta = jnp.float32(-1.45660718464996e-05)
+    beta = beta * z + jnp.float32(-2.13374055278905e-04)
+    beta = beta * z + jnp.float32(-1.68282697438203e-03)
+    beta = beta * z + jnp.float32(-7.37332916720468e-03)
+    beta = beta * z + jnp.float32(-1.42647390514189e-02)
+    return x * alpha / beta
+
+
+def _gelu_exact(x: Array) -> Array:
+    """Exact (erf-based) GELU — torch ``nn.GELU()`` default semantics
+    (reference model.py MLP), usable inside Mosaic kernels."""
+    inv_sqrt2 = jnp.float32(0.7071067811865476)
+    return 0.5 * x * (1.0 + _erf_f32(x * inv_sqrt2))
+
+
 def _ffn_kernel(x_ref, s_ref, *refs, n_expert: int, n_linears: int):
     k_refs = refs[:n_linears]
     b_refs = refs[n_linears : 2 * n_linears]
@@ -98,7 +128,7 @@ def _ffn_kernel(x_ref, s_ref, *refs, n_expert: int, n_linears: int):
                 + b_refs[i][e].astype(jnp.float32)  # [1, out] row broadcast
             )
             if i < n_linears - 1:
-                h = jax.nn.gelu(h, approximate=False)
+                h = _gelu_exact(h)
         acc = acc + scores[:, e][:, None] * h
     out_ref[0] = acc.astype(out_ref.dtype)
 
@@ -142,7 +172,10 @@ def _ffn_call(x, scores, kernels, biases, interpret: bool):
 def _reference_impl(x, scores, kernels, biases):
     """Einsum/jnp form with the kernel's f32 semantics (backward source
     + test oracle). Matches the XLA GatedExpertFfn math
-    (models/layers.py): per-expert MLP, gate-weighted sum."""
+    (models/layers.py) — per-expert MLP, gate-weighted sum — with the
+    kernel's polynomial erf-GELU (``_gelu_exact``), so forward kernel
+    and backward recompute are the same function (the polynomial is
+    within ~4e-7 of ``jax.nn.gelu(approximate=False)``)."""
     h = jnp.broadcast_to(
         x[None].astype(jnp.float32), (kernels[0].shape[0], *x.shape)
     )  # [E, B, L, Din]
@@ -153,7 +186,7 @@ def _reference_impl(x, scores, kernels, biases):
             + bb.astype(jnp.float32)[:, None, None, :]
         )
         if i < n - 1:
-            h = jax.nn.gelu(h, approximate=False)
+            h = _gelu_exact(h)
     out = jnp.einsum("eblo,ble->blo", h, scores.astype(jnp.float32))
     return out.astype(x.dtype)
 

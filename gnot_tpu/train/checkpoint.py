@@ -75,6 +75,19 @@ class Checkpointer:
         self._ckptr.wait_until_finished()
         self._flush_pending()
         dirname = f"{name}.{epoch}"
+        # Resume-replay can revisit an epoch whose directory the
+        # published sidecar already names; force=True would delete that
+        # committed checkpoint at kickoff, so uniquify instead — the old
+        # one stays restorable until the new commit's sidecar lands.
+        meta_path = os.path.join(self.directory, f"{name}.json")
+        published = None
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                published = json.load(f).get("dir")
+        tick = 0
+        while dirname == published:
+            tick += 1
+            dirname = f"{name}.{epoch}r{tick}"
         self._ckptr.save(os.path.join(self.directory, dirname), state, force=True)
         self._pending.append(
             (name, {"epoch": epoch, "best_metric": best_metric, "dir": dirname}, dirname)

@@ -55,11 +55,10 @@ def make_optimizer(cfg: OptimConfig, learning_rate) -> optax.GradientTransformat
     return tx
 
 
-def batch_loss(model: GNOT, params, batch: MeshBatch, loss_name: str) -> jax.Array:
-    """Forward + per-graph pooled loss. The loss is always masked — the
-    reference unpads before pooling (main.py:89), so padding never enters
-    the loss even in parity mode."""
-    preds = model.apply(
+def apply_batch(model: GNOT, params, batch: MeshBatch) -> jax.Array:
+    """The one forward-on-a-MeshBatch invocation (shared by loss, init
+    and inference paths)."""
+    return model.apply(
         {"params": params},
         batch.coords,
         batch.theta,
@@ -67,6 +66,13 @@ def batch_loss(model: GNOT, params, batch: MeshBatch, loss_name: str) -> jax.Arr
         node_mask=batch.node_mask,
         func_mask=batch.func_mask,
     )
+
+
+def batch_loss(model: GNOT, params, batch: MeshBatch, loss_name: str) -> jax.Array:
+    """Forward + per-graph pooled loss. The loss is always masked — the
+    reference unpads before pooling (main.py:89), so padding never enters
+    the loss even in parity mode."""
+    preds = apply_batch(model, params, batch)
     return LOSSES[loss_name](preds, batch.y, batch.node_mask)
 
 
@@ -207,6 +213,7 @@ class Trainer:
         self.metrics_sink = metrics_sink
         self.checkpointer = checkpointer
         self.state: TrainState | None = None
+        self._forward = None  # jitted inference fn, built on first predict()
         self.best_metric = float("inf")
         self.start_epoch = 0
         # Host-side mirror of state.step: reading the device counter every
@@ -261,6 +268,51 @@ class Trainer:
         # same full-test metric — no cross-host aggregation needed.
         return float(np.mean(metrics))
 
+    def predict(self, samples) -> list[np.ndarray]:
+        """Inference: per-sample UNPADDED model outputs ``[n_i, out_dim]``.
+
+        A capability the reference lacks entirely (it writes
+        ``best_model.pth`` and never reads it back, main.py:149-151;
+        there is no inference entry point). Batches are padded/masked
+        like eval; padding rows are sliced off before returning, so
+        callers see exactly the ragged mesh they passed in. On a mesh,
+        the tail batch is filled with repeats of the last sample so
+        every batch shards evenly; the repeats are dropped on return.
+        """
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "predict() is single-process only (outputs would span "
+                "non-addressable devices); gather predictions per host"
+            )
+        if self.state is None:
+            self.initialize()
+        if self._forward is None:
+            model = self.model
+            self._forward = jax.jit(
+                lambda params, batch: apply_batch(model, params, batch)
+            )
+
+        samples = list(samples)
+        n_real = len(samples)
+        bs = self.config.data.batch_size
+        if self.mesh is not None and n_real % bs:
+            samples = samples + [samples[-1]] * (bs - n_real % bs)
+        loader = Loader(
+            samples,
+            bs,
+            bucket=self.config.data.bucket,
+            pad_nodes=self.train_loader.pad_nodes,
+            pad_funcs=self.train_loader.pad_funcs,
+        )
+        outs: list[np.ndarray] = []
+        for batch in loader:
+            out = np.asarray(
+                self._forward(self.state.params, self._device_batch(batch))
+            )
+            lengths = np.sum(np.asarray(batch.node_mask), axis=1).astype(int)
+            outs.extend(out[i, :n] for i, n in enumerate(lengths))
+        return outs[:n_real]
+
     def evaluate_from_checkpoint(self) -> float:
         """Restore the best checkpoint and run eval only — the load path
         the reference never had (it writes best_model.pth and never
@@ -303,6 +355,19 @@ class Trainer:
                         self.host_step += 1
                         losses.append(loss)
                         points += batch.n_real_points
+                        if (
+                            self.metrics_sink is not None
+                            and cfg.train.log_every
+                            and self.host_step % cfg.train.log_every == 0
+                        ):
+                            # float(loss) syncs; per-step logging is
+                            # opt-in and meant for coarse cadences.
+                            self.metrics_sink.log(
+                                step=self.host_step,
+                                epoch=epoch,
+                                loss=float(np.asarray(loss)),
+                                lr=lr,
+                            )
                 train_loss = float(np.mean([np.asarray(l) for l in losses]))
                 dt = time.perf_counter() - t0
                 # Reference's exact console line (main.py:105).

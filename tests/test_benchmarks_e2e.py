@@ -1,0 +1,114 @@
+"""End-to-end trainer smoke over ALL five benchmark configs
+(BASELINE.json `configs`): each exercises a different structural stress
+(regular grid, ~1k mesh, ragged lengths + 2 output channels, multiple
+input functions, 3D coords). Tiny models, 2 epochs — the point is that
+the full pipeline (synthetic data -> collate/mask -> model -> loss ->
+AdamW -> eval) runs and produces finite, improvable losses everywhere.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from gnot_tpu.config import ModelConfig, make_config
+from gnot_tpu.data import datasets
+from gnot_tpu.train.trainer import Trainer
+
+TINY = dict(
+    n_attn_layers=1,
+    n_attn_hidden_dim=16,
+    n_mlp_num_layers=1,
+    n_mlp_hidden_dim=16,
+    n_input_hidden_dim=16,
+    n_expert=2,
+    n_head=2,
+)
+
+
+def tiny_setup(name: str, n_train=8, n_test=4, epochs=2):
+    cfg = make_config(**{
+        "data.synthetic": name,
+        "data.n_train": n_train,
+        "data.n_test": n_test,
+        "train.epochs": epochs,
+    })
+    # Keep heatsink3d point counts test-sized.
+    gen_kwargs = {"heatsink3d": {"base_points": 256}, "elasticity": {"base_points": 128},
+                  "inductor2d": {"base_points": 128}, "ns2d": {"n_points": 128},
+                  "darcy2d": {"grid_n": 8}}[name]
+    train = datasets.SYNTHETIC[name](n_train, seed=0, **gen_kwargs)
+    test = datasets.SYNTHETIC[name](n_test, seed=1, **gen_kwargs)
+    mc = ModelConfig(**TINY, **datasets.infer_model_dims(train))
+    return cfg, mc, train, test
+
+
+@pytest.mark.parametrize("name", sorted(datasets.SYNTHETIC))
+def test_benchmark_config_trains(name):
+    cfg, mc, train, test = tiny_setup(name)
+    trainer = Trainer(cfg, mc, train, test)
+    best = trainer.fit()
+    assert np.isfinite(best), f"{name}: non-finite best metric"
+
+
+def test_predict_returns_unpadded_per_sample_outputs():
+    cfg, mc, train, test = tiny_setup("elasticity")  # ragged lengths
+    trainer = Trainer(cfg, mc, train, test)
+    trainer.initialize()
+    outs = trainer.predict(test)
+    assert len(outs) == len(test)
+    for o, s in zip(outs, test):
+        assert o.shape == (s.coords.shape[0], s.y.shape[1])
+        assert np.all(np.isfinite(o))
+
+
+def test_predict_matches_direct_apply():
+    """predict()'s padded/masked batching must not change the numbers:
+    compare against a direct single-sample forward."""
+    import jax
+
+    cfg, mc, train, test = tiny_setup("elasticity")
+    trainer = Trainer(cfg, mc, train, test)
+    trainer.initialize()
+    outs = trainer.predict(test[:1])
+
+    from gnot_tpu.data.batch import collate
+
+    b = collate(test[:1], bucket=False)
+    direct = trainer.model.apply(
+        {"params": trainer.state.params},
+        b.coords,
+        b.theta,
+        b.funcs,
+        node_mask=b.node_mask,
+        func_mask=b.func_mask,
+    )
+    np.testing.assert_allclose(
+        outs[0], np.asarray(direct)[0, : test[0].coords.shape[0]],
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_log_every_writes_step_records(tmp_path):
+    from gnot_tpu.utils.metrics import MetricsSink
+
+    cfg, mc, train, test = tiny_setup("darcy2d")
+    cfg = dataclasses.replace(
+        cfg,
+        train=dataclasses.replace(
+            cfg.train,
+            log_every=1,
+            metrics_path=str(tmp_path / "m.jsonl"),
+        ),
+    )
+    sink = MetricsSink(cfg.train.metrics_path)
+    Trainer(cfg, mc, train, test, metrics_sink=sink).fit()
+    sink.close()
+
+    import json
+
+    records = [json.loads(l) for l in open(tmp_path / "m.jsonl")]
+    step_records = [r for r in records if "step" in r]
+    n_steps = cfg.train.epochs * ((len(train) + 3) // 4)
+    assert len(step_records) == n_steps
+    assert all(np.isfinite(r["loss"]) for r in step_records)

@@ -119,3 +119,24 @@ def test_training_run_parity_vs_torch():
     assert len(t_leaves) == len(j_leaves)
     for t, j in zip(t_leaves, j_leaves):
         np.testing.assert_allclose(np.asarray(j), np.asarray(t), rtol=2e-3, atol=1e-4)
+
+
+def test_flax_to_state_dict_roundtrip():
+    """flax -> torch -> flax is the identity, and the exported
+    state_dict loads into the reference torch model."""
+    import torch
+
+    from gnot_tpu.interop.torch_oracle import (
+        build_reference_model,
+        flax_to_state_dict,
+        state_dict_to_flax,
+    )
+
+    torch.manual_seed(3)
+    tmodel = build_reference_model(MC)
+    params = state_dict_to_flax(tmodel.state_dict(), MC)
+    sd = flax_to_state_dict(params, MC)
+    tmodel.load_state_dict(sd)  # raises on any missing/unexpected key
+    back = state_dict_to_flax(tmodel.state_dict(), MC)
+    for a, b in zip(jax.tree.leaves(params), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

@@ -139,3 +139,33 @@ def test_parity_schedule_bug_lr_stays_on_warmup():
     correct = OptimConfig(parity_schedule_bug=False)
     lr_fn2 = make_lr_fn(correct, steps_per_epoch=250, epochs=100)
     assert lr_fn2(24999, 0) < 1e-6  # per-step schedule reaches the floor
+
+
+def test_checkpoint_resume_replay_same_epoch_keeps_committed_dir(tmp_path):
+    """Saving the same (name, epoch) the published sidecar names must not
+    delete that committed directory at kickoff — resume-replay hits this
+    when a re-run epoch improves the metric again."""
+    from gnot_tpu.train.checkpoint import Checkpointer
+
+    cfg, mc, train, test = small_setup(epochs=1)
+    t = Trainer(cfg, mc, train, test)
+    t.initialize()
+
+    ck = Checkpointer(str(tmp_path / "ckpt"))
+    ck.save_best(t.state, epoch=7, best_metric=0.5)
+    ck.wait()  # best.7 committed, sidecar published
+
+    # Replay epoch 7 (e.g. after resume from latest.6); the new save
+    # must land in a fresh dir while best.7 stays restorable.
+    ck.save_best(t.state, epoch=7, best_metric=0.4)
+    assert (tmp_path / "ckpt" / "best.7").is_dir()  # old one intact
+    restored = Checkpointer(str(tmp_path / "ckpt")).restore_best(t.state)
+    assert restored is not None and restored[1:] == (7, 0.5)
+
+    ck.wait()
+    restored = Checkpointer(str(tmp_path / "ckpt")).restore_best(t.state)
+    assert restored is not None and restored[1:] == (7, 0.4)
+    dirs = sorted(
+        d for d in os.listdir(tmp_path / "ckpt") if (tmp_path / "ckpt" / d).is_dir()
+    )
+    assert dirs == ["best.7r1"]
