@@ -42,6 +42,10 @@ class ModelConfig:
     ffn_impl: str = "xla"
     # Compute dtype for the encoder stack; params stay float32.
     dtype: str = "float32"
+    # Rematerialize each attention block in backward (jax.checkpoint):
+    # trades ~1 extra forward of FLOPs for O(n_attn_layers) less
+    # activation memory — the lever for long point clouds on one chip.
+    remat: bool = False
 
     def __post_init__(self) -> None:
         if self.n_attn_hidden_dim % self.n_head:

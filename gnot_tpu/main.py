@@ -59,6 +59,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="pallas: VMEM-resident fused expert FFN (single-device / DP)"
     )
     p.add_argument("--dtype", type=str, default="float32", choices=["float32", "bfloat16"])
+    p.add_argument(
+        "--remat", action="store_true",
+        help="rematerialize attention blocks in backward (less activation "
+             "memory, ~1 extra forward of FLOPs — for long point clouds)"
+    )
+    p.add_argument(
+        "--export_torch", type=str, default="",
+        help="after the run, save params as a reference-compatible torch "
+             "state_dict .pth (best checkpoint when --checkpoint_dir is "
+             "set, else the final weights)"
+    )
     p.add_argument("--loss", type=str, default="rel_l2", choices=["rel_l2", "mse"])
     p.add_argument("--schedule", type=str, default="parity", choices=["parity", "per_step"],
                    help="parity: per-epoch OneCycle stepping (the reference bug); per_step: correct")
@@ -133,6 +144,7 @@ def model_config(cfg: Config, args: argparse.Namespace, train_samples) -> ModelC
         attention_impl=args.attention_impl,
         ffn_impl=args.ffn_impl,
         dtype=args.dtype,
+        remat=args.remat,
         **dims,
     )
 
@@ -202,6 +214,9 @@ def run_torch_backend(args: argparse.Namespace) -> float:
         print("-----------------------------------")
         best = min(best, res)
     print(f"\nBest Test Metric: {best}")
+    if args.export_torch:
+        torch.save(model.state_dict(), args.export_torch)
+        print(f"Exported torch state_dict to {args.export_torch}")
     return best
 
 
@@ -282,8 +297,43 @@ def main(argv=None) -> float:
         cfg, mc, train_samples, test_samples, metrics_sink=sink, checkpointer=checkpointer
     )
     if args.eval_only:
-        return trainer.evaluate_from_checkpoint()
-    return trainer.fit()
+        result = trainer.evaluate_from_checkpoint()
+    else:
+        result = trainer.fit()
+
+    if args.export_torch:
+        # evaluate_from_checkpoint already restored the best state;
+        # don't pay a second Orbax read for it.
+        _export_torch(trainer, mc, args.export_torch, restore_best=not args.eval_only)
+    return result
+
+
+def _export_torch(trainer, mc, path: str, *, restore_best: bool = True) -> None:
+    """Save the run's params as a reference-compatible torch state_dict
+    (the best checkpoint when one exists, else the final weights)."""
+    import jax
+    import torch
+
+    from gnot_tpu.interop.torch_oracle import flax_to_state_dict
+
+    state = trainer.state
+    if restore_best and trainer.checkpointer is not None:
+        restored = trainer.checkpointer.restore_best(state)
+        if restored is not None:
+            state = restored[0]
+    if jax.process_count() > 1:
+        # Sharded params may span non-addressable devices; gather the
+        # global values onto every host (collective — all processes
+        # must call it), then only process 0 writes.
+        from jax.experimental import multihost_utils
+
+        params = multihost_utils.process_allgather(state.params)
+        if jax.process_index() != 0:
+            return
+    else:
+        params = jax.device_get(state.params)
+    torch.save(flax_to_state_dict(params, mc), path)
+    print(f"Exported torch state_dict to {path}")
 
 
 if __name__ == "__main__":

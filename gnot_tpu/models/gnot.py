@@ -174,8 +174,9 @@ class GNOT(nn.Module):
         else:
             funcs = None
 
+        block_cls = nn.remat(HNABlock) if cfg.remat else HNABlock
         for i in range(cfg.n_attn_layers):
-            query = HNABlock(
+            query = block_cls(
                 cfg.n_attn_hidden_dim,
                 cfg.n_mlp_num_layers,
                 cfg.n_mlp_hidden_dim,

@@ -112,3 +112,35 @@ def test_log_every_writes_step_records(tmp_path):
     n_steps = cfg.train.epochs * ((len(train) + 3) // 4)
     assert len(step_records) == n_steps
     assert all(np.isfinite(r["loss"]) for r in step_records)
+
+
+def test_cli_export_torch(tmp_path):
+    """--export_torch writes a state_dict the reference model loads."""
+    pytest.importorskip("torch")
+    if not __import__("os").path.exists("/root/reference/model.py"):
+        pytest.skip("reference checkout not available")
+    from gnot_tpu.main import main
+
+    out = tmp_path / "model.pth"
+    main(
+        [
+            "--n_attn_layers", "1", "--n_attn_hidden_dim", "16", "--n_mlp_num_layers", "1",
+            "--n_mlp_hidden_dim", "16", "--n_input_hidden_dim", "16", "--n_expert", "2",
+            "--n_head", "2", "--epochs", "1", "--n_train", "8", "--n_test", "4",
+            "--synthetic", "darcy2d", "--export_torch", str(out),
+        ]
+    )
+    import torch
+
+    from gnot_tpu.config import ModelConfig
+    from gnot_tpu.data import datasets
+    from gnot_tpu.interop.torch_oracle import build_reference_model
+
+    sd = torch.load(out, weights_only=True)
+    dims = datasets.infer_model_dims(datasets.synth_darcy2d(1, seed=0))
+    mc = ModelConfig(
+        n_attn_layers=1, n_attn_hidden_dim=16, n_mlp_num_layers=1,
+        n_mlp_hidden_dim=16, n_input_hidden_dim=16, n_expert=2, n_head=2, **dims,
+    )
+    tmodel = build_reference_model(mc)
+    tmodel.load_state_dict(sd)  # raises on mismatch

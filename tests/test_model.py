@@ -209,3 +209,27 @@ class TestTorchParity:
         )
         assert float(np.max(np.abs(got - want))) < 1e-4
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_remat_same_outputs_and_grads():
+    """remat must be numerics-neutral: same forward, same grads — it only
+    changes what the backward rematerializes."""
+    mc = ModelConfig(**SMALL)
+    mc_r = dataclasses.replace(mc, remat=True)
+    coords, theta, funcs = make_inputs(np.random.default_rng(5))
+    model, model_r = GNOT(mc), GNOT(mc_r)
+    params = model.init(jax.random.key(0), coords, theta, funcs)["params"]
+
+    out = model.apply({"params": params}, coords, theta, funcs)
+    out_r = model_r.apply({"params": params}, coords, theta, funcs)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out_r))
+
+    def loss(m):
+        def f(p):
+            return jnp.sum(m.apply({"params": p}, coords, theta, funcs) ** 2)
+        return f
+
+    g = jax.jit(jax.grad(loss(model)))(params)
+    g_r = jax.jit(jax.grad(loss(model_r)))(params)
+    for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(g_r)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
