@@ -125,6 +125,10 @@ class TrainConfig:
     log_every: int = 0  # steps; 0 = per-epoch only
     metrics_path: str = ""  # JSONL sink; "" = console only
     profile_dir: str = ""  # jax.profiler trace output
+    # Debug-build numeric guard: jax_debug_nans — the first NaN/inf in
+    # any step raises with the producing op's location instead of
+    # silently propagating.
+    debug_checks: bool = False
     seed: int = 0
 
 

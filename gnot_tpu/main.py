@@ -42,7 +42,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--n_train", type=int, default=64)
     p.add_argument("--n_test", type=int, default=16)
-    p.add_argument("--batch_size", type=int, default=4)
+    p.add_argument(
+        "--batch_size", type=int, default=4,
+        help="samples per batch (per-process on multi-host runs: the "
+             "global batch is batch_size x process_count)"
+    )
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--seed", type=int, default=0)
     # Framework knobs.
@@ -86,6 +90,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="per-step JSONL metric cadence (0 = per-epoch only; needs --metrics_path)"
     )
     p.add_argument("--profile_dir", type=str, default="")
+    p.add_argument(
+        "--debug_checks", action="store_true",
+        help="jax_debug_nans mode: the first NaN/inf raises with the "
+             "producing op's location (debug builds; disables donation "
+             "benefits on the failing re-run)"
+    )
     p.add_argument("--no_bucket", action="store_true", help="pad to per-batch max (parity)")
     p.add_argument(
         "--distributed", action="store_true",
@@ -119,6 +129,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "train.metrics_path": args.metrics_path,
             "train.log_every": args.log_every,
             "train.profile_dir": args.profile_dir,
+            "train.debug_checks": args.debug_checks,
             "train.seed": args.seed,
             "train.distributed": args.distributed,
             "mesh.data": args.mesh_data,
@@ -225,6 +236,12 @@ def main(argv=None) -> float:
     args = parser.parse_args(argv)
     if args.log_every and not args.metrics_path:
         parser.error("--log_every needs --metrics_path (step records are JSONL-only)")
+    if args.debug_checks:
+        # Before ANY tracing: mid-process toggling does not reliably
+        # instrument already-warm jit paths.
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
     if args.backend == "torch":
         return run_torch_backend(args)
 

@@ -129,10 +129,32 @@ def make_hybrid_mesh(cfg: MeshConfig) -> Mesh:
             f"per-host mesh {ici_data}x{cfg.seq}x{cfg.model} does not "
             f"cover {local} local devices"
         )
-    devices = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape=(ici_data, cfg.seq, cfg.model),
-        dcn_mesh_shape=(n_proc, 1, 1),
-    )
+    slices = {getattr(d, "slice_index", None) for d in jax.devices()}
+    if slices != {None} and len(slices) == n_proc:
+        # Real multi-slice topology: the hybrid builder knows the
+        # ICI/DCN layout. Its errors are informative — let them raise.
+        devices = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(ici_data, cfg.seq, cfg.model),
+            dcn_mesh_shape=(n_proc, 1, 1),
+        )
+    else:
+        # Devices that don't advertise DCN slices (CPU fleets,
+        # single-slice topologies) reject the hybrid builder. Build the
+        # same layout by hand: host-major data axis, each host's local
+        # block shaped (local_data, seq, model) so seq/model never
+        # leave a host.
+        import numpy as np
+
+        by_proc: dict[int, list] = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, []).append(d)
+        blocks = [
+            np.asarray(sorted(v, key=lambda d: d.id)).reshape(
+                ici_data, cfg.seq, cfg.model
+            )
+            for _, v in sorted(by_proc.items())
+        ]
+        devices = np.concatenate(blocks, axis=0)
     return Mesh(devices, AXES)
 
 

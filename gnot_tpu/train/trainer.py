@@ -196,6 +196,11 @@ class Trainer:
             pad_nodes=pad_nodes,
             pad_funcs=pad_funcs,
         )
+        # debug_checks: main() enables process-global jax_debug_nans at
+        # startup (before any tracing — the only point it reliably
+        # instruments, and a global flag is the CLI's to own, not a
+        # library constructor's); the trainer's own guard is the
+        # host-side per-step finiteness check in fit().
         if self.mesh is None:
             self.train_step = make_train_step(
                 self.model, config.optim, config.train.loss
@@ -355,6 +360,17 @@ class Trainer:
                         self.host_step += 1
                         losses.append(loss)
                         points += batch.n_real_points
+                        if cfg.train.debug_checks and not np.isfinite(
+                            float(np.asarray(loss))
+                        ):
+                            # Deterministic guard (jax_debug_nans does
+                            # not reliably fire on warm jit paths); the
+                            # sync-per-step cost is the debug-build
+                            # trade.
+                            raise FloatingPointError(
+                                f"non-finite train loss at epoch {epoch}, "
+                                f"step {self.host_step}"
+                            )
                         if (
                             self.metrics_sink is not None
                             and cfg.train.log_every

@@ -7,6 +7,7 @@ AdamW -> eval) runs and produces finite, improvable losses everywhere.
 """
 
 import dataclasses
+import os
 
 import numpy as np
 import pytest
@@ -114,11 +115,13 @@ def test_log_every_writes_step_records(tmp_path):
     assert all(np.isfinite(r["loss"]) for r in step_records)
 
 
+@pytest.mark.skipif(
+    not os.path.exists("/root/reference/model.py"),
+    reason="reference checkout not available",
+)
 def test_cli_export_torch(tmp_path):
     """--export_torch writes a state_dict the reference model loads."""
     pytest.importorskip("torch")
-    if not __import__("os").path.exists("/root/reference/model.py"):
-        pytest.skip("reference checkout not available")
     from gnot_tpu.main import main
 
     out = tmp_path / "model.pth"

@@ -57,3 +57,28 @@ def test_eval_only_roundtrip(tmp_path):
     best = cli.main(args)
     res = cli.main(args + ["--eval_only"])
     np.testing.assert_allclose(res, best, rtol=1e-6)
+
+
+def test_debug_checks_nan_raises():
+    """--debug_checks: a NaN entering the pipeline raises a
+    FloatingPointError (with step context) instead of training silently
+    on garbage."""
+    from gnot_tpu.config import ModelConfig, make_config
+    from gnot_tpu.data import datasets
+    from gnot_tpu.train.trainer import Trainer
+
+    train = datasets.synth_ns2d(8, n_points=16, seed=0)
+    train[2].coords[0, 0] = np.nan  # poison one sample
+    test = datasets.synth_ns2d(4, n_points=16, seed=1)
+    cfg = make_config(**{
+        "data.n_train": 8, "data.n_test": 4, "train.epochs": 1,
+        "train.debug_checks": True, "data.shuffle_train": False,
+    })
+    mc = ModelConfig(
+        n_attn_layers=1, n_attn_hidden_dim=16, n_mlp_num_layers=1,
+        n_mlp_hidden_dim=16, n_input_hidden_dim=16, n_expert=2, n_head=2,
+        **datasets.infer_model_dims(train),
+    )
+    trainer = Trainer(cfg, mc, train, test)
+    with pytest.raises(FloatingPointError, match="epoch 0"):
+        trainer.fit()
