@@ -78,6 +78,17 @@ class OptimConfig:
     # False steps the schedule per optimizer update (the correct form).
     parity_schedule_bug: bool = True
     grad_clip_norm: float = 0.0  # 0 = off (reference has no clipping)
+    # Accumulate gradients over k micro-batches before each optimizer
+    # update (1 = off). Effective batch = k x batch_size with the same
+    # device memory — the lever when big meshes cap the per-step batch.
+    # Keep steps_per_epoch divisible by k: MultiSteps discards a partial
+    # trailing window, and windows straddling epoch boundaries make
+    # per-epoch eval observe mid-window params.
+    grad_accum: int = 1
+
+    def __post_init__(self) -> None:
+        if self.grad_accum < 1:
+            raise ValueError(f"grad_accum must be >= 1, got {self.grad_accum}")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -48,6 +48,11 @@ def build_parser() -> argparse.ArgumentParser:
              "global batch is batch_size x process_count)"
     )
     p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument(
+        "--grad_accum", type=int, default=1,
+        help="accumulate gradients over k micro-batches per optimizer "
+             "update (effective batch = k x batch_size)"
+    )
     p.add_argument("--seed", type=int, default=0)
     # Framework knobs.
     p.add_argument("--backend", type=str, default="jax", choices=["jax", "torch"])
@@ -120,6 +125,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "data.seed": args.seed,
             "data.bucket": not args.no_bucket and args.attention_mode != "parity",
             "optim.lr": args.lr,
+            "optim.grad_accum": args.grad_accum,
             "optim.parity_schedule_bug": args.schedule == "parity",
             "train.epochs": args.epochs,
             "train.loss": args.loss,

@@ -49,6 +49,8 @@ def initialize(
     failure re-raises instead: silently degrading there would launch p
     duplicate single-process trainings racing on the same checkpoint
     and metrics paths."""
+    if _already_initialized():
+        return  # a driver (or test harness) brought the runtime up itself
     if (
         coordinator_address is None
         and num_processes is None
@@ -79,6 +81,17 @@ def initialize(
         num_processes=num_processes,
         process_id=process_id,
     )
+
+
+def _already_initialized() -> bool:
+    """Whether the jax.distributed runtime is already up (a driver may
+    legitimately initialize it before calling into this framework)."""
+    try:
+        return bool(jax.distributed.is_initialized())
+    except AttributeError:  # older jax without the public predicate
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
 
 
 def _managed_job_hint() -> str | None:
@@ -130,12 +143,22 @@ def make_hybrid_mesh(cfg: MeshConfig) -> Mesh:
             f"cover {local} local devices"
         )
     slices = {getattr(d, "slice_index", None) for d in jax.devices()}
-    if slices != {None} and len(slices) == n_proc:
+    if slices != {None} and len(slices) > 1:
         # Real multi-slice topology: the hybrid builder knows the
-        # ICI/DCN layout. Its errors are informative — let them raise.
+        # ICI/DCN layout. DCN granularity is SLICES (a slice may span
+        # several processes), so the data axis factors as
+        # n_slices x per-slice. Its errors are informative — let them
+        # raise.
+        n_slices = len(slices)
+        total_data = ici_data * n_proc
+        if total_data % n_slices:
+            raise ValueError(
+                f"total data degree {total_data} must be divisible by "
+                f"the {n_slices} slices"
+            )
         devices = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=(ici_data, cfg.seq, cfg.model),
-            dcn_mesh_shape=(n_proc, 1, 1),
+            mesh_shape=(total_data // n_slices, cfg.seq, cfg.model),
+            dcn_mesh_shape=(n_slices, 1, 1),
         )
     else:
         # Devices that don't advertise DCN slices (CPU fleets,

@@ -42,7 +42,11 @@ class TrainState:
 
 def make_optimizer(cfg: OptimConfig, learning_rate) -> optax.GradientTransformation:
     """AdamW with torch defaults made explicit (SURVEY.md §7 hard parts:
-    optax and torch defaults differ — wd=0.01, eps=1e-8 are torch's)."""
+    optax and torch defaults differ — wd=0.01, eps=1e-8 are torch's).
+
+    ``grad_accum > 1`` wraps the transform in ``optax.MultiSteps``: k
+    micro-batch gradients are averaged before each real update, so the
+    effective batch is k x batch_size at constant device memory."""
     tx = optax.adamw(
         learning_rate=learning_rate,
         b1=cfg.b1,
@@ -52,6 +56,8 @@ def make_optimizer(cfg: OptimConfig, learning_rate) -> optax.GradientTransformat
     )
     if cfg.grad_clip_norm > 0:
         tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), tx)
+    if cfg.grad_accum > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=cfg.grad_accum)
     return tx
 
 
@@ -210,6 +216,19 @@ class Trainer:
             # Built lazily in initialize(): the sharded jits need the
             # state's sharding layout.
             self.train_step = self.eval_step = None
+        if (
+            config.optim.grad_accum > 1
+            and len(self.train_loader) % config.optim.grad_accum
+        ):
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "steps_per_epoch=%d is not divisible by grad_accum=%d: "
+                "accumulation windows straddle epoch boundaries and the "
+                "final partial window is discarded",
+                len(self.train_loader),
+                config.optim.grad_accum,
+            )
         self.lr_fn = make_lr_fn(
             config.optim,
             steps_per_epoch=len(self.train_loader),

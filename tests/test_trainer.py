@@ -169,3 +169,60 @@ def test_checkpoint_resume_replay_same_epoch_keeps_committed_dir(tmp_path):
         d for d in os.listdir(tmp_path / "ckpt") if (tmp_path / "ckpt" / d).is_dir()
     )
     assert dirs == ["best.7r1"]
+
+
+def test_grad_accum_two_micro_equals_one_full_batch():
+    """MultiSteps(k=2): two micro-batches of B/2 produce the same update
+    as one step on the combined batch (equal micro sizes -> averaged
+    micro-grads == grad of the combined per-batch-mean loss)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gnot_tpu.config import ModelConfig, OptimConfig
+    from gnot_tpu.data import datasets
+    from gnot_tpu.data.batch import collate
+    from gnot_tpu.models.gnot import GNOT
+    from gnot_tpu.train.trainer import (
+        TrainState,
+        init_state,
+        make_optimizer,
+        make_train_step,
+    )
+
+    mc = ModelConfig(
+        input_dim=2, theta_dim=1, input_func_dim=3, out_dim=1,
+        n_input_functions=1, n_attn_layers=1, n_attn_hidden_dim=16,
+        n_mlp_num_layers=1, n_mlp_hidden_dim=16, n_input_hidden_dim=16,
+        n_expert=2, n_head=2,
+    )
+    samples = datasets.synth_ns2d(4, n_points=32, seed=3)
+    full = collate(samples, bucket=False)
+    micro1 = collate(samples[:2], bucket=False)
+    micro2 = collate(samples[2:], bucket=False)
+    model = GNOT(mc)
+    lr = jnp.asarray(1e-3, jnp.float32)
+
+    base = OptimConfig()
+    params0 = init_state(model, base, full, seed=0).params
+    state_full = init_state(model, base, full, seed=0)
+    step_full = make_train_step(model, base, "rel_l2")
+    out_full, _ = step_full(state_full, full, lr)
+
+    accum = OptimConfig(grad_accum=2)
+    tx = make_optimizer(accum, lr)
+    state_acc = TrainState(
+        params=jax.tree.map(jnp.copy, params0),
+        opt_state=tx.init(params0),
+        step=jnp.zeros((), jnp.int32),
+    )
+    step_acc = make_train_step(model, accum, "rel_l2")
+    state_acc, _ = step_acc(state_acc, micro1, lr)
+    # After the first micro-batch no real update has happened.
+    for a, b in zip(jax.tree.leaves(state_acc.params), jax.tree.leaves(params0)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    state_acc, _ = step_acc(state_acc, micro2, lr)
+
+    for a, b in zip(
+        jax.tree.leaves(state_acc.params), jax.tree.leaves(out_full.params)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6)
