@@ -74,6 +74,12 @@ def build_parser() -> argparse.ArgumentParser:
              "memory, ~1 extra forward of FLOPs — for long point clouds)"
     )
     p.add_argument(
+        "--predict_out", type=str, default="",
+        help="after the run, write test-set predictions to this pickle "
+             "as [X, Y_pred, theta, (f...)] records (reference schema, "
+             "so they round-trip through the same readers)"
+    )
+    p.add_argument(
         "--export_torch", type=str, default="",
         help="after the run, save params as a reference-compatible torch "
              "state_dict .pth (best checkpoint when --checkpoint_dir is "
@@ -324,10 +330,37 @@ def main(argv=None) -> float:
     else:
         result = trainer.fit()
 
+    if (
+        (args.export_torch or args.predict_out)
+        and not args.eval_only
+        and checkpointer is not None
+    ):
+        # Export/predict from the BEST checkpoint, not the final epoch,
+        # so both artifacts correspond to the reported best metric.
+        # (eval_only already restored it into trainer.state.)
+        restored = checkpointer.restore_best(trainer.state)
+        if restored is not None:
+            trainer.state = restored[0]
     if args.export_torch:
-        # evaluate_from_checkpoint already restored the best state;
-        # don't pay a second Orbax read for it.
-        _export_torch(trainer, mc, args.export_torch, restore_best=not args.eval_only)
+        _export_torch(trainer, mc, args.export_torch, restore_best=False)
+    if args.predict_out:
+        import jax
+
+        if jax.process_count() > 1:
+            print(
+                "--predict_out skipped: predict() is single-process only "
+                "(see Trainer.predict)"
+            )
+        else:
+            preds = trainer.predict(test_samples)
+            datasets.save_pickle(
+                [
+                    dataclasses.replace(s, y=p)
+                    for s, p in zip(test_samples, preds)
+                ],
+                args.predict_out,
+            )
+            print(f"Wrote {len(preds)} predictions to {args.predict_out}")
     return result
 
 

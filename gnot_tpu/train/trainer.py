@@ -283,6 +283,10 @@ class Trainer:
         return mesh_lib.shard_batch(self.mesh, batch)
 
     def evaluate(self) -> float:
+        if len(self.test_loader) == 0:
+            # No test set: nothing to select a best checkpoint on
+            # (np.mean([]) would propagate NaN into best-metric logic).
+            return float("inf")
         metrics = [
             np.asarray(self.eval_step(self.state.params, self._device_batch(b)))
             for b in self.test_loader
@@ -417,7 +421,9 @@ class Trainer:
                 self.metrics_sink.log(
                     epoch=epoch,
                     train_loss=train_loss,
-                    test_metric=res,
+                    # inf (empty test set) would serialize as the bare
+                    # token `Infinity` — not valid JSON; emit null.
+                    test_metric=res if np.isfinite(res) else None,
                     lr=self.lr_fn(self.host_step, epoch),
                     points_per_sec=points / dt,
                     epoch_seconds=dt,
