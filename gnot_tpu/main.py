@@ -201,16 +201,20 @@ def run_torch_backend(args: argparse.Namespace) -> float:
         den = (target**2 * mask[..., None]).sum(1)
         return ((num / den) ** 0.5).mean()
 
+    def predict_batch(b):
+        return model(
+            torch.from_numpy(b.coords),
+            torch.from_numpy(b.theta),
+            [torch.from_numpy(f) for f in b.funcs] if b.funcs is not None else None,
+        )
+
     best = float("inf")
     for epoch in range(args.epochs):
         losses = []
         for b in train_loader:
-            out = model(
-                torch.from_numpy(b.coords),
-                torch.from_numpy(b.theta),
-                [torch.from_numpy(f) for f in b.funcs] if b.funcs is not None else None,
+            loss = rel_l2(
+                predict_batch(b), torch.from_numpy(b.y), torch.from_numpy(b.node_mask)
             )
-            loss = rel_l2(out, torch.from_numpy(b.y), torch.from_numpy(b.node_mask))
             losses.append(loss.item())
             opt.zero_grad()
             loss.backward()
@@ -220,13 +224,7 @@ def run_torch_backend(args: argparse.Namespace) -> float:
         with torch.no_grad():
             metrics = [
                 rel_l2(
-                    model(
-                        torch.from_numpy(b.coords),
-                        torch.from_numpy(b.theta),
-                        [torch.from_numpy(f) for f in b.funcs]
-                        if b.funcs is not None
-                        else None,
-                    ),
+                    predict_batch(b),
                     torch.from_numpy(b.y),
                     torch.from_numpy(b.node_mask),
                 ).item()
@@ -240,6 +238,21 @@ def run_torch_backend(args: argparse.Namespace) -> float:
     if args.export_torch:
         torch.save(model.state_dict(), args.export_torch)
         print(f"Exported torch state_dict to {args.export_torch}")
+    if args.predict_out:
+        with torch.no_grad():
+            preds = []
+            for b in test_loader:
+                out = predict_batch(b).numpy()
+                lengths = b.node_mask.sum(1).astype(int)
+                preds.extend(out[i, :n] for i, n in enumerate(lengths))
+        datasets.save_pickle(
+            [
+                dataclasses.replace(s, y=p)
+                for s, p in zip(test_samples, preds)
+            ],
+            args.predict_out,
+        )
+        print(f"Wrote {len(preds)} predictions to {args.predict_out}")
     return best
 
 
@@ -342,10 +355,8 @@ def main(argv=None) -> float:
         if restored is not None:
             trainer.state = restored[0]
     if args.export_torch:
-        _export_torch(trainer, mc, args.export_torch, restore_best=False)
+        _export_torch(trainer, mc, args.export_torch)
     if args.predict_out:
-        import jax
-
         if jax.process_count() > 1:
             print(
                 "--predict_out skipped: predict() is single-process only "
@@ -364,19 +375,16 @@ def main(argv=None) -> float:
     return result
 
 
-def _export_torch(trainer, mc, path: str, *, restore_best: bool = True) -> None:
-    """Save the run's params as a reference-compatible torch state_dict
-    (the best checkpoint when one exists, else the final weights)."""
+def _export_torch(trainer, mc, path: str) -> None:
+    """Save ``trainer.state``'s params as a reference-compatible torch
+    state_dict (main() restores the best checkpoint into the trainer
+    before calling this)."""
     import jax
     import torch
 
     from gnot_tpu.interop.torch_oracle import flax_to_state_dict
 
     state = trainer.state
-    if restore_best and trainer.checkpointer is not None:
-        restored = trainer.checkpointer.restore_best(state)
-        if restored is not None:
-            state = restored[0]
     if jax.process_count() > 1:
         # Sharded params may span non-addressable devices; gather the
         # global values onto every host (collective — all processes

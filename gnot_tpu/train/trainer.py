@@ -421,9 +421,7 @@ class Trainer:
                 self.metrics_sink.log(
                     epoch=epoch,
                     train_loss=train_loss,
-                    # inf (empty test set) would serialize as the bare
-                    # token `Infinity` — not valid JSON; emit null.
-                    test_metric=res if np.isfinite(res) else None,
+                    test_metric=res,  # sink serializes non-finite as null
                     lr=self.lr_fn(self.host_step, epoch),
                     points_per_sec=points / dt,
                     epoch_seconds=dt,

@@ -9,6 +9,7 @@ console lines for diffability; this module adds structured JSONL metrics
 from __future__ import annotations
 
 import json
+import math
 import os
 import time
 from typing import Any, TextIO
@@ -25,6 +26,13 @@ class MetricsSink:
 
     def log(self, **record: Any) -> None:
         record.setdefault("ts", time.time())
+        # json.dumps would emit bare NaN/Infinity tokens (invalid JSON)
+        # for non-finite floats — e.g. a diverged loss or the inf metric
+        # of an empty test set; serialize those as null.
+        record = {
+            k: (None if isinstance(v, float) and not math.isfinite(v) else v)
+            for k, v in record.items()
+        }
         self._fh.write(json.dumps(record) + "\n")
 
     def close(self) -> None:

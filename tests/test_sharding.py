@@ -131,3 +131,31 @@ def test_seq_sharding_masked_correctness():
 def test_mesh_validation():
     with pytest.raises(ValueError):
         mesh_lib.make_mesh(MeshConfig(data=3, seq=2, model=2))
+
+
+def test_sharded_step_with_grad_accum_matches_single_device():
+    """MultiSteps opt-state (nested param-suffixed tree) must shard
+    correctly; two sharded micro-steps == two single-device micro-steps."""
+    optim = dataclasses.replace(OptimConfig(), grad_accum=2)
+    model = GNOT(SMALL)
+    batch = make_batch()
+    state = init_state(model, optim, batch, seed=0)
+    lr = jnp.asarray(1e-3, jnp.float32)
+
+    step_single = make_train_step(model, optim, "rel_l2")
+    s_single = state
+    for _ in range(2):
+        s_single, _ = step_single(s_single, batch, lr)
+
+    mesh = mesh_lib.make_mesh(MeshConfig(data=2, seq=2, model=2), jax.devices()[:8])
+    s_mesh = mesh_lib.shard_state(mesh, init_state(model, optim, batch, seed=0))
+    step_mesh = mesh_lib.make_sharded_train_step(model, optim, "rel_l2", mesh, s_mesh)
+    sharded = mesh_lib.shard_batch(mesh, batch)
+    for _ in range(2):
+        s_mesh, _ = step_mesh(s_mesh, sharded, lr)
+
+    for a, b in zip(
+        jax.tree.leaves(jax.device_get(s_mesh.params)),
+        jax.tree.leaves(jax.device_get(s_single.params)),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
