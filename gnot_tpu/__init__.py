@@ -10,7 +10,7 @@ from gnot_tpu.config import Config, DataConfig, MeshConfig, ModelConfig, OptimCo
 from gnot_tpu.data.batch import Loader, MeshBatch, MeshSample, collate
 from gnot_tpu.models.gnot import GNOT
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 __all__ = [
     "Config",
@@ -25,5 +25,16 @@ __all__ = [
     "MeshSample",
     "collate",
     "GNOT",
+    "Trainer",
     "__version__",
 ]
+
+
+def __getattr__(name):
+    # Lazy: importing Trainer pulls jax/optax/orbax, which config/data
+    # users may not need at import time.
+    if name == "Trainer":
+        from gnot_tpu.train.trainer import Trainer
+
+        return Trainer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

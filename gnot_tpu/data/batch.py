@@ -186,7 +186,17 @@ class Loader:
         self.prefetch = prefetch
         self.pad_nodes = pad_nodes
         self.pad_funcs = pad_funcs
-        self._rng = np.random.default_rng(seed)
+        self.seed = seed
+        # Epoch counter for shuffling: each epoch's order is a pure
+        # function of (seed, epoch), so a resumed run at epoch N sees
+        # exactly the batches the continuous run would have (a stateful
+        # rng stream would restart from epoch 0's order after resume).
+        # Advanced by __iter__; set_epoch() pins it (trainer resume,
+        # torch DistributedSampler-style).
+        self._epoch = 0
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
 
     def __len__(self) -> int:
         n = len(self.samples)
@@ -197,7 +207,8 @@ class Loader:
     def _epoch_indices(self) -> list[np.ndarray]:
         order = np.arange(len(self.samples))
         if self.shuffle:
-            self._rng.shuffle(order)
+            np.random.default_rng((self.seed, self._epoch)).shuffle(order)
+        self._epoch += 1
         chunks = []
         for start in range(0, len(order), self.batch_size):
             idx = order[start : start + self.batch_size]

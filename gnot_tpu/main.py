@@ -209,6 +209,7 @@ def run_torch_backend(args: argparse.Namespace) -> float:
         )
 
     best = float("inf")
+    best_sd = None
     for epoch in range(args.epochs):
         losses = []
         for b in train_loader:
@@ -233,8 +234,15 @@ def run_torch_backend(args: argparse.Namespace) -> float:
         res = float(np.mean(metrics))
         print(f"Epoch {epoch}, Test Metric: {res}")
         print("-----------------------------------")
-        best = min(best, res)
+        if res < best:
+            best = res
+            if args.export_torch or args.predict_out:
+                # Keep the best weights so export/predict artifacts match
+                # the reported best metric (same contract as the jax path).
+                best_sd = {k: v.detach().clone() for k, v in model.state_dict().items()}
     print(f"\nBest Test Metric: {best}")
+    if best_sd is not None:
+        model.load_state_dict(best_sd)
     if args.export_torch:
         torch.save(model.state_dict(), args.export_torch)
         print(f"Exported torch state_dict to {args.export_torch}")
@@ -245,14 +253,7 @@ def run_torch_backend(args: argparse.Namespace) -> float:
                 out = predict_batch(b).numpy()
                 lengths = b.node_mask.sum(1).astype(int)
                 preds.extend(out[i, :n] for i, n in enumerate(lengths))
-        datasets.save_pickle(
-            [
-                dataclasses.replace(s, y=p)
-                for s, p in zip(test_samples, preds)
-            ],
-            args.predict_out,
-        )
-        print(f"Wrote {len(preds)} predictions to {args.predict_out}")
+        _write_predictions(test_samples, preds, args.predict_out)
     return best
 
 
@@ -363,16 +364,19 @@ def main(argv=None) -> float:
                 "(see Trainer.predict)"
             )
         else:
-            preds = trainer.predict(test_samples)
-            datasets.save_pickle(
-                [
-                    dataclasses.replace(s, y=p)
-                    for s, p in zip(test_samples, preds)
-                ],
-                args.predict_out,
+            _write_predictions(
+                test_samples, trainer.predict(test_samples), args.predict_out
             )
-            print(f"Wrote {len(preds)} predictions to {args.predict_out}")
     return result
+
+
+def _write_predictions(samples, preds, path: str) -> None:
+    """Write predictions as reference-schema records ([X, Y_pred, theta,
+    (f...)]) so they round-trip through the same readers."""
+    datasets.save_pickle(
+        [dataclasses.replace(s, y=p) for s, p in zip(samples, preds)], path
+    )
+    print(f"Wrote {len(preds)} predictions to {path}")
 
 
 def _export_torch(trainer, mc, path: str) -> None:

@@ -367,6 +367,9 @@ class Trainer:
         # one if the run has a single epoch.
         trace_at = min(self.start_epoch + 1, cfg.train.epochs - 1)
         for epoch in range(self.start_epoch, cfg.train.epochs):
+            # Shuffle order is a function of (seed, epoch): resumed runs
+            # replay the continuous run's batch order exactly.
+            self.train_loader.set_epoch(epoch)
             t0 = time.perf_counter()
             losses, points = [], 0
             with profiling.trace_epoch(
