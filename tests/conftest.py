@@ -18,3 +18,18 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent compile cache: the suite is dominated by XLA compiles of
+# near-identical tiny programs; re-runs hit the cache instead. Per-user
+# path: a world-shared /tmp dir would fail for the second user on a
+# shared machine and mean executing artifacts another user could write.
+import getpass
+import tempfile
+
+_default_cache = os.path.join(
+    tempfile.gettempdir(), f"gnot_jax_cache_{getpass.getuser()}"
+)
+jax.config.update(
+    "jax_compilation_cache_dir",
+    os.environ.get("GNOT_TEST_CACHE", _default_cache),
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -58,3 +58,27 @@ def test_collate_uses_packer_consistently():
     np.testing.assert_array_equal(b1.funcs, b2.funcs)
     np.testing.assert_array_equal(b1.func_mask, b2.func_mask)
     np.testing.assert_array_equal(b1.node_mask, b2.node_mask)
+
+
+def test_pack_rows_fuzz_matches_numpy():
+    """Randomized shapes/lengths: the C++ packer and the numpy fallback
+    must agree bit-for-bit, including mask placement."""
+    from gnot_tpu import native
+
+    if not native.native_available():
+        import pytest
+
+        pytest.skip("native packer unavailable")
+    rng = np.random.default_rng(123)
+    for _ in range(50):
+        n = int(rng.integers(1, 9))
+        dim = int(rng.integers(1, 17))
+        lens = rng.integers(0, 33, size=n)
+        max_len = int(max(lens.max(), 1) + rng.integers(0, 8))
+        arrs = [
+            rng.normal(size=(int(m), dim)).astype(np.float32) for m in lens
+        ]
+        out_c, mask_c = native.pack_rows(arrs, max_len)
+        out_np, mask_np = native.pack_rows_numpy(arrs, max_len)
+        np.testing.assert_array_equal(out_c, out_np)
+        np.testing.assert_array_equal(mask_c, mask_np)
