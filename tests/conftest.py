@@ -22,12 +22,19 @@ jax.config.update("jax_platforms", "cpu")
 # near-identical tiny programs; re-runs hit the cache instead. Per-user
 # path: a world-shared /tmp dir would fail for the second user on a
 # shared machine and mean executing artifacts another user could write.
-import getpass
 import tempfile
 
-_default_cache = os.path.join(
-    tempfile.gettempdir(), f"gnot_jax_cache_{getpass.getuser()}"
-)
+_home = os.path.expanduser("~")
+if os.path.isabs(_home):
+    # User-owned location: nobody else can pre-create or write it.
+    _default_cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME") or os.path.join(_home, ".cache"),
+        "gnot_jax_cache",
+    )
+else:  # stripped container env without HOME: uid-scoped tmp fallback
+    _default_cache = os.path.join(
+        tempfile.gettempdir(), f"gnot_jax_cache_{os.getuid()}"
+    )
 jax.config.update(
     "jax_compilation_cache_dir",
     os.environ.get("GNOT_TEST_CACHE", _default_cache),

@@ -233,3 +233,49 @@ def test_remat_same_outputs_and_grads():
     g_r = jax.jit(jax.grad(loss(model_r)))(params)
     for a, b in zip(jax.tree.leaves(g), jax.tree.leaves(g_r)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+from gnot_tpu.interop.torch_oracle import DEFAULT_REFERENCE_PATH
+
+
+@pytest.mark.skipif(
+    not os.path.exists(DEFAULT_REFERENCE_PATH),
+    reason="reference implementation not available",
+)
+def test_forward_parity_darcy_full_resolution():
+    """BASELINE configs[0] at its literal resolution: Darcy2d 64x64
+    regular grid (4096 mesh points), small GNOT, CPU reference run —
+    the <1e-4 parity gate."""
+    import torch
+
+    from gnot_tpu.data import datasets
+    from gnot_tpu.interop.torch_oracle import build_reference_model, state_dict_to_flax
+
+    cfg = dict(
+        SMALL,
+        theta_dim=1,
+        n_input_functions=1,
+        n_attn_layers=2,
+        n_expert=2,
+    )
+    mc = ModelConfig(**cfg, attention_mode="parity")
+    torch.manual_seed(4)
+    ref = build_reference_model(mc)
+    ref.eval()
+
+    samples = datasets.synth_darcy2d(2, seed=9, grid_n=64)  # 4096 points
+    from gnot_tpu.data.batch import collate
+
+    b = collate(samples, bucket=False)
+    with torch.no_grad():
+        want = ref(
+            torch.from_numpy(b.coords),
+            torch.from_numpy(b.theta),
+            [torch.from_numpy(f) for f in b.funcs],
+        ).numpy()
+
+    params = state_dict_to_flax(ref.state_dict(), mc)
+    got = np.asarray(
+        GNOT(mc).apply({"params": params}, b.coords, b.theta, b.funcs)
+    )
+    assert float(np.max(np.abs(got - want))) < 1e-4
