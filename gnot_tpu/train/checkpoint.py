@@ -41,6 +41,12 @@ class Checkpointer:
         # Saves kicked off but whose meta is not yet committed:
         # (name, meta dict, committed dir basename).
         self._pending: list[tuple[str, dict, str]] = []
+        # Last published dir per name, tracked in memory on EVERY
+        # process: the sidecar file is written by process 0 only, so
+        # re-reading it from disk on other hosts (e.g. over NFS right
+        # after a flush) can return a stale dir and desynchronize the
+        # collective orbax save targets.
+        self._published: dict[str, str] = {}
 
     # -- commit protocol ---------------------------------------------------
 
@@ -50,22 +56,24 @@ class Checkpointer:
         Call only after ``wait_until_finished()``: at that point every
         pending save's directory is finalized on disk.
         """
-        if jax.process_index() == 0:
-            for name, meta, dirname in self._pending:
-                meta_path = os.path.join(self.directory, f"{name}.json")
-                tmp = f"{meta_path}.tmp"
-                with open(tmp, "w") as f:
-                    json.dump(meta, f)
-                os.replace(tmp, meta_path)
-                for d in os.listdir(self.directory):
-                    full = os.path.join(self.directory, d)
-                    # d == name: a pre-upgrade unsuffixed checkpoint dir.
-                    if (
-                        (d == name or d.startswith(f"{name}."))
-                        and d != dirname
-                        and os.path.isdir(full)
-                    ):
-                        shutil.rmtree(full, ignore_errors=True)
+        for name, meta, dirname in self._pending:
+            self._published[name] = dirname
+            if jax.process_index() != 0:
+                continue
+            meta_path = os.path.join(self.directory, f"{name}.json")
+            tmp = f"{meta_path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(meta, f)
+            os.replace(tmp, meta_path)
+            for d in os.listdir(self.directory):
+                full = os.path.join(self.directory, d)
+                # d == name: a pre-upgrade unsuffixed checkpoint dir.
+                if (
+                    (d == name or d.startswith(f"{name}."))
+                    and d != dirname
+                    and os.path.isdir(full)
+                ):
+                    shutil.rmtree(full, ignore_errors=True)
         self._pending.clear()
 
     def _save(self, name: str, state: Any, epoch: int, best_metric: float) -> None:
@@ -79,11 +87,16 @@ class Checkpointer:
         # published sidecar already names; force=True would delete that
         # committed checkpoint at kickoff, so uniquify instead — the old
         # one stays restorable until the new commit's sidecar lands.
-        meta_path = os.path.join(self.directory, f"{name}.json")
-        published = None
-        if os.path.exists(meta_path):
-            with open(meta_path) as f:
-                published = json.load(f).get("dir")
+        published = self._published.get(name)
+        if published is None:
+            # First save this process lifetime: the on-disk sidecar (if
+            # any) predates this run and is stable, so reading it is
+            # safe on every host — unlike mid-run reads (see __init__).
+            meta_path = os.path.join(self.directory, f"{name}.json")
+            if os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    published = json.load(f).get("dir")
+                    self._published[name] = published
         tick = 0
         while dirname == published:
             tick += 1

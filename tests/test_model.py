@@ -211,6 +211,101 @@ class TestTorchParity:
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.skipif(
+    not os.path.exists(os.environ.get("GNOT_REFERENCE_PATH", "/root/reference")),
+    reason="reference implementation not available",
+)
+def test_forward_parity_ragged_padding_pollution():
+    """The reference's defining quirk: padding is UNMASKED, so pad rows
+    pass through biased MLPs and pollute ``k_sum``/``k^T v`` — results
+    depend on batch composition (reference main.py:63-82, model.py:77-80).
+    This test feeds a genuinely ragged batch (elasticity-style lengths,
+    nonzero pad rows on every sample but the longest) through both sides
+    from the same imported weights and asserts parity holds anyway.
+    """
+    import torch
+
+    from gnot_tpu.data import datasets
+    from gnot_tpu.data.batch import collate
+    from gnot_tpu.interop.torch_oracle import build_reference_model, state_dict_to_flax
+
+    cfg = dict(
+        SMALL,
+        theta_dim=2,
+        n_input_functions=1,
+        out_dim=2,
+        n_attn_layers=2,
+        n_expert=2,
+    )
+    mc = ModelConfig(**cfg, attention_mode="parity")
+    torch.manual_seed(7)
+    ref = build_reference_model(mc)
+    ref.eval()
+
+    samples = datasets.synth_elasticity(4, seed=11, base_points=96)
+    lengths = [s.coords.shape[0] for s in samples]
+    flengths = [s.funcs[0].shape[0] for s in samples]
+    assert len(set(lengths)) > 1, "samples must be genuinely ragged"
+    assert len(set(flengths)) > 1
+
+    # Our collate(bucket=False) must byte-match the reference's inline
+    # padding (main.py:63-82 + utils.py:3-4): input functions padded to
+    # the single shared max across ALL functions of ALL samples, coords
+    # to the per-batch max, zero pad at the tail of axis 0.
+    b = collate(samples, bucket=False)
+    ref_max_f = max(f.shape[0] for s in samples for f in s.funcs)
+    ref_max_l = max(lengths)
+
+    def ref_pad(a, n):  # utils.py:3-4 semantics
+        return np.pad(a, [(0, n - a.shape[0])] + [(0, 0)] * (a.ndim - 1))
+
+    ref_funcs = np.stack(
+        [np.stack([ref_pad(s.funcs[0], ref_max_f) for s in samples])]
+    )
+    ref_x = np.stack([ref_pad(s.coords, ref_max_l) for s in samples])
+    np.testing.assert_array_equal(b.funcs, ref_funcs)
+    np.testing.assert_array_equal(b.coords, ref_x)
+    assert b.coords.shape[1] == ref_max_l and b.funcs.shape[2] == ref_max_f
+    # Pad rows exist (ragged batch, no bucketing).
+    assert float(b.node_mask.min()) == 0.0 and float(b.func_mask.min()) == 0.0
+
+    with torch.no_grad():
+        want = ref(
+            torch.from_numpy(b.coords),
+            torch.from_numpy(b.theta),
+            [torch.from_numpy(f) for f in b.funcs],
+        ).numpy()
+
+    params = state_dict_to_flax(ref.state_dict(), mc)
+    got = np.asarray(GNOT(mc).apply({"params": params}, b.coords, b.theta, b.funcs))
+    # Parity holds at EVERY row, including pad rows (pollution included).
+    assert float(np.max(np.abs(got - want))) < 1e-4
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+    # Prove the pad rows are nonzero contributors: masked-mode output at
+    # the real rows must DIFFER from the parity output — if padding were
+    # inert the two modes would coincide and this test would prove
+    # nothing about pollution.
+    mc_masked = ModelConfig(**cfg, attention_mode="masked")
+    got_masked = np.asarray(
+        GNOT(mc_masked).apply(
+            {"params": params},
+            b.coords,
+            b.theta,
+            b.funcs,
+            node_mask=b.node_mask,
+            func_mask=b.func_mask,
+        )
+    )
+    real = np.asarray(b.node_mask, bool)
+    pollution = float(np.max(np.abs(got[real] - got_masked[real])))
+    parity_err = float(np.max(np.abs(got - want)))
+    # Pollution is larger than both the achieved parity error and the
+    # 1e-4 gate itself: had parity mode not replicated it, the gate
+    # above would fail.
+    assert pollution > 1e-4 and pollution > parity_err
+
+
 def test_remat_same_outputs_and_grads():
     """remat must be numerics-neutral: same forward, same grads — it only
     changes what the backward rematerializes."""

@@ -6,10 +6,13 @@ file covers a full short TRAINING run — same torch-exported initial
 weights, same batches, AdamW at torch defaults on both sides — and
 compares per-step losses and final parameters.
 
-Batches are built with uniform sample lengths (no padding), where
-masked and parity numerics coincide, so this isolates optimizer +
-gradient parity from the padding-pollution question (which
-test_model.py's parity-mode tests cover).
+Two regimes share one harness (``_assert_training_parity``):
+
+* uniform sample lengths (zero padding) — isolates optimizer + gradient
+  parity from the padding-pollution question;
+* genuinely ragged batches (elasticity-style lengths) — every batch
+  carries nonzero pad rows that pollute attention unmasked on both
+  sides (reference main.py:63-82, model.py:77-80).
 """
 
 import os
@@ -63,16 +66,18 @@ def _torch_rel_l2(pred, target, mask):
     return ((num / den) ** 0.5).mean()
 
 
-def test_training_run_parity_vs_torch():
+def _assert_training_parity(mc, batches, torch_seed):
+    """Run the same short AdamW training on both backends from identical
+    torch-seeded initial weights; assert per-step losses match <1e-4 and
+    final parameters stay in parity. The torch loss masks pad rows —
+    exactly the reference's unpad-slicing + SumPool (main.py:87-98)."""
     import torch
 
     from gnot_tpu.interop.torch_oracle import build_reference_model, state_dict_to_flax
 
-    batches = _uniform_batches()
-
     # --- torch side -------------------------------------------------------
-    torch.manual_seed(0)
-    tmodel = build_reference_model(MC)
+    torch.manual_seed(torch_seed)
+    tmodel = build_reference_model(mc)
     topt = torch.optim.AdamW(tmodel.parameters(), lr=LR)  # wd=0.01 default
     tlosses = []
     for b in batches:
@@ -81,10 +86,8 @@ def test_training_run_parity_vs_torch():
             torch.from_numpy(b.theta),
             [torch.from_numpy(f) for f in b.funcs],
         )
-        loss = _torch_rel_l2(
-            out, torch.from_numpy(b.y), torch.from_numpy(b.node_mask)
-        )
-        tlosses.append(float(loss))
+        loss = _torch_rel_l2(out, torch.from_numpy(b.y), torch.from_numpy(b.node_mask))
+        tlosses.append(float(loss.detach()))
         topt.zero_grad()
         loss.backward()
         topt.step()
@@ -92,13 +95,11 @@ def test_training_run_parity_vs_torch():
     # --- jax side, from the SAME initial weights --------------------------
     # tmodel has been updated in place; rebuild the initial weights from
     # the same torch seed.
-    torch.manual_seed(0)
-    tmodel0 = build_reference_model(MC)
-    params = jax.tree.map(
-        jnp.asarray, state_dict_to_flax(tmodel0.state_dict(), MC)
-    )
+    torch.manual_seed(torch_seed)
+    tmodel0 = build_reference_model(mc)
+    params = jax.tree.map(jnp.asarray, state_dict_to_flax(tmodel0.state_dict(), mc))
 
-    model = GNOT(MC)
+    model = GNOT(mc)
     tx = make_optimizer(OptimConfig(), LR)
     state = TrainState(
         params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32)
@@ -113,12 +114,46 @@ def test_training_run_parity_vs_torch():
     np.testing.assert_allclose(jlosses, tlosses, rtol=1e-4, atol=1e-5)
 
     # Final parameters stay within parity after N_STEPS of AdamW.
-    final_torch = state_dict_to_flax(tmodel.state_dict(), MC)
+    final_torch = state_dict_to_flax(tmodel.state_dict(), mc)
     t_leaves = jax.tree.leaves(final_torch)
     j_leaves = jax.tree.leaves(jax.device_get(state.params))
     assert len(t_leaves) == len(j_leaves)
     for t, j in zip(t_leaves, j_leaves):
         np.testing.assert_allclose(np.asarray(j), np.asarray(t), rtol=2e-3, atol=1e-4)
+
+
+def test_training_run_parity_vs_torch():
+    _assert_training_parity(MC, _uniform_batches(), torch_seed=0)
+
+
+def test_training_run_parity_vs_torch_ragged():
+    """Same gate on genuinely RAGGED batches: nonzero pad rows pollute
+    attention unmasked on both sides, while the loss is pad-free on both
+    sides (reference unpad-slicing main.py:87-89 == masked segment sums
+    here). Closes the round-2 verdict's top gap: padding-pollution
+    parity had only ever been tested pad-free."""
+    mc = ModelConfig(
+        input_dim=2,
+        theta_dim=2,
+        input_func_dim=3,
+        out_dim=2,
+        n_input_functions=1,
+        n_attn_layers=2,
+        n_attn_hidden_dim=32,
+        n_mlp_num_layers=2,
+        n_mlp_hidden_dim=32,
+        n_input_hidden_dim=32,
+        n_expert=2,
+        n_head=4,
+        attention_mode="parity",
+    )
+    # Elasticity-style ragged lengths; bucket=False reproduces the
+    # reference's per-batch-max padding exactly (main.py:63-82).
+    samples = datasets.synth_elasticity(4 * N_STEPS, seed=13, base_points=96)
+    batches = list(Loader(samples, 4, bucket=False, prefetch=0))
+    for b in batches:
+        assert float(np.min(b.node_mask)) == 0.0, "batch must carry real padding"
+    _assert_training_parity(mc, batches, torch_seed=1)
 
 
 def test_flax_to_state_dict_roundtrip():
