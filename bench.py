@@ -3,11 +3,15 @@
 Runs the full jitted training step (forward + backward + AdamW) on the
 default JAX platform (the TPU chip under the driver) at the
 reference-default architecture on the NS2d ~1k-point config, counting
-REAL (unpadded) mesh points per second per chip. The baseline divisor is
-the same step measured on the host CPU backend in float32 — the
-reference's design point (torch CPU/GPU eager, f32) — so
-``vs_baseline`` is the TPU/CPU speedup ratio; the BASELINE.md gate wants
->= 8.
+REAL (unpadded) mesh points per second per chip. ``vs_baseline`` is the
+TPU/CPU speedup ratio; the BASELINE.md gate wants >= 8. Two baseline
+divisors are available via ``--baseline``:
+
+* ``jax`` (default): the same jitted step on the host CPU backend in
+  float32 — a hardware-for-hardware ratio of this framework;
+* ``torch``: the reference PyTorch implementation in CPU eager mode
+  (its actual design point, ``/root/reference/main.py:27``) doing the
+  same forward + backward + AdamW on the same batch.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -22,12 +26,12 @@ import jax
 import jax.numpy as jnp
 
 
-def build(step_dtype: str, attention_impl: str = "xla", n_points: int = 1024, batch_size: int = 4, ffn_impl: str = "xla", config: str = "ns2d"):
-    from gnot_tpu.config import ModelConfig, OptimConfig
+def build_data(step_dtype: str, n_points: int, batch_size: int, config: str, attention_impl: str = "xla", ffn_impl: str = "xla", remat: bool = False):
+    """One padded batch + the reference-default ModelConfig
+    (main.py:16-22) for the given workload — no jax state."""
+    from gnot_tpu.config import ModelConfig
     from gnot_tpu.data import datasets
     from gnot_tpu.data.batch import Loader
-    from gnot_tpu.models.gnot import GNOT
-    from gnot_tpu.train.trainer import init_state, make_train_step
 
     # Size knobs per synthetic generator; darcy2d is a square grid, so
     # n_points maps to the nearest grid edge (pass 4096 for the
@@ -44,14 +48,25 @@ def build(step_dtype: str, attention_impl: str = "xla", n_points: int = 1024, ba
         dtype=step_dtype,
         attention_impl=attention_impl,
         ffn_impl=ffn_impl,
+        remat=remat,
         **datasets.infer_model_dims(samples),
-    )  # reference-default architecture (main.py:16-22)
-    batch = next(iter(Loader(samples, batch_size)))
+    )
+    return next(iter(Loader(samples, batch_size))), mc
+
+
+def build(step_dtype: str, attention_impl: str = "xla", n_points: int = 1024, batch_size: int = 4, ffn_impl: str = "xla", config: str = "ns2d", remat: bool = False):
+    from gnot_tpu.config import OptimConfig
+    from gnot_tpu.models.gnot import GNOT
+    from gnot_tpu.train.trainer import init_state, make_train_step
+
+    batch, mc = build_data(
+        step_dtype, n_points, batch_size, config, attention_impl, ffn_impl, remat
+    )
     model = GNOT(mc)
     optim = OptimConfig()
     state = init_state(model, optim, batch, seed=0)
     step = make_train_step(model, optim, "rel_l2")
-    return step, state, batch
+    return step, state, batch, mc
 
 
 def time_steps(step, state, batch, lr, n_warmup: int, n_steps: int, device) -> float:
@@ -70,11 +85,53 @@ def time_steps(step, state, batch, lr, n_warmup: int, n_steps: int, device) -> f
     return batch.n_real_points * n_steps / dt
 
 
+def time_torch_steps(batch, mc, lr: float, n_warmup: int, n_steps: int) -> float:
+    """Real-mesh-points/sec for the reference torch model's train step
+    (CPU eager, f32 — the reference regime, main.py:27,50-52,98-103)."""
+    import torch
+
+    from gnot_tpu.interop.torch_oracle import build_reference_model
+
+    torch.manual_seed(0)
+    model = build_reference_model(mc)
+    opt = torch.optim.AdamW(model.parameters(), lr=lr)
+    coords = torch.from_numpy(batch.coords)
+    theta = torch.from_numpy(batch.theta)
+    funcs = [torch.from_numpy(f) for f in batch.funcs] if batch.funcs is not None else None
+    y = torch.from_numpy(batch.y)
+    mask = torch.from_numpy(batch.node_mask)
+
+    def one_step():
+        out = model(coords, theta, funcs)
+        num = ((out - y) ** 2 * mask[..., None]).sum(1)
+        den = (y**2 * mask[..., None]).sum(1)
+        loss = ((num / den) ** 0.5).mean()
+        opt.zero_grad()
+        loss.backward()
+        opt.step()
+
+    for _ in range(n_warmup):
+        one_step()
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        one_step()
+    dt = time.perf_counter() - t0
+    return batch.n_real_points * n_steps / dt
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=3)
-    p.add_argument("--cpu_steps", type=int, default=3)
+    p.add_argument(
+        "--cpu_steps", type=int, default=10,
+        help="baseline-divisor sample size (0 skips the baseline run)"
+    )
+    p.add_argument(
+        "--baseline", type=str, default="jax", choices=["jax", "torch"],
+        help="divisor for vs_baseline: this framework's step on the host "
+             "CPU (jax) or the reference PyTorch eager step (torch)"
+    )
     p.add_argument("--dtype", type=str, default="bfloat16", choices=["float32", "bfloat16"])
     p.add_argument("--attention_impl", type=str, default="xla", choices=["xla", "pallas"])
     p.add_argument("--ffn_impl", type=str, default="xla", choices=["xla", "pallas"])
@@ -85,27 +142,58 @@ def main():
         choices=["ns2d", "darcy2d", "elasticity", "inductor2d", "heatsink3d"],
         help="benchmark config; the headline metric is ns2d"
     )
+    p.add_argument("--remat", action="store_true", help="rematerialized backward")
+    p.add_argument(
+        "--mem_stats", action="store_true",
+        help="also print the device's peak-memory stats as JSON on stderr "
+             "(keeps the stdout one-line contract)"
+    )
     args = p.parse_args()
 
     lr = jnp.asarray(1e-3, jnp.float32)
     accel = jax.devices()[0]
     cpu = jax.devices("cpu")[0]
 
-    step, state, batch = build(
+    step, state, batch, _ = build(
         args.dtype, args.attention_impl, args.n_points, args.batch_size,
-        args.ffn_impl, args.config,
+        args.ffn_impl, args.config, args.remat,
     )
     value = time_steps(step, state, batch, lr, args.warmup, args.steps, accel)
+    if args.mem_stats:
+        import sys
+
+        stats = accel.memory_stats() or {}
+        mem = {
+            k: stats.get(k)
+            for k in ("peak_bytes_in_use", "bytes_in_use", "largest_alloc_size")
+        }
+        if not any(mem.values()):
+            # Devices behind remote tunnels expose no allocator stats;
+            # report the compiled step's static memory analysis instead
+            # (lower() only needs avals, so donated buffers are fine).
+            ma = step.lower(state, batch, lr).compile().memory_analysis()
+            mem = {
+                "temp_size_bytes": ma.temp_size_in_bytes,
+                "argument_size_bytes": ma.argument_size_in_bytes,
+                "output_size_bytes": ma.output_size_in_bytes,
+            }
+        print(json.dumps(mem), file=sys.stderr)
 
     if accel.platform == "cpu" or args.cpu_steps == 0:
         vs_baseline = 1.0
     else:
-        # CPU baseline in f32 — the reference's numeric regime — at the
+        # f32 CPU baseline — the reference's numeric regime — at the
         # SAME workload, so vs_baseline is purely a hardware ratio.
-        step_c, state_c, batch_c = build(
-            "float32", "xla", args.n_points, args.batch_size, config=args.config
-        )
-        cpu_value = time_steps(step_c, state_c, batch_c, lr, 1, args.cpu_steps, cpu)
+        if args.baseline == "torch":
+            batch_c, mc_c = build_data(
+                "float32", args.n_points, args.batch_size, args.config
+            )
+            cpu_value = time_torch_steps(batch_c, mc_c, 1e-3, 1, args.cpu_steps)
+        else:
+            step_c, state_c, batch_c, _ = build(
+                "float32", "xla", args.n_points, args.batch_size, config=args.config
+            )
+            cpu_value = time_steps(step_c, state_c, batch_c, lr, 1, args.cpu_steps, cpu)
         vs_baseline = value / cpu_value
 
     print(

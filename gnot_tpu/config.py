@@ -98,6 +98,9 @@ class DataConfig:
     # Synthetic fallback so nothing blocks on data files; one of the five
     # benchmark configs in BASELINE.json.
     synthetic: str = "ns2d"  # darcy2d | ns2d | elasticity | inductor2d | heatsink3d
+    # Size knob of the synthetic generator (0 = its default): grid side
+    # for darcy2d (points = size^2), mesh points for the others.
+    synth_size: int = 0
     n_train: int = 64
     n_test: int = 16
     batch_size: int = 4  # reference main.py:41
@@ -140,6 +143,11 @@ class TrainConfig:
     # any step raises with the producing op's location instead of
     # silently propagating.
     debug_checks: bool = False
+    # Fault injection: stop cleanly after this many epochs (0 = off),
+    # simulating a preemption mid-run. The schedule/epoch horizon stays
+    # sized by `epochs`, so a --resume run continues the SAME regime —
+    # this is how resume correctness is tested.
+    stop_after_epoch: int = 0
     seed: int = 0
 
 

@@ -170,6 +170,15 @@ SYNTHETIC: dict[str, Callable[..., list[MeshSample]]] = {
     "heatsink3d": synth_heatsink3d,
 }
 
+# Name of each generator's size kwarg, for DataConfig.synth_size.
+_SIZE_KWARG = {
+    "darcy2d": "grid_n",
+    "ns2d": "n_points",
+    "elasticity": "base_points",
+    "inductor2d": "base_points",
+    "heatsink3d": "base_points",
+}
+
 
 def load(data_cfg) -> tuple[list[MeshSample], list[MeshSample]]:
     """Load (train, test) per DataConfig: pickle paths or synthetic."""
@@ -178,8 +187,11 @@ def load(data_cfg) -> tuple[list[MeshSample], list[MeshSample]]:
         test = load_pickle(data_cfg.test_path) if data_cfg.test_path else []
         return train, test
     gen = SYNTHETIC[data_cfg.synthetic]
-    train = gen(data_cfg.n_train, seed=data_cfg.seed)
-    test = gen(data_cfg.n_test, seed=data_cfg.seed + 1)
+    kwargs = {}
+    if getattr(data_cfg, "synth_size", 0):
+        kwargs[_SIZE_KWARG[data_cfg.synthetic]] = data_cfg.synth_size
+    train = gen(data_cfg.n_train, seed=data_cfg.seed, **kwargs)
+    test = gen(data_cfg.n_test, seed=data_cfg.seed + 1, **kwargs)
     return train, test
 
 

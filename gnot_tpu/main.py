@@ -40,6 +40,11 @@ def build_parser() -> argparse.ArgumentParser:
         choices=sorted(datasets.SYNTHETIC),
         help="synthetic benchmark config when no pickle paths are given",
     )
+    p.add_argument(
+        "--synth_size", type=int, default=0,
+        help="synthetic generator size (0 = its default): grid side for "
+             "darcy2d (points = size^2), mesh points for the others"
+    )
     p.add_argument("--n_train", type=int, default=64)
     p.add_argument("--n_test", type=int, default=16)
     p.add_argument(
@@ -57,11 +62,19 @@ def build_parser() -> argparse.ArgumentParser:
     # Framework knobs.
     p.add_argument("--backend", type=str, default="jax", choices=["jax", "torch"])
     p.add_argument(
+        "--device_id", type=int, default=-1,
+        help="pin single-device runs to jax.devices()[i] (the reference's "
+             "--gpu_id, main.py:15); -1 = automatic. Multi-chip runs use "
+             "--distributed + the mesh flags instead"
+    )
+    p.add_argument(
         "--attention_mode", type=str, default="masked", choices=["masked", "parity"]
     )
     p.add_argument(
         "--attention_impl", type=str, default="xla", choices=["xla", "pallas"],
-        help="pallas: fused VMEM attention kernel (shard_map'd on a mesh)"
+        help="pallas: experimental fused VMEM attention kernel — measured "
+             "SLOWER than the default xla path at all scales (~4.5x at "
+             "L=1k; see docs/performance.md); kept for kernel research"
     )
     p.add_argument(
         "--ffn_impl", type=str, default="xla", choices=["xla", "pallas"],
@@ -77,7 +90,9 @@ def build_parser() -> argparse.ArgumentParser:
         "--predict_out", type=str, default="",
         help="after the run, write test-set predictions to this pickle "
              "as [X, Y_pred, theta, (f...)] records (reference schema, "
-             "so they round-trip through the same readers)"
+             "so they round-trip through the same readers); uses the "
+             "best checkpoint when --checkpoint_dir is set, else the "
+             "final-epoch weights"
     )
     p.add_argument(
         "--export_torch", type=str, default="",
@@ -95,6 +110,12 @@ def build_parser() -> argparse.ArgumentParser:
         help="restore the best checkpoint and evaluate (no training)"
     )
     p.add_argument("--checkpoint_every", type=int, default=0)
+    p.add_argument(
+        "--stop_after_epoch", type=int, default=0,
+        help="fault injection: stop cleanly after N epochs as if "
+             "preempted (schedule stays sized by --epochs; resume with "
+             "--resume to continue the same regime)"
+    )
     p.add_argument("--metrics_path", type=str, default="")
     p.add_argument(
         "--log_every", type=int, default=0,
@@ -125,6 +146,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "data.train_path": args.train_data,
             "data.test_path": args.test_data,
             "data.synthetic": args.synthetic,
+            "data.synth_size": args.synth_size,
             "data.n_train": args.n_train,
             "data.n_test": args.n_test,
             "data.batch_size": args.batch_size,
@@ -138,6 +160,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "train.checkpoint_dir": args.checkpoint_dir,
             "train.resume": args.resume,
             "train.checkpoint_every": args.checkpoint_every,
+            "train.stop_after_epoch": args.stop_after_epoch,
             "train.metrics_path": args.metrics_path,
             "train.log_every": args.log_every,
             "train.profile_dir": args.profile_dir,
@@ -184,7 +207,16 @@ def run_torch_backend(args: argparse.Namespace) -> float:
     cfg = config_from_args(args)
     train_samples, test_samples = datasets.load(cfg.data)
     mc = model_config(cfg, args, train_samples)
-    model = build_reference_model(mc)
+    # --device_id == the reference's --gpu_id (its main.py:15,27):
+    # cuda:<id> when CUDA is available, else CPU.
+    dev = torch.device("cpu")
+    if args.device_id >= 0:
+        if torch.cuda.is_available():
+            dev = torch.device(f"cuda:{args.device_id}")
+        else:
+            print("note: CUDA unavailable; torch backend runs on CPU")
+    torch.manual_seed(args.seed)  # reproducible init for recorded runs
+    model = build_reference_model(mc).to(dev)
     opt = torch.optim.AdamW(model.parameters(), lr=args.lr)
     from torch.optim.lr_scheduler import OneCycleLR
 
@@ -201,11 +233,14 @@ def run_torch_backend(args: argparse.Namespace) -> float:
         den = (target**2 * mask[..., None]).sum(1)
         return ((num / den) ** 0.5).mean()
 
+    def t(x):
+        return torch.from_numpy(x).to(dev)
+
     def predict_batch(b):
         return model(
-            torch.from_numpy(b.coords),
-            torch.from_numpy(b.theta),
-            [torch.from_numpy(f) for f in b.funcs] if b.funcs is not None else None,
+            t(b.coords),
+            t(b.theta),
+            [t(f) for f in b.funcs] if b.funcs is not None else None,
         )
 
     best = float("inf")
@@ -213,9 +248,7 @@ def run_torch_backend(args: argparse.Namespace) -> float:
     for epoch in range(args.epochs):
         losses = []
         for b in train_loader:
-            loss = rel_l2(
-                predict_batch(b), torch.from_numpy(b.y), torch.from_numpy(b.node_mask)
-            )
+            loss = rel_l2(predict_batch(b), t(b.y), t(b.node_mask))
             losses.append(loss.item())
             opt.zero_grad()
             loss.backward()
@@ -224,11 +257,7 @@ def run_torch_backend(args: argparse.Namespace) -> float:
         sched.step()
         with torch.no_grad():
             metrics = [
-                rel_l2(
-                    predict_batch(b),
-                    torch.from_numpy(b.y),
-                    torch.from_numpy(b.node_mask),
-                ).item()
+                rel_l2(predict_batch(b), t(b.y), t(b.node_mask)).item()
                 for b in test_loader
             ]
         res = float(np.mean(metrics))
@@ -250,7 +279,7 @@ def run_torch_backend(args: argparse.Namespace) -> float:
         with torch.no_grad():
             preds = []
             for b in test_loader:
-                out = predict_batch(b).numpy()
+                out = predict_batch(b).cpu().numpy()
                 lengths = b.node_mask.sum(1).astype(int)
                 preds.extend(out[i, :n] for i, n in enumerate(lengths))
         _write_predictions(test_samples, preds, args.predict_out)
@@ -278,6 +307,19 @@ def main(argv=None) -> float:
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+    if args.device_id >= 0:
+        import jax
+
+        if args.distributed:
+            parser.error("--device_id pins a single device; drop --distributed")
+        devices = jax.devices()
+        if args.device_id >= len(devices):
+            parser.error(
+                f"--device_id {args.device_id} out of range: "
+                f"{len(devices)} device(s) visible"
+            )
+        jax.config.update("jax_default_device", devices[args.device_id])
+
     if args.distributed:
         from gnot_tpu.parallel import multihost
 
@@ -289,6 +331,9 @@ def main(argv=None) -> float:
     cfg = config_from_args(args)
     train_samples, test_samples = datasets.load(cfg.data)
     mc = model_config(cfg, args, train_samples)
+    # Multi-process runs shard test_samples below; predict/export want
+    # the full set (identical on every host).
+    full_test_samples = test_samples
 
     if args.distributed:
         import jax
@@ -344,29 +389,28 @@ def main(argv=None) -> float:
     else:
         result = trainer.fit()
 
-    if (
-        (args.export_torch or args.predict_out)
-        and not args.eval_only
-        and checkpointer is not None
-    ):
-        # Export/predict from the BEST checkpoint, not the final epoch,
-        # so both artifacts correspond to the reported best metric.
-        # (eval_only already restored it into trainer.state.)
-        restored = checkpointer.restore_best(trainer.state)
-        if restored is not None:
-            trainer.state = restored[0]
+    if (args.export_torch or args.predict_out) and not args.eval_only:
+        if checkpointer is not None:
+            # Export/predict from the BEST checkpoint, not the final
+            # epoch, so both artifacts correspond to the reported best
+            # metric. (eval_only already restored it into trainer.state.)
+            restored = checkpointer.restore_best(trainer.state)
+            if restored is not None:
+                trainer.state = restored[0]
+        else:
+            print(
+                "note: no --checkpoint_dir, so export/predict artifacts "
+                "use the FINAL-epoch weights, not the reported best"
+            )
     if args.export_torch:
         _export_torch(trainer, mc, args.export_torch)
     if args.predict_out:
-        if jax.process_count() > 1:
-            print(
-                "--predict_out skipped: predict() is single-process only "
-                "(see Trainer.predict)"
-            )
-        else:
-            _write_predictions(
-                test_samples, trainer.predict(test_samples), args.predict_out
-            )
+        # Collective on multi-process runs (params allgather inside
+        # predict): every process computes the full predictions, only
+        # process 0 writes the file.
+        preds = trainer.predict(full_test_samples)
+        if jax.process_index() == 0:
+            _write_predictions(full_test_samples, preds, args.predict_out)
     return result
 
 
@@ -395,7 +439,9 @@ def _export_torch(trainer, mc, path: str) -> None:
         # must call it), then only process 0 writes.
         from jax.experimental import multihost_utils
 
-        params = multihost_utils.process_allgather(state.params)
+        # tiled=True: gather each array's GLOBAL value (the default
+        # stacks a per-process axis and rejects global sharded inputs).
+        params = multihost_utils.process_allgather(state.params, tiled=True)
         if jax.process_index() != 0:
             return
     else:

@@ -49,16 +49,30 @@ def onecycle_lr(
 
 
 def make_lr_fn(optim_cfg, *, steps_per_epoch: int, epochs: int) -> Callable[[int, int], float]:
-    """Returns ``lr(step, epoch)``.
+    """Returns ``lr(step, epoch)`` where ``step`` is the micro-step count.
 
     With the parity bug on, the schedule is evaluated at the epoch count
     (the reference's per-epoch ``scheduler.step()``); otherwise at the
-    optimizer update count.
+    optimizer UPDATE count: with ``grad_accum = k > 1``, MultiSteps
+    applies the LR sampled at every k-th micro-step, so the schedule is
+    evaluated at ``step // k`` over a total horizon of updates — exactly
+    torch's per-update ``scheduler.step()`` semantics, not a subsampling
+    of a micro-step-sized cycle.
     """
-    total_steps = steps_per_epoch * epochs
+    accum = max(1, getattr(optim_cfg, "grad_accum", 1))
+    if optim_cfg.parity_schedule_bug:
+        # The reference sizes the cycle in per-batch steps (main.py:52);
+        # keep its construction verbatim in parity mode.
+        total_steps = steps_per_epoch * epochs
+    else:
+        # True update count: MultiSteps windows are GLOBAL micro-step
+        # windows (they straddle epoch boundaries), so divide the whole
+        # micro-step horizon — per-epoch flooring would undercount
+        # updates and park the tail of training at min_lr.
+        total_steps = max(1, (steps_per_epoch * epochs) // accum)
 
     def lr(step: int, epoch: int) -> float:
-        counter = epoch if optim_cfg.parity_schedule_bug else step
+        counter = epoch if optim_cfg.parity_schedule_bug else step // accum
         return onecycle_lr(
             counter,
             max_lr=optim_cfg.lr,

@@ -253,15 +253,21 @@ class Trainer:
         self.state = init_state(
             self.model, self.config.optim, sample, self.config.train.seed
         )
+        if self.mesh is not None:
+            from gnot_tpu.parallel import mesh as mesh_lib
+
+            # Shard BEFORE any restore: Orbax then restores straight
+            # into the mesh layout (each process reads only its shards).
+            # Restoring into a local template and re-sharding would need
+            # a committed-array cross-host device_put, which non-TPU
+            # backends reject.
+            self.state = mesh_lib.shard_state(self.mesh, self.state)
         if self.checkpointer is not None and self.config.train.resume:
             restored = self.checkpointer.restore_latest(self.state)
             if restored is not None:
                 self.state, self.start_epoch, self.best_metric = restored
                 self.host_step = int(self.state.step)  # one-time sync
         if self.mesh is not None:
-            from gnot_tpu.parallel import mesh as mesh_lib
-
-            self.state = mesh_lib.shard_state(self.mesh, self.state)
             self.train_step = mesh_lib.make_sharded_train_step(
                 self.model, self.config.optim, self.config.train.loss,
                 self.mesh, self.state,
@@ -306,24 +312,65 @@ class Trainer:
         callers see exactly the ragged mesh they passed in. On a mesh,
         the tail batch is filled with repeats of the last sample so
         every batch shards evenly; the repeats are dropped on return.
+
+        Multi-process runs: sharded params span non-addressable
+        devices, so the global values are gathered onto every host
+        (``process_allgather`` — a collective; ALL processes must call
+        predict together, with the same samples) and the forward runs
+        on local devices. Every process returns the full predictions.
         """
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "predict() is single-process only (outputs would span "
-                "non-addressable devices); gather predictions per host"
-            )
+        multiproc = jax.process_count() > 1
         if self.state is None:
             self.initialize()
-        if self._forward is None:
-            model = self.model
-            self._forward = jax.jit(
-                lambda params, batch: apply_batch(model, params, batch)
+        if multiproc:
+            if self.model.mesh is not None:
+                raise NotImplementedError(
+                    "multi-process predict() with the pallas attention "
+                    "impl (mesh-carrying model) is unsupported; use the "
+                    "default xla impl"
+                )
+            from jax.experimental import multihost_utils
+
+            # tiled=True: gather the GLOBAL value of each (possibly
+            # non-fully-addressable) array — the default stacks a
+            # per-process leading axis and rejects global inputs.
+            params = multihost_utils.process_allgather(
+                self.state.params, tiled=True
             )
+            model = self.model
+            forward = jax.jit(lambda p, b: apply_batch(model, p, b))
+        else:
+            if self._forward is None:
+                model = self.model
+                self._forward = jax.jit(
+                    lambda params, batch: apply_batch(model, params, batch)
+                )
+            forward = self._forward
+            params = self.state.params
 
         samples = list(samples)
         n_real = len(samples)
         bs = self.config.data.batch_size
-        if self.mesh is not None and n_real % bs:
+        # Fixed pad lengths were captured from the training data; an
+        # unseen longer mesh cannot be packed into them — fail with the
+        # limit instead of a cryptic broadcast error from the packer.
+        pn, pf = self.train_loader.pad_nodes, self.train_loader.pad_funcs
+        for i, s in enumerate(samples):
+            if pn and s.coords.shape[0] > pn:
+                raise ValueError(
+                    f"predict sample {i} has {s.coords.shape[0]} mesh points "
+                    f"but this trainer's fixed pad length is {pn} (set from "
+                    "the training data); rebuild with larger pad_nodes"
+                )
+            if pf:
+                for j, f in enumerate(s.funcs):
+                    if f.shape[0] > pf:
+                        raise ValueError(
+                            f"predict sample {i} input function {j} has "
+                            f"{f.shape[0]} points but the fixed pad length "
+                            f"is {pf}; rebuild with larger pad_funcs"
+                        )
+        if not multiproc and self.mesh is not None and n_real % bs:
             samples = samples + [samples[-1]] * (bs - n_real % bs)
         loader = Loader(
             samples,
@@ -334,9 +381,10 @@ class Trainer:
         )
         outs: list[np.ndarray] = []
         for batch in loader:
-            out = np.asarray(
-                self._forward(self.state.params, self._device_batch(batch))
-            )
+            # Multi-process: params were gathered, so the forward runs
+            # on this host's local device — no cross-host batch assembly.
+            db = batch if multiproc else self._device_batch(batch)
+            out = np.asarray(forward(params, db))
             lengths = np.sum(np.asarray(batch.node_mask), axis=1).astype(int)
             outs.extend(out[i, :n] for i, n in enumerate(lengths))
         return outs[:n_real]
@@ -438,6 +486,14 @@ class Trainer:
                 and (epoch + 1) % cfg.train.checkpoint_every == 0
             ):
                 self.checkpointer.save_latest(self.state, epoch + 1, self.best_metric)
+            if (
+                cfg.train.stop_after_epoch
+                and epoch + 1 >= cfg.train.stop_after_epoch
+            ):
+                # Simulated preemption (fault injection): exit the loop
+                # cleanly; the final wait() below commits in-flight saves.
+                print(f"Stopping after epoch {epoch} (--stop_after_epoch)")
+                break
 
         if self.checkpointer is not None:
             self.checkpointer.wait()  # flush in-flight async saves

@@ -14,6 +14,8 @@ import os
 import time
 from typing import Any, TextIO
 
+import numpy as np
+
 
 class MetricsSink:
     """Append-only JSONL metrics writer."""
@@ -28,7 +30,18 @@ class MetricsSink:
         record.setdefault("ts", time.time())
         # json.dumps would emit bare NaN/Infinity tokens (invalid JSON)
         # for non-finite floats — e.g. a diverged loss or the inf metric
-        # of an empty test set; serialize those as null.
+        # of an empty test set — and rejects numpy scalars outright, so
+        # coerce numpy scalars to Python first, then null non-finites.
+        def coerce(v):
+            if isinstance(v, np.floating):
+                return float(v)
+            if isinstance(v, np.integer):
+                return int(v)
+            if isinstance(v, np.bool_):
+                return bool(v)
+            return v
+
+        record = {k: coerce(v) for k, v in record.items()}
         record = {
             k: (None if isinstance(v, float) and not math.isfinite(v) else v)
             for k, v in record.items()

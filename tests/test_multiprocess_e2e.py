@@ -1,16 +1,17 @@
 """True multi-process distributed training: 2 processes x 2 CPU devices.
 
 Everything else in the suite runs single-process (8 virtual devices in
-one process). This test exercises the real multi-controller path —
+one process). These tests exercise the real multi-controller path —
 ``jax.distributed.initialize``, per-host dataset sharding, fixed
 dataset-wide pads, ``global_batch`` assembly via
-``make_array_from_process_local_data``, and the hybrid DCNxICI mesh —
-by launching two actual OS processes and asserting they emit
-IDENTICAL, finite epoch losses and eval metrics (SPMD: every process
-computes the same global numbers).
+``make_array_from_process_local_data``, the hybrid DCNxICI mesh,
+Orbax checkpoint/resume across processes, and distributed predict —
+by launching actual OS processes and asserting SPMD invariants
+(every process computes the same global numbers).
 """
 
 import os
+import pickle
 import re
 import socket
 import subprocess
@@ -30,15 +31,17 @@ jax.distributed.initialize(
     coordinator_address=f"localhost:{port}", num_processes=2, process_id=proc_id
 )
 from gnot_tpu.main import main
-best = main([
-    "--n_attn_layers", "1", "--n_attn_hidden_dim", "16", "--n_mlp_num_layers", "1",
-    "--n_mlp_hidden_dim", "16", "--n_input_hidden_dim", "16", "--n_expert", "2",
-    "--n_head", "2", "--epochs", "2", "--n_train", "8", "--n_test", "8",
-    "--batch_size", "2",  # per-host: global batch 4 over the data axis
-    "--synthetic", "ns2d", "--distributed", "--mesh_data", "4",
-])
+best = main(sys.argv[3:])
 print(f"WORKER_BEST {best}")
 """
+
+BASE_ARGS = [
+    "--n_attn_layers", "1", "--n_attn_hidden_dim", "16", "--n_mlp_num_layers", "1",
+    "--n_mlp_hidden_dim", "16", "--n_input_hidden_dim", "16", "--n_expert", "2",
+    "--n_head", "2", "--n_train", "8", "--n_test", "8",
+    "--batch_size", "2",  # per-host: global batch 4 over the data axis
+    "--synthetic", "ns2d", "--distributed", "--mesh_data", "4",
+]
 
 
 def _free_port() -> int:
@@ -47,14 +50,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_training(tmp_path):
+def _run_pair(tmp_path, cli_args: list[str]) -> list[str]:
+    """Launch the worker in 2 coordinated OS processes; return their
+    stdouts (asserting both exited 0)."""
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
     port = str(_free_port())
-
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(i), port],
+            [sys.executable, str(script), str(i), port, *cli_args],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
@@ -75,6 +79,11 @@ def test_two_process_distributed_training(tmp_path):
                 p.wait()
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"process {i} failed:\n{out[-3000:]}"
+    return outs
+
+
+def test_two_process_distributed_training(tmp_path):
+    outs = _run_pair(tmp_path, BASE_ARGS + ["--epochs", "2"])
 
     def lines(out, pat):
         return re.findall(pat, out)
@@ -87,3 +96,63 @@ def test_two_process_distributed_training(tmp_path):
         a, b = lines(outs[0], pat), lines(outs[1], pat)
         assert a and a == b, f"process outputs diverge for {pat}: {a} vs {b}"
         assert all(np.isfinite(float(x)) for x in a)
+
+
+def test_two_process_checkpoint_resume_and_predict(tmp_path):
+    """Checkpoint/resume and predict under ``jax.distributed``:
+
+    * a 2-epoch run is 'preempted', then resumed to 4 epochs — the
+      resumed epochs' losses must equal a continuous 4-epoch run's
+      (Orbax save/restore across processes + seeded shuffle replay);
+    * both runs write predictions from the best checkpoint (a params
+      allgather collective); the files must agree.
+    """
+    d_cont, d_int = str(tmp_path / "cont"), str(tmp_path / "int")
+    p_cont, p_res = str(tmp_path / "pred_cont.pkl"), str(tmp_path / "pred_res.pkl")
+
+    pth = str(tmp_path / "model.pth")
+    outs_c = _run_pair(
+        tmp_path,
+        BASE_ARGS
+        + ["--epochs", "4", "--checkpoint_dir", d_cont, "--checkpoint_every", "1",
+           "--predict_out", p_cont, "--export_torch", pth],
+    )
+    # Same 4-epoch regime (the OneCycle schedule is sized by --epochs),
+    # preempted after epoch 1 via fault injection.
+    _run_pair(
+        tmp_path,
+        BASE_ARGS
+        + ["--epochs", "4", "--checkpoint_dir", d_int, "--checkpoint_every", "1",
+           "--stop_after_epoch", "2"],
+    )
+    outs_r = _run_pair(
+        tmp_path,
+        BASE_ARGS
+        + ["--epochs", "4", "--checkpoint_dir", d_int, "--checkpoint_every", "1",
+           "--resume", "--predict_out", p_res],
+    )
+
+    pat = r"Epoch (\d+), Loss: ([\d.eE+-]+)"
+    cont = dict(re.findall(pat, outs_c[0]))
+    res = dict(re.findall(pat, outs_r[0]))
+    assert set(res) == {"2", "3"}, f"resume should replay epochs 2-3, got {sorted(res)}"
+    for e in ("2", "3"):
+        np.testing.assert_allclose(
+            float(res[e]), float(cont[e]), rtol=1e-5,
+            err_msg=f"resumed epoch {e} loss diverges from continuous run",
+        )
+
+    # Predictions: written by process 0 only, identical across runs.
+    with open(p_cont, "rb") as f:
+        recs_c = pickle.load(f)
+    with open(p_res, "rb") as f:
+        recs_r = pickle.load(f)
+    assert len(recs_c) == len(recs_r) == 8
+    for rc, rr in zip(recs_c, recs_r):
+        np.testing.assert_allclose(rc[1], rr[1], rtol=1e-5, atol=1e-6)
+
+    # --export_torch under jax.distributed: the gathered state_dict is a
+    # loadable torch artifact (written by process 0).
+    torch = pytest.importorskip("torch")
+    sd = torch.load(pth, weights_only=True)
+    assert sd and all(v.ndim in (1, 2) for v in sd.values())

@@ -23,3 +23,38 @@ def test_onecycle_matches_torch(steps_per_epoch, epochs):
         want.append(opt.param_groups[0]["lr"])
         got.append(onecycle_lr(step, max_lr=max_lr, total_steps=total))
     np.testing.assert_allclose(got, want, rtol=1e-10)
+
+
+@pytest.mark.parametrize("k,steps_per_epoch,epochs", [(3, 9, 4), (3, 10, 3)])
+def test_per_step_schedule_with_grad_accum_matches_torch_updates(
+    k, steps_per_epoch, epochs
+):
+    """With grad_accum=k, the per_step schedule must step once per
+    OPTIMIZER UPDATE (torch semantics), not once per micro-step:
+    MultiSteps applies the LR sampled at each k-th micro-step, so
+    lr_fn evaluated there must equal torch OneCycleLR stepped per
+    update over the update-count horizon. The second case has
+    steps_per_epoch not divisible by k (accumulation windows straddle
+    epoch boundaries) — the horizon is the GLOBAL micro-step count / k."""
+    torch = pytest.importorskip("torch")
+    from torch.optim.lr_scheduler import OneCycleLR
+
+    from gnot_tpu.config import OptimConfig
+    from gnot_tpu.train.schedule import make_lr_fn
+
+    cfg = OptimConfig(parity_schedule_bug=False, grad_accum=k)
+    lr_fn = make_lr_fn(cfg, steps_per_epoch=steps_per_epoch, epochs=epochs)
+
+    total_updates = (steps_per_epoch * epochs) // k
+    opt = torch.optim.AdamW([torch.nn.Parameter(torch.zeros(1))], lr=cfg.lr)
+    sched = OneCycleLR(opt, max_lr=cfg.lr, total_steps=total_updates)
+
+    got, want = [], []
+    for u in range(total_updates):
+        want.append(opt.param_groups[0]["lr"])
+        # The micro-step where MultiSteps fires update u is u*k + k - 1;
+        # the epoch is wherever that global micro-step falls.
+        micro = u * k + k - 1
+        got.append(lr_fn(micro, epoch=micro // steps_per_epoch))
+        sched.step()
+    np.testing.assert_allclose(got, want, rtol=1e-10)

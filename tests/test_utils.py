@@ -59,6 +59,45 @@ def test_eval_only_roundtrip(tmp_path):
     np.testing.assert_allclose(res, best, rtol=1e-6)
 
 
+def test_metrics_sink_numpy_scalars(tmp_path):
+    """np.floating values (finite and non-finite) must serialize to
+    valid JSON — plain-float isinstance checks miss np.float32."""
+    import json
+
+    from gnot_tpu.utils.metrics import MetricsSink
+
+    path = str(tmp_path / "m.jsonl")
+    sink = MetricsSink(path)
+    sink.log(a=np.float32(1.5), b=np.float32("nan"), c=float("inf"), d=3)
+    sink.close()
+    with open(path) as f:
+        rec = json.loads(f.readline())
+    assert rec["a"] == 1.5 and rec["b"] is None and rec["c"] is None and rec["d"] == 3
+
+
+def test_predict_rejects_oversize_sample():
+    """predict() with a mesh longer than the trainer's fixed pad length
+    raises a descriptive ValueError, not a numpy broadcast error."""
+    from gnot_tpu.config import ModelConfig, make_config
+    from gnot_tpu.data import datasets
+    from gnot_tpu.train.trainer import Trainer
+
+    train = datasets.synth_ns2d(4, n_points=16, seed=0)
+    cfg = make_config(**{
+        "data.n_train": 4, "data.n_test": 0, "train.epochs": 1,
+        "data.pad_nodes": 16, "data.pad_funcs": 16,
+    })
+    mc = ModelConfig(
+        n_attn_layers=1, n_attn_hidden_dim=16, n_mlp_num_layers=1,
+        n_mlp_hidden_dim=16, n_input_hidden_dim=16, n_expert=2, n_head=2,
+        **datasets.infer_model_dims(train),
+    )
+    trainer = Trainer(cfg, mc, train, [])
+    big = datasets.synth_ns2d(1, n_points=64, seed=3)
+    with pytest.raises(ValueError, match="fixed pad length"):
+        trainer.predict(big)
+
+
 def test_debug_checks_nan_raises():
     """--debug_checks: a NaN entering the pipeline raises a
     FloatingPointError (with step context) instead of training silently
