@@ -90,7 +90,7 @@ def time_torch_steps(batch, mc, lr: float, n_warmup: int, n_steps: int) -> float
     (CPU eager, f32 — the reference regime, main.py:27,50-52,98-103)."""
     import torch
 
-    from gnot_tpu.interop.torch_oracle import build_reference_model
+    from gnot_tpu.interop.torch_oracle import build_reference_model, torch_rel_l2
 
     torch.manual_seed(0)
     model = build_reference_model(mc)
@@ -102,10 +102,7 @@ def time_torch_steps(batch, mc, lr: float, n_warmup: int, n_steps: int) -> float
     mask = torch.from_numpy(batch.node_mask)
 
     def one_step():
-        out = model(coords, theta, funcs)
-        num = ((out - y) ** 2 * mask[..., None]).sum(1)
-        den = (y**2 * mask[..., None]).sum(1)
-        loss = ((num / den) ** 0.5).mean()
+        loss = torch_rel_l2(model(coords, theta, funcs), y, mask)
         opt.zero_grad()
         loss.backward()
         opt.step()

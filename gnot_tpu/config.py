@@ -124,6 +124,18 @@ class MeshConfig:
     data: int = -1  # -1: all remaining devices
     seq: int = 1  # sequence (context) parallelism over mesh points
     model: int = 1  # tensor parallelism over heads / FFN hidden
+    # Expert parallelism over the stacked soft-MoE expert axis (the
+    # gated combine becomes one psum). n_expert % expert == 0.
+    expert: int = 1
+    # Pipeline parallelism over the attention-block stack (shard_map
+    # microbatch pipeline, parallel/pipeline.py). Composes with `data`;
+    # requires seq == model == expert == 1 and
+    # n_attn_layers % pipe == 0.
+    pipe: int = 1
+    # Microbatches per pipeline round-trip (pipe > 1 only); the bubble
+    # fraction is (pipe-1)/(microbatches+pipe-1). 0 = one microbatch
+    # per pipeline stage.
+    microbatches: int = 0
 
 
 @dataclasses.dataclass(frozen=True)

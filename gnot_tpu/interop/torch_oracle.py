@@ -51,6 +51,18 @@ def load_reference_model_module(path: str | None = None):
     return mod
 
 
+def torch_rel_l2(pred, target, mask):
+    """Masked per-sample relative L2 on padded torch tensors — the
+    reference objective (loss.py:19-23) without the unpad/concat round
+    trip: per-sample masked sums over the padded node axis are
+    mathematically identical to DGL's per-graph pooling. The ONE
+    torch-side oracle loss; the torch backend (main.py), the bench
+    baseline (bench.py) and the quality gate all call this."""
+    num = ((pred - target) ** 2 * mask[..., None]).sum(1)
+    den = (target**2 * mask[..., None]).sum(1)
+    return ((num / den) ** 0.5).mean()
+
+
 def build_reference_model(cfg: ModelConfig, path: str | None = None):
     """Instantiate the reference torch GNOT with matching hyperparams."""
     mod = load_reference_model_module(path)

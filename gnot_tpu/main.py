@@ -137,6 +137,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--mesh_data", type=int, default=-1)
     p.add_argument("--mesh_seq", type=int, default=1)
     p.add_argument("--mesh_model", type=int, default=1)
+    p.add_argument(
+        "--mesh_expert", type=int, default=1,
+        help="expert parallelism over the stacked soft-MoE experts "
+             "(n_expert must be divisible by it)"
+    )
+    p.add_argument(
+        "--mesh_pipe", type=int, default=1,
+        help="pipeline parallelism over the attention-block stack "
+             "(n_attn_layers must be divisible by it; composes with the "
+             "data axis only)"
+    )
+    p.add_argument(
+        "--microbatches", type=int, default=0,
+        help="microbatches per pipeline round (0 = one per stage); the "
+             "pipeline bubble is (pipe-1)/(microbatches+pipe-1)"
+    )
     return p
 
 
@@ -170,6 +186,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "mesh.data": args.mesh_data,
             "mesh.seq": args.mesh_seq,
             "mesh.model": args.mesh_model,
+            "mesh.expert": args.mesh_expert,
+            "mesh.pipe": args.mesh_pipe,
+            "mesh.microbatches": args.microbatches,
         }
     )
     return cfg
@@ -228,10 +247,7 @@ def run_torch_backend(args: argparse.Namespace) -> float:
         opt, max_lr=args.lr, steps_per_epoch=len(train_loader), epochs=args.epochs
     )
 
-    def rel_l2(pred, target, mask):
-        num = ((pred - target) ** 2 * mask[..., None]).sum(1)
-        den = (target**2 * mask[..., None]).sum(1)
-        return ((num / den) ** 0.5).mean()
+    from gnot_tpu.interop.torch_oracle import torch_rel_l2 as rel_l2
 
     def t(x):
         return torch.from_numpy(x).to(dev)
@@ -432,20 +448,15 @@ def _export_torch(trainer, mc, path: str) -> None:
 
     from gnot_tpu.interop.torch_oracle import flax_to_state_dict
 
-    state = trainer.state
     if jax.process_count() > 1:
         # Sharded params may span non-addressable devices; gather the
         # global values onto every host (collective — all processes
         # must call it), then only process 0 writes.
-        from jax.experimental import multihost_utils
-
-        # tiled=True: gather each array's GLOBAL value (the default
-        # stacks a per-process axis and rejects global sharded inputs).
-        params = multihost_utils.process_allgather(state.params, tiled=True)
+        params = trainer.gathered_standard_params()
         if jax.process_index() != 0:
             return
     else:
-        params = jax.device_get(state.params)
+        params = jax.device_get(trainer.standard_params())
     torch.save(flax_to_state_dict(params, mc), path)
     print(f"Exported torch state_dict to {path}")
 

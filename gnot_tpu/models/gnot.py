@@ -103,6 +103,114 @@ class HNABlock(nn.Module):
         return query + ffn2
 
 
+# --- Shared module factories + pure math ---------------------------------
+#
+# Single source of truth for every submodule's hyperparameters and the
+# pre/post-block math. GNOT.__call__ composes them inline (compact, so
+# the `name=`s place params at the reference-mapped tree paths); the
+# pipeline-parallel forward (parallel/pipeline.py) applies the very same
+# factories standalone against the corresponding param subtrees — the
+# two paths cannot drift apart.
+
+
+def model_dtype(cfg: ModelConfig):
+    return jnp.dtype(cfg.dtype) if cfg.dtype != "float32" else None
+
+
+def gating_module(cfg: ModelConfig) -> Mlp:
+    """Geometry gating MLP (model.py:148)."""
+    return Mlp(
+        cfg.n_mlp_num_layers,
+        cfg.n_mlp_hidden_dim,
+        cfg.n_expert,
+        dtype=model_dtype(cfg),
+        name="gating",
+    )
+
+
+def gating_scores(gating_out: Array) -> Array:
+    """Softmax over experts in f32, computed once (model.py:155-156)."""
+    return jax.nn.softmax(gating_out.astype(jnp.float32), axis=-1)
+
+
+def query_features(coords: Array, theta: Array) -> Array:
+    """theta broadcast along L, concat to coords (model.py:158-159)."""
+    theta_b = jnp.broadcast_to(
+        theta[:, None, :], (coords.shape[0], coords.shape[1], theta.shape[-1])
+    )
+    return jnp.concatenate([coords, theta_b], axis=-1)
+
+
+def x_embed_module(cfg: ModelConfig) -> Mlp:
+    """Query embedding MLP (model.py:146,161)."""
+    return Mlp(
+        cfg.n_mlp_num_layers,
+        cfg.n_input_hidden_dim,
+        cfg.n_input_hidden_dim,
+        dtype=model_dtype(cfg),
+        name="x_embed",
+    )
+
+
+def func_embed_module(cfg: ModelConfig):
+    """Per-input-function embedding MLPs (model.py:149,164-166),
+    stacked over the function axis."""
+    return nn.vmap(
+        Mlp,
+        in_axes=0,
+        out_axes=0,
+        variable_axes={"params": 0},
+        split_rngs={"params": True},
+    )(
+        cfg.n_mlp_num_layers,
+        cfg.n_mlp_hidden_dim,
+        cfg.n_input_hidden_dim,
+        model_dtype(cfg),
+        name="input_func_mlps",
+    )
+
+
+def block_module(
+    cfg: ModelConfig,
+    has_funcs: bool,
+    *,
+    mesh: Any = None,
+    name: str | None = None,
+    remat: bool = False,
+) -> HNABlock:
+    cls = nn.remat(HNABlock) if remat else HNABlock
+    return cls(
+        cfg.n_attn_hidden_dim,
+        cfg.n_mlp_num_layers,
+        cfg.n_mlp_hidden_dim,
+        cfg.n_input_hidden_dim,
+        cfg.n_expert,
+        cfg.n_head,
+        cfg.n_input_functions if has_funcs else 0,
+        dtype=model_dtype(cfg),
+        parity=cfg.attention_mode == "parity",
+        attention_impl=cfg.attention_impl,
+        ffn_impl=cfg.ffn_impl,
+        mesh=mesh,
+        name=name,
+    )
+
+
+def out_module(cfg: ModelConfig) -> Mlp:
+    """Output projection MLP (model.py:152,171)."""
+    return Mlp(
+        cfg.n_mlp_num_layers,
+        cfg.n_mlp_hidden_dim,
+        cfg.out_dim,
+        dtype=model_dtype(cfg),
+        name="out_mlp",
+    )
+
+
+def finalize_output(out: Array) -> Array:
+    return out.astype(jnp.float32)
+
+
 class GNOT(nn.Module):
     """Full GNOT model (reference model.py:142-172).
 
@@ -126,77 +234,28 @@ class GNOT(nn.Module):
         func_mask: Array | None = None,
     ) -> Array:
         cfg = self.config
-        dtype = jnp.dtype(cfg.dtype) if cfg.dtype != "float32" else None
         if cfg.attention_mode == "parity":
             node_mask = func_mask = None
 
         # Geometry gating on raw coordinates, computed once (model.py:155-156).
-        scores = Mlp(
-            cfg.n_mlp_num_layers,
-            cfg.n_mlp_hidden_dim,
-            cfg.n_expert,
-            dtype=dtype,
-            name="gating",
-        )(coords)
-        scores = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+        scores = gating_scores(gating_module(cfg)(coords))
 
         # Query embedding: theta broadcast along L, concat to coords
         # (model.py:158-161).
-        theta_b = jnp.broadcast_to(
-            theta[:, None, :], (coords.shape[0], coords.shape[1], theta.shape[-1])
-        )
-        x = jnp.concatenate([coords, theta_b], axis=-1)
-        query = Mlp(
-            cfg.n_mlp_num_layers,
-            cfg.n_input_hidden_dim,
-            cfg.n_input_hidden_dim,
-            dtype=dtype,
-            name="x_embed",
-        )(x)
+        query = x_embed_module(cfg)(query_features(coords, theta))
 
-        # Per-input-function embedding MLPs (model.py:149,164-166),
-        # stacked over the function axis.
         if cfg.n_input_functions > 0 and input_functions is not None:
-            embed = nn.vmap(
-                Mlp,
-                in_axes=0,
-                out_axes=0,
-                variable_axes={"params": 0},
-                split_rngs={"params": True},
-            )(
-                cfg.n_mlp_num_layers,
-                cfg.n_mlp_hidden_dim,
-                cfg.n_input_hidden_dim,
-                dtype,
-                name="input_func_mlps",
-            )
-            funcs = embed(input_functions)  # [F, B, Lf, D]
+            funcs = func_embed_module(cfg)(input_functions)  # [F, B, Lf, D]
         else:
             funcs = None
 
-        block_cls = nn.remat(HNABlock) if cfg.remat else HNABlock
         for i in range(cfg.n_attn_layers):
-            query = block_cls(
-                cfg.n_attn_hidden_dim,
-                cfg.n_mlp_num_layers,
-                cfg.n_mlp_hidden_dim,
-                cfg.n_input_hidden_dim,
-                cfg.n_expert,
-                cfg.n_head,
-                cfg.n_input_functions if funcs is not None else 0,
-                dtype=dtype,
-                parity=cfg.attention_mode == "parity",
-                attention_impl=cfg.attention_impl,
-                ffn_impl=cfg.ffn_impl,
+            query = block_module(
+                cfg,
+                funcs is not None,
                 mesh=self.mesh,
                 name=f"block_{i}",
+                remat=cfg.remat,
             )(scores, query, funcs, node_mask=node_mask, func_mask=func_mask)
 
-        out = Mlp(
-            cfg.n_mlp_num_layers,
-            cfg.n_mlp_hidden_dim,
-            cfg.out_dim,
-            dtype=dtype,
-            name="out_mlp",
-        )(query)
-        return out.astype(jnp.float32)
+        return finalize_output(out_module(cfg)(query))

@@ -18,11 +18,16 @@ Axes of the mesh:
 * ``model`` — tensor parallelism (TP): attention projections are
   head-sharded (the embed axis factors as [head, head_dim] with head
   leading), expert-FFN hidden layers are column/row-sharded.
-
-Soft-MoE note: GNOT's mixture is dense (every expert runs on every
-token, no routing — reference model.py:128-130), so classic expert
-parallelism with all-to-all does not apply; the expert dimension is a
-batched GEMM that TP shards instead.
+* ``expert`` — expert parallelism (EP) over the stacked soft-MoE
+  expert axis. GNOT's mixture is dense (every expert runs on every
+  token, no routing — reference model.py:128-130), so there is no
+  all-to-all dispatch/combine as in routed MoE; each shard runs its
+  experts on the full token stream and the gate-weighted combine
+  (a contraction over E) becomes one psum.
+* ``pipe`` — pipeline parallelism (PP) over the attention-block stack.
+  Not a GSPMD axis: the pipeline is an explicit shard_map microbatch
+  schedule (parallel/pipeline.py); ``make_sharded_train_step``
+  dispatches there when the mesh carries ``pipe > 1``.
 """
 
 from __future__ import annotations
@@ -37,19 +42,26 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from gnot_tpu.config import MeshConfig
 from gnot_tpu.data.batch import MeshBatch
 
-AXES = ("data", "seq", "model")
+AXES = ("data", "seq", "model", "expert", "pipe")
 
 
 def make_mesh(cfg: MeshConfig, devices=None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
-    seq, model = cfg.seq, cfg.model
-    data = cfg.data if cfg.data > 0 else n // (seq * model)
-    if data * seq * model != n:
+    seq, model, expert, pipe = cfg.seq, cfg.model, cfg.expert, cfg.pipe
+    rest = seq * model * expert * pipe
+    data = cfg.data if cfg.data > 0 else n // rest
+    if data * rest != n:
         raise ValueError(
-            f"mesh {data}x{seq}x{model} does not cover {n} devices"
+            f"mesh {data}x{seq}x{model}x{expert}x{pipe} "
+            f"(data x seq x model x expert x pipe) does not cover {n} devices"
         )
-    arr = np.asarray(devices).reshape(data, seq, model)
+    if pipe > 1 and (seq > 1 or model > 1 or expert > 1):
+        raise ValueError(
+            "pipe > 1 composes with the data axis only (the pipeline is "
+            "a shard_map schedule, not a GSPMD axis); set seq=model=expert=1"
+        )
+    arr = np.asarray(devices).reshape(data, seq, model, expert, pipe)
     return Mesh(arr, AXES)
 
 
@@ -103,8 +115,20 @@ def _param_pspec(path: str, leaf) -> P:
         return P(*([None] * (ndim - 1) + ["model"]))
     if re.search(r"fc_out/kernel$", path):
         return P("model", None)  # row parallel -> psum
-    if "experts/" in path or "input_func_mlps/" in path:
-        # Stacked MLPs [S, in, out]: shard the hidden axis.
+    if "experts/" in path:
+        # Stacked expert MLPs [E, in, out]: the stack axis is EP, the
+        # hidden axis TP. The gated combine contracts over E, so EP's
+        # only collective is one psum at each FFN output.
+        if is_kernel and "dense_0" in path:
+            return P("expert", None, "model")
+        if is_kernel:
+            return P("expert", "model", None)
+        if "dense_0" in path and ndim == 2:
+            return P("expert", "model")
+        return P(*(["expert"] + [None] * (ndim - 1)))
+    if "input_func_mlps/" in path:
+        # Stacked per-input-function MLPs [F, in, out]: the stack axis
+        # is the (semantic) function axis — never sharded; hidden is TP.
         if is_kernel and "dense_0" in path:
             return P(None, None, "model")
         if is_kernel:
@@ -148,17 +172,34 @@ def shard_state(mesh: Mesh, state):
     )
 
 
-def make_sharded_train_step(model, optim_cfg, loss_name: str, mesh: Mesh, state):
+def make_sharded_train_step(
+    model, optim_cfg, loss_name: str, mesh: Mesh, state, microbatches: int = 0
+):
     """jit the train step with explicit in/out shardings over the mesh.
 
     All communication (DP gradient psum, SP partial-sum psums inside the
     linear attention, TP collectives around the sharded GEMMs) is
-    emitted by XLA from these annotations.
+    emitted by XLA from these annotations. A mesh with ``pipe > 1``
+    dispatches to the explicit shard_map pipeline schedule instead
+    (parallel/pipeline.py; ``microbatches`` applies there only).
     """
     import optax
 
     from gnot_tpu.train.trainer import TrainState, batch_loss, make_optimizer
 
+    if mesh.shape.get("pipe", 1) > 1:
+        from gnot_tpu.parallel import pipeline
+
+        return pipeline.make_pipelined_train_step(
+            model, optim_cfg, loss_name, mesh, state, microbatches
+        )
+    if mesh.shape.get("expert", 1) > 1 and (
+        model.config.n_expert % mesh.shape["expert"]
+    ):
+        raise ValueError(
+            f"n_expert={model.config.n_expert} must be divisible by the "
+            f"mesh expert axis ({mesh.shape['expert']})"
+        )
     if getattr(model.config, "ffn_impl", "xla") == "pallas":
         raise ValueError(
             "ffn_impl='pallas' is single-device/DP only (no shard_map "
@@ -198,10 +239,17 @@ def make_sharded_train_step(model, optim_cfg, loss_name: str, mesh: Mesh, state)
     )
 
 
-def make_sharded_eval_step(model, loss_name: str, mesh: Mesh, state):
+def make_sharded_eval_step(model, loss_name: str, mesh: Mesh, state, microbatches: int = 0):
     """jit the eval (loss-only) step over the mesh; the scalar metric
     comes back replicated."""
     from gnot_tpu.train.trainer import batch_loss
+
+    if mesh.shape.get("pipe", 1) > 1:
+        from gnot_tpu.parallel import pipeline
+
+        return pipeline.make_pipelined_eval_step(
+            model, loss_name, mesh, state, microbatches
+        )
 
     p_sh = state_shardings(mesh, state).params
     replicated = NamedSharding(mesh, P())

@@ -123,10 +123,11 @@ def make_hybrid_mesh(cfg: MeshConfig) -> Mesh:
     from jax.experimental import mesh_utils
 
     local = jax.local_device_count()
-    if local % (cfg.seq * cfg.model):
+    rest = cfg.seq * cfg.model * cfg.expert * cfg.pipe
+    if local % rest:
         raise ValueError(
-            f"seq*model={cfg.seq * cfg.model} must divide the {local} "
-            "local devices (SP/TP must not cross hosts)"
+            f"seq*model*expert*pipe={rest} must divide the {local} "
+            "local devices (SP/TP/EP/PP must not cross hosts)"
         )
     if cfg.data > 0:
         if cfg.data % n_proc:
@@ -136,11 +137,11 @@ def make_hybrid_mesh(cfg: MeshConfig) -> Mesh:
             )
         ici_data = cfg.data // n_proc
     else:
-        ici_data = local // (cfg.seq * cfg.model)
-    if ici_data * cfg.seq * cfg.model != local:
+        ici_data = local // rest
+    if ici_data * rest != local:
         raise ValueError(
-            f"per-host mesh {ici_data}x{cfg.seq}x{cfg.model} does not "
-            f"cover {local} local devices"
+            f"per-host mesh {ici_data}x{cfg.seq}x{cfg.model}x{cfg.expert}"
+            f"x{cfg.pipe} does not cover {local} local devices"
         )
     slices = {getattr(d, "slice_index", None) for d in jax.devices()}
     if slices != {None} and len(slices) > 1:
@@ -157,8 +158,10 @@ def make_hybrid_mesh(cfg: MeshConfig) -> Mesh:
                 f"the {n_slices} slices"
             )
         devices = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=(total_data // n_slices, cfg.seq, cfg.model),
-            dcn_mesh_shape=(n_slices, 1, 1),
+            mesh_shape=(
+                total_data // n_slices, cfg.seq, cfg.model, cfg.expert, cfg.pipe,
+            ),
+            dcn_mesh_shape=(n_slices, 1, 1, 1, 1),
         )
     else:
         # Devices that don't advertise DCN slices (CPU fleets,
@@ -173,7 +176,7 @@ def make_hybrid_mesh(cfg: MeshConfig) -> Mesh:
             by_proc.setdefault(d.process_index, []).append(d)
         blocks = [
             np.asarray(sorted(v, key=lambda d: d.id)).reshape(
-                ici_data, cfg.seq, cfg.model
+                ici_data, cfg.seq, cfg.model, cfg.expert, cfg.pipe
             )
             for _, v in sorted(by_proc.items())
         ]

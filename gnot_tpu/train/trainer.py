@@ -173,6 +173,15 @@ class Trainer:
                     "padding (lengths divisible by the seq axis); drop "
                     "--no_bucket"
                 )
+            if self.mesh.shape.get("pipe", 1) > 1:
+                from gnot_tpu.parallel import pipeline
+
+                pipeline.validate_local_batch(
+                    self.mesh,
+                    config.data.batch_size,
+                    config.mesh.microbatches,
+                    max(1, jax.process_count()),
+                )
             if len(train_samples) % config.data.batch_size:
                 drop_remainder = True  # partial batches can't shard
             if len(test_samples) % config.data.batch_size:
@@ -250,10 +259,20 @@ class Trainer:
         # that get thrown away.
         probe = self.test_loader if len(self.test_loader) else self.train_loader
         sample = probe._collate_at(np.arange(min(probe.batch_size, len(probe.samples))))
-        self.state = init_state(
-            self.model, self.config.optim, sample, self.config.train.seed
-        )
-        if self.mesh is not None:
+        if self.mesh is not None and self.mesh.shape.get("pipe", 1) > 1:
+            from gnot_tpu.parallel import pipeline
+
+            # Pipeline layout: block params stacked on a pipe-sharded
+            # layer axis. Checkpoints save/restore this layout directly.
+            self.state = pipeline.init_pipeline_state(
+                self.model, self.config.optim, sample, self.config.train.seed,
+                self.mesh,
+            )
+        else:
+            self.state = init_state(
+                self.model, self.config.optim, sample, self.config.train.seed
+            )
+        if self.mesh is not None and "blocks" not in self.state.params:
             from gnot_tpu.parallel import mesh as mesh_lib
 
             # Shard BEFORE any restore: Orbax then restores straight
@@ -268,14 +287,47 @@ class Trainer:
                 self.state, self.start_epoch, self.best_metric = restored
                 self.host_step = int(self.state.step)  # one-time sync
         if self.mesh is not None:
+            from gnot_tpu.parallel import mesh as mesh_lib
+
             self.train_step = mesh_lib.make_sharded_train_step(
                 self.model, self.config.optim, self.config.train.loss,
-                self.mesh, self.state,
+                self.mesh, self.state, self.config.mesh.microbatches,
             )
             self.eval_step = mesh_lib.make_sharded_eval_step(
-                self.model, self.config.train.loss, self.mesh, self.state
+                self.model, self.config.train.loss, self.mesh, self.state,
+                self.config.mesh.microbatches,
             )
         return self.state
+
+    def standard_params(self):
+        """Current params in the standard ``block_i`` layout (unstacks
+        the pipeline layout when the mesh carries ``pipe > 1``) — the
+        layout predict / torch export / the reference weight mapping
+        expect. Single-process only: multi-process callers must gather
+        first (``gathered_standard_params``), because unstacking indexes
+        eagerly into arrays that may not be fully addressable here."""
+        return self._unstack_if_pipelined(self.state.params)
+
+    def gathered_standard_params(self):
+        """Multi-process variant: allgather the global param values onto
+        every host (collective — ALL processes must call together), then
+        unstack. Gather happens on the stacked tree; eager indexing into
+        a non-fully-addressable sharded array would raise."""
+        from jax.experimental import multihost_utils
+
+        # tiled=True: gather each array's GLOBAL value (the default
+        # stacks a per-process leading axis and rejects global inputs).
+        params = multihost_utils.process_allgather(self.state.params, tiled=True)
+        return self._unstack_if_pipelined(params)
+
+    def _unstack_if_pipelined(self, params):
+        if "blocks" in params:
+            from gnot_tpu.parallel import pipeline
+
+            params = pipeline.unstack_params(
+                params, self.model.config.n_attn_layers
+            )
+        return params
 
     def _device_batch(self, batch: MeshBatch) -> MeshBatch:
         """Place a host batch for the step: sharded over the mesh when
@@ -329,24 +381,15 @@ class Trainer:
                     "impl (mesh-carrying model) is unsupported; use the "
                     "default xla impl"
                 )
-            from jax.experimental import multihost_utils
-
-            # tiled=True: gather the GLOBAL value of each (possibly
-            # non-fully-addressable) array — the default stacks a
-            # per-process leading axis and rejects global inputs.
-            params = multihost_utils.process_allgather(
-                self.state.params, tiled=True
-            )
+        if self._forward is None:
             model = self.model
-            forward = jax.jit(lambda p, b: apply_batch(model, p, b))
-        else:
-            if self._forward is None:
-                model = self.model
-                self._forward = jax.jit(
-                    lambda params, batch: apply_batch(model, params, batch)
-                )
-            forward = self._forward
-            params = self.state.params
+            self._forward = jax.jit(
+                lambda params, batch: apply_batch(model, params, batch)
+            )
+        forward = self._forward
+        params = (
+            self.gathered_standard_params() if multiproc else self.standard_params()
+        )
 
         samples = list(samples)
         n_real = len(samples)

@@ -32,7 +32,9 @@ def test_hybrid_mesh_degenerates_single_process():
         pytest.skip("needs 8 (virtual) devices")
     cfg = MeshConfig(data=2, seq=2, model=2)
     mesh = multihost.make_hybrid_mesh(cfg)
-    assert mesh.shape == {"data": 2, "seq": 2, "model": 2}
+    assert mesh.shape == {
+        "data": 2, "seq": 2, "model": 2, "expert": 1, "pipe": 1,
+    }
 
 
 def test_global_batch_matches_shard_batch():
