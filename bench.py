@@ -19,6 +19,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import time
 
@@ -69,12 +70,42 @@ def build(step_dtype: str, attention_impl: str = "xla", n_points: int = 1024, ba
     return step, state, batch, mc
 
 
-def time_steps(step, state, batch, lr, n_warmup: int, n_steps: int, device) -> float:
-    """Returns real-mesh-points/sec for the train step on `device`."""
+def time_steps(
+    step, state, batch, lr, n_warmup: int, n_steps: int, device,
+    fused: bool = False,
+) -> float:
+    """Returns real-mesh-points/sec for the train step on `device`.
+
+    ``fused=True`` compiles the n_steps iterations into ONE program
+    (lax.scan over the step), so the measurement contains zero per-step
+    host dispatch — the robust mode when the device sits behind a
+    remote tunnel whose per-call latency varies. Default off: the
+    per-step loop is what training actually does."""
     state = jax.device_put(state, device)
     dbatch = jax.device_put(batch, device)
     lr = jax.device_put(lr, device)
-    for _ in range(n_warmup):
+    if fused:
+
+        @functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))
+        def multi(state, b, lr, n):
+            def body(s, _):
+                s, loss = step(s, b, lr)
+                return s, loss
+
+            state, losses = jax.lax.scan(body, state, None, length=n)
+            return state, losses[-1]
+
+        # Warm with the SAME static length the timed call uses — a
+        # different length is a different compiled program, and the
+        # compile would land inside the timed region.
+        state, loss = multi(state, dbatch, lr, n_steps)
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        state, loss = multi(state, dbatch, lr, n_steps)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        return batch.n_real_points * n_steps / dt
+    for _ in range(max(1, n_warmup)):  # >=1: the first call compiles
         state, loss = step(state, dbatch, lr)
     jax.block_until_ready(loss)
     t0 = time.perf_counter()
@@ -119,6 +150,14 @@ def time_torch_steps(batch, mc, lr: float, n_warmup: int, n_steps: int) -> float
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=20)
+    p.add_argument(
+        "--fused_steps", action="store_true",
+        help="compile the timed steps into one lax.scan program (no "
+             "per-step host dispatch in the measurement). Trustworthy "
+             "on LOCAL devices only: remote-tunnel backends have been "
+             "observed returning from block_until_ready before scanned "
+             "programs finish, yielding impossibly high numbers"
+    )
     p.add_argument("--warmup", type=int, default=3)
     p.add_argument(
         "--cpu_steps", type=int, default=10,
@@ -155,7 +194,10 @@ def main():
         args.dtype, args.attention_impl, args.n_points, args.batch_size,
         args.ffn_impl, args.config, args.remat,
     )
-    value = time_steps(step, state, batch, lr, args.warmup, args.steps, accel)
+    value = time_steps(
+        step, state, batch, lr, args.warmup, args.steps, accel,
+        fused=args.fused_steps,
+    )
     if args.mem_stats:
         import sys
 

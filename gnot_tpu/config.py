@@ -40,6 +40,12 @@ class ModelConfig:
     # expert stack tile-resident in VMEM (ops/pallas_ffn.py);
     # single-device / DP only.
     ffn_impl: str = "xla"
+    # Collective schedule combining the sequence-parallel attention
+    # partials on the pallas shard_map path: "psum" (one fused
+    # all-reduce — optimal for the fixed-size Gram payload, the
+    # default) or "ring" (S-1 ppermute hops; ops/collectives.py).
+    # The xla impl's SP collectives are scheduled by XLA — unaffected.
+    sp_collective: str = "psum"
     # Compute dtype for the encoder stack; params stay float32.
     dtype: str = "float32"
     # Rematerialize each attention block in backward (jax.checkpoint):
@@ -56,6 +62,8 @@ class ModelConfig:
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
         if self.ffn_impl not in ("xla", "pallas"):
             raise ValueError(f"unknown ffn_impl {self.ffn_impl!r}")
+        if self.sp_collective not in ("psum", "ring"):
+            raise ValueError(f"unknown sp_collective {self.sp_collective!r}")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -80,6 +80,12 @@ def build_parser() -> argparse.ArgumentParser:
         "--ffn_impl", type=str, default="xla", choices=["xla", "pallas"],
         help="pallas: VMEM-resident fused expert FFN (single-device / DP)"
     )
+    p.add_argument(
+        "--sp_collective", type=str, default="psum", choices=["psum", "ring"],
+        help="sequence-parallel combine schedule on the pallas attention "
+             "mesh path: one fused psum (default) or a ring of ppermute "
+             "hops (ops/collectives.py)"
+    )
     p.add_argument("--dtype", type=str, default="float32", choices=["float32", "bfloat16"])
     p.add_argument(
         "--remat", action="store_true",
@@ -208,6 +214,7 @@ def model_config(cfg: Config, args: argparse.Namespace, train_samples) -> ModelC
         attention_mode=args.attention_mode,
         attention_impl=args.attention_impl,
         ffn_impl=args.ffn_impl,
+        sp_collective=args.sp_collective,
         dtype=args.dtype,
         remat=args.remat,
         **dims,

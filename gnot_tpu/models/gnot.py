@@ -49,6 +49,7 @@ class HNABlock(nn.Module):
     attention_impl: str = "xla"
     ffn_impl: str = "xla"
     mesh: Any = None
+    sp_collective: str = "psum"
 
     @nn.compact
     def __call__(
@@ -68,6 +69,7 @@ class HNABlock(nn.Module):
             parity=self.parity,
             attention_impl=self.attention_impl,
             mesh=self.mesh,
+            sp_collective=self.sp_collective,
             name="cross_attention",
         )(query, input_functions, query_mask=node_mask, func_mask=func_mask)
         ffn1 = GatedExpertFfn(
@@ -89,6 +91,7 @@ class HNABlock(nn.Module):
             parity=self.parity,
             attention_impl=self.attention_impl,
             mesh=self.mesh,
+            sp_collective=self.sp_collective,
             name="self_attention",
         )(query, query_mask=node_mask)
         ffn2 = GatedExpertFfn(
@@ -192,6 +195,7 @@ def block_module(
         attention_impl=cfg.attention_impl,
         ffn_impl=cfg.ffn_impl,
         mesh=mesh,
+        sp_collective=cfg.sp_collective,
         name=name,
     )
 

@@ -107,7 +107,7 @@ def _stacked_dense(features: int, fan_in: int, *, name: str, dtype=None):
     )
 
 
-def _dispatch_fused_nla(q, k, v, mask, n_head, mesh):
+def _dispatch_fused_nla(q, k, v, mask, n_head, mesh, sp_collective="psum"):
     """Route to the single-device kernel or the shard_map'd distributed
     form, mapping the standard mesh axis names (parallel/mesh.py AXES)."""
     if mesh is None:
@@ -123,6 +123,7 @@ def _dispatch_fused_nla(q, k, v, mask, n_head, mesh):
         data_axis="data" if "data" in axes else None,
         seq_axis="seq" if "seq" in axes else None,
         model_axis="model" if "model" in axes else None,
+        sp_collective=sp_collective,
     )
 
 
@@ -159,6 +160,8 @@ class LinearAttention(nn.Module):
     # dispatched through shard_map (DP over "data", SP psum over "seq",
     # head-group TP over "model"). None = single-device pallas_call.
     mesh: Any = None
+    # SP combine schedule on the pallas mesh path: "psum" | "ring".
+    sp_collective: str = "psum"
 
     def _merge(self, x: Array) -> Array:
         if self.parity:
@@ -202,7 +205,8 @@ class LinearAttention(nn.Module):
                 if mask is None:
                     mask = jnp.ones(k_proj.shape[:3], k_proj.dtype)
                 out_f, res_q = _dispatch_fused_nla(
-                    q_proj, k_proj, v_proj, mask, h, self.mesh
+                    q_proj, k_proj, v_proj, mask, h, self.mesh,
+                    self.sp_collective,
                 )
                 res = res_q + jnp.mean(out_f, axis=0)
             else:
@@ -226,7 +230,8 @@ class LinearAttention(nn.Module):
                 if mask is None:
                     mask = jnp.ones(k_proj.shape[:2], k_proj.dtype)
                 out_f, res_q = _dispatch_fused_nla(
-                    q_proj, k_proj[None], v_proj[None], mask[None], h, self.mesh
+                    q_proj, k_proj[None], v_proj[None], mask[None], h,
+                    self.mesh, self.sp_collective,
                 )
                 res = res_q + out_f[0]
             else:

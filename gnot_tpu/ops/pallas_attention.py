@@ -355,6 +355,7 @@ def fused_nla_sp(
     seq_axis: str | None = "seq",
     model_axis: str | None = None,
     interpret: bool | None = None,
+    sp_collective: str = "psum",
 ):
     """Distributed fused attention over a DP x SP x TP device mesh.
 
@@ -371,11 +372,20 @@ def fused_nla_sp(
       diagonal), so each shard runs the kernel on its local heads with
       no communication at all.
 
-    Differentiable end-to-end (psum transposes to psum through the
-    per-stage custom VJPs).
+    ``sp_collective`` selects the schedule that combines the per-shard
+    Gram partials over ``seq_axis``: ``"psum"`` (one fused all-reduce,
+    the default and recommendation) or ``"ring"`` (S-1 ppermute hops —
+    see ops/collectives.ring_allreduce for when that schedule makes
+    sense). Differentiable end-to-end either way (psum transposes to
+    psum, the ring replays in reverse, through the per-stage custom
+    VJPs).
     """
     from jax import shard_map
 
+    from gnot_tpu.ops.collectives import ring_allreduce
+
+    if sp_collective not in ("psum", "ring"):
+        raise ValueError(f"unknown sp_collective {sp_collective!r}")
     model_size = mesh.shape[model_axis] if model_axis else 1
     if n_head % model_size:
         raise ValueError(
@@ -387,8 +397,13 @@ def fused_nla_sp(
     def local_fn(q_l, k_l, v_l, m_l):
         kv_l, ksum_l = nla_reduce(k_l, v_l, m_l, local_heads, interpret)
         if seq_axis:
-            kv_l = jax.lax.psum(kv_l, seq_axis)
-            ksum_l = jax.lax.psum(ksum_l, seq_axis)
+            if sp_collective == "ring":
+                size = mesh.shape[seq_axis]
+                kv_l = ring_allreduce(kv_l, seq_axis, size)
+                ksum_l = ring_allreduce(ksum_l, seq_axis, size)
+            else:
+                kv_l = jax.lax.psum(kv_l, seq_axis)
+                ksum_l = jax.lax.psum(ksum_l, seq_axis)
         return nla_apply(q_l, kv_l, ksum_l, local_heads, interpret)
 
     return shard_map(

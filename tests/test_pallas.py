@@ -202,6 +202,63 @@ def test_fused_nla_sp_matches_single_device():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-5, atol=1e-6)
 
 
+def test_fused_nla_sp_ring_matches_psum():
+    """The ring all-reduce schedule (S-1 ppermute hops) must be
+    numerically interchangeable with the one-shot psum, forward and
+    backward (the backward replays the ring in reverse)."""
+    from jax.sharding import Mesh
+
+    from gnot_tpu.ops.pallas_attention import fused_nla_sp
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("seq",))
+
+    b, h, l, lk, e, f = 2, 4, 64, 32, 32, 2
+    keys = jax.random.split(jax.random.key(7), 4)
+    q = _rand(keys[0], b, l, e)
+    k = _rand(keys[1], f, b, lk, e)
+    v = _rand(keys[2], f, b, lk, e)
+    mask = (jax.random.uniform(keys[3], (f, b, lk)) > 0.3).astype(jnp.float32)
+    mask = mask.at[:, :, 0].set(1.0)
+
+    out_r, qs_r = fused_nla_sp(q, k, v, mask, h, mesh, sp_collective="ring")
+    out_p, qs_p = fused_nla_sp(q, k, v, mask, h, mesh, sp_collective="psum")
+    np.testing.assert_allclose(np.asarray(out_r), np.asarray(out_p), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(qs_r), np.asarray(qs_p), rtol=1e-5, atol=1e-6)
+
+    def loss(q, k, v, collective):
+        out, qs = fused_nla_sp(q, k, v, mask, h, mesh, sp_collective=collective)
+        return jnp.sum(out**2) + jnp.sum(qs * 0.5)
+
+    g_r = jax.grad(lambda *a: loss(*a, "ring"), argnums=(0, 1, 2))(q, k, v)
+    g_p = jax.grad(lambda *a: loss(*a, "psum"), argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_r, g_p):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=1e-5, atol=1e-6)
+
+
+def test_ring_allreduce_matches_psum_generic():
+    """ops/collectives.ring_allreduce == lax.psum for a generic payload."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from gnot_tpu.ops.collectives import ring_allreduce
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("s",))
+    x = jax.random.normal(jax.random.key(0), (8, 4, 4))
+
+    ring = jax.shard_map(
+        lambda t: ring_allreduce(t, "s", 8),
+        mesh=mesh, in_specs=P("s"), out_specs=P("s"),
+    )(x)
+    ps = jax.shard_map(
+        lambda t: jax.lax.psum(t, "s"),
+        mesh=mesh, in_specs=P("s"), out_specs=P("s"),
+    )(x)
+    np.testing.assert_allclose(np.asarray(ring), np.asarray(ps), rtol=1e-6, atol=1e-6)
+
+
 def test_pallas_rejects_parity():
     mc = ModelConfig(
         input_dim=2,
