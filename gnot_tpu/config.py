@@ -163,6 +163,13 @@ class TrainConfig:
     # any step raises with the producing op's location instead of
     # silently propagating.
     debug_checks: bool = False
+    # Dispatch K training steps (over K different batches) as ONE
+    # compiled program (lax.scan over stacked batches): host->device
+    # dispatch drops to 1/K per step. Numerically identical to K single
+    # steps. Batches must share shapes to stack — groups break at
+    # bucket-shape changes and epoch ends, and the remainder runs
+    # through the single-step path.
+    steps_per_dispatch: int = 1
     # Fault injection: stop cleanly after this many epochs (0 = off),
     # simulating a preemption mid-run. The schedule/epoch horizon stays
     # sized by `epochs`, so a --resume run continues the SAME regime —

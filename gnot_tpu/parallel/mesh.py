@@ -78,8 +78,18 @@ def batch_pspecs() -> MeshBatch:
     )
 
 
-def batch_shardings(mesh: Mesh, batch: MeshBatch) -> MeshBatch:
-    specs = batch_pspecs()
+def stacked_batch_pspecs() -> MeshBatch:
+    """PartitionSpecs for a K-step stacked MeshBatch (leading step axis
+    unsharded — the scan iterates it)."""
+    return jax.tree.map(
+        lambda spec: P(*((None,) + tuple(spec))),
+        batch_pspecs(),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def batch_shardings(mesh: Mesh, batch: MeshBatch, specs: MeshBatch | None = None) -> MeshBatch:
+    specs = batch_pspecs() if specs is None else specs
     return jax.tree.map(
         lambda spec, leaf: NamedSharding(mesh, spec) if leaf is not None else None,
         specs,
@@ -88,12 +98,14 @@ def batch_shardings(mesh: Mesh, batch: MeshBatch) -> MeshBatch:
     )
 
 
-def shard_batch(mesh: Mesh, batch: MeshBatch) -> MeshBatch:
-    """Host->device transfer with the batch layout applied."""
+def shard_batch(mesh: Mesh, batch: MeshBatch, *, stacked: bool = False) -> MeshBatch:
+    """Host->device transfer with the batch layout applied
+    (``stacked=True`` for a K-step stacked batch)."""
+    specs = stacked_batch_pspecs() if stacked else None
     return jax.tree.map(
         lambda leaf, sh: jax.device_put(leaf, sh),
         batch,
-        batch_shardings(mesh, batch),
+        batch_shardings(mesh, batch, specs),
     )
 
 
@@ -172,27 +184,10 @@ def shard_state(mesh: Mesh, state):
     )
 
 
-def make_sharded_train_step(
-    model, optim_cfg, loss_name: str, mesh: Mesh, state, microbatches: int = 0
-):
-    """jit the train step with explicit in/out shardings over the mesh.
-
-    All communication (DP gradient psum, SP partial-sum psums inside the
-    linear attention, TP collectives around the sharded GEMMs) is
-    emitted by XLA from these annotations. A mesh with ``pipe > 1``
-    dispatches to the explicit shard_map pipeline schedule instead
-    (parallel/pipeline.py; ``microbatches`` applies there only).
-    """
-    import optax
-
-    from gnot_tpu.train.trainer import TrainState, batch_loss, make_optimizer
-
-    if mesh.shape.get("pipe", 1) > 1:
-        from gnot_tpu.parallel import pipeline
-
-        return pipeline.make_pipelined_train_step(
-            model, optim_cfg, loss_name, mesh, state, microbatches
-        )
+def _validate_gspmd(model, mesh: Mesh) -> None:
+    """Config-validity guards shared by every GSPMD step builder —
+    clear ValueErrors at build time instead of opaque XLA partitioning
+    failures mid-compile."""
     if mesh.shape.get("expert", 1) > 1 and (
         model.config.n_expert % mesh.shape["expert"]
     ):
@@ -217,22 +212,63 @@ def make_sharded_train_step(
                 "attention_impl='xla'"
             )
 
-    def step(state: TrainState, batch: MeshBatch, lr):
-        loss, grads = jax.value_and_grad(
-            lambda p: batch_loss(model, p, batch, loss_name)
-        )(state.params)
-        tx = make_optimizer(optim_cfg, lr)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return (
-            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
-            loss,
+
+def make_sharded_train_step(
+    model, optim_cfg, loss_name: str, mesh: Mesh, state, microbatches: int = 0
+):
+    """jit the train step with explicit in/out shardings over the mesh.
+
+    All communication (DP gradient psum, SP partial-sum psums inside the
+    linear attention, TP collectives around the sharded GEMMs) is
+    emitted by XLA from these annotations. A mesh with ``pipe > 1``
+    dispatches to the explicit shard_map pipeline schedule instead
+    (parallel/pipeline.py; ``microbatches`` applies there only).
+    """
+    from gnot_tpu.train.trainer import train_step_body
+
+    if mesh.shape.get("pipe", 1) > 1:
+        from gnot_tpu.parallel import pipeline
+
+        return pipeline.make_pipelined_train_step(
+            model, optim_cfg, loss_name, mesh, state, microbatches
         )
+    _validate_gspmd(model, mesh)
+    body = train_step_body(model, optim_cfg, loss_name)
+
+    def step(state, batch: MeshBatch, lr):
+        return body(state, (batch, lr))
 
     st_sh = state_shardings(mesh, state)
     replicated = NamedSharding(mesh, P())
     return jax.jit(
         step,
+        in_shardings=(st_sh, None, replicated),
+        out_shardings=(st_sh, replicated),
+        donate_argnums=(0,),
+    )
+
+
+def make_sharded_multi_train_step(model, optim_cfg, loss_name: str, mesh: Mesh, state):
+    """K-step scanned train step over the mesh (see
+    trainer.make_multi_train_step): one dispatch, one program, all
+    GSPMD collectives inside the scan body."""
+    from gnot_tpu.train.trainer import train_step_body
+
+    if mesh.shape.get("pipe", 1) > 1:
+        raise ValueError(
+            "steps_per_dispatch > 1 does not compose with the pipeline "
+            "mesh path; use single-step dispatch with pipe > 1"
+        )
+    _validate_gspmd(model, mesh)
+    body = train_step_body(model, optim_cfg, loss_name)
+
+    def multi_step(state, batches, lrs):
+        return jax.lax.scan(body, state, (batches, lrs))
+
+    st_sh = state_shardings(mesh, state)
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        multi_step,
         in_shardings=(st_sh, None, replicated),
         out_shardings=(st_sh, replicated),
         donate_argnums=(0,),

@@ -197,10 +197,15 @@ def shard_samples(
     return list(samples)[i::n]
 
 
-def global_batch(mesh: Mesh, local_batch: MeshBatch) -> MeshBatch:
+def global_batch(
+    mesh: Mesh, local_batch: MeshBatch, *, stacked: bool = False
+) -> MeshBatch:
     """Assemble a globally-sharded MeshBatch from this process's local
-    batch (the batch axis concatenates across hosts in process order)."""
-    specs = batch_pspecs()
+    batch (the batch axis concatenates across hosts in process order).
+    ``stacked=True`` for K-step stacked batches (leading step axis)."""
+    from gnot_tpu.parallel.mesh import stacked_batch_pspecs
+
+    specs = stacked_batch_pspecs() if stacked else batch_pspecs()
 
     def put(spec, leaf):
         if leaf is None:

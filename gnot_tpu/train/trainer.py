@@ -82,20 +82,61 @@ def batch_loss(model: GNOT, params, batch: MeshBatch, loss_name: str) -> jax.Arr
     return LOSSES[loss_name](preds, batch.y, batch.node_mask)
 
 
-def make_train_step(model: GNOT, optim_cfg: OptimConfig, loss_name: str) -> Callable:
-    @functools.partial(jax.jit, donate_argnums=(0,))
-    def train_step(state: TrainState, batch: MeshBatch, lr: jax.Array):
+def train_step_body(model: GNOT, optim_cfg: OptimConfig, loss_name: str):
+    """THE training-step math — the one copy every step builder wraps
+    (single-device, GSPMD-sharded, and the K-step scanned variants), so
+    'numerically identical across dispatch modes' holds by construction.
+    Shaped as a scan body: ``body(state, (batch, lr))``. The LR is a
+    traced scalar: optax.adamw is pure, so building the transform inside
+    the compiled step is free and recompile-safe."""
+
+    def body(state: TrainState, xs):
+        batch, lr = xs
         loss, grads = jax.value_and_grad(
             lambda p: batch_loss(model, p, batch, loss_name)
         )(state.params)
-        # The LR is a traced scalar: optax.adamw is pure, so building the
-        # transform inside the compiled step is free and recompile-safe.
         tx = make_optimizer(optim_cfg, lr)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        return TrainState(params=params, opt_state=opt_state, step=state.step + 1), loss
+        return (
+            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+            loss,
+        )
+
+    return body
+
+
+def make_train_step(model: GNOT, optim_cfg: OptimConfig, loss_name: str) -> Callable:
+    body = train_step_body(model, optim_cfg, loss_name)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: TrainState, batch: MeshBatch, lr: jax.Array):
+        return body(state, (batch, lr))
 
     return train_step
+
+
+def make_multi_train_step(
+    model: GNOT, optim_cfg: OptimConfig, loss_name: str
+) -> Callable:
+    """K training steps over K different batches as ONE compiled
+    program: ``lax.scan`` over a MeshBatch whose leaves carry a leading
+    step axis, with a ``[K]`` array of per-step learning rates. One
+    host->device dispatch per K steps — the lever when dispatch latency
+    (remote tunnels, tiny models) rivals step compute. Numerically
+    identical to K ``make_train_step`` calls."""
+    body = train_step_body(model, optim_cfg, loss_name)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def multi_step(state: TrainState, batches: MeshBatch, lrs: jax.Array):
+        return jax.lax.scan(body, state, (batches, lrs))
+
+    return multi_step
+
+
+def stack_batches(batches: list[MeshBatch]) -> MeshBatch:
+    """Stack same-shape host batches along a new leading step axis."""
+    return jax.tree.map(lambda *xs: np.stack(xs), *batches)
 
 
 def make_eval_step(model: GNOT, loss_name: str) -> Callable:
@@ -243,8 +284,14 @@ class Trainer:
             steps_per_epoch=len(self.train_loader),
             epochs=config.train.epochs,
         )
+        if config.train.steps_per_dispatch < 1:
+            raise ValueError(
+                f"steps_per_dispatch must be >= 1, got "
+                f"{config.train.steps_per_dispatch}"
+            )
         self.metrics_sink = metrics_sink
         self.checkpointer = checkpointer
+        self.multi_train_step = None
         self.state: TrainState | None = None
         self._forward = None  # jitted inference fn, built on first predict()
         self.best_metric = float("inf")
@@ -297,6 +344,18 @@ class Trainer:
                 self.model, self.config.train.loss, self.mesh, self.state,
                 self.config.mesh.microbatches,
             )
+        if self.config.train.steps_per_dispatch > 1:
+            if self.mesh is None:
+                self.multi_train_step = make_multi_train_step(
+                    self.model, self.config.optim, self.config.train.loss
+                )
+            else:
+                from gnot_tpu.parallel import mesh as mesh_lib
+
+                self.multi_train_step = mesh_lib.make_sharded_multi_train_step(
+                    self.model, self.config.optim, self.config.train.loss,
+                    self.mesh, self.state,
+                )
         return self.state
 
     def standard_params(self):
@@ -329,16 +388,17 @@ class Trainer:
             )
         return params
 
-    def _device_batch(self, batch: MeshBatch) -> MeshBatch:
+    def _device_batch(self, batch: MeshBatch, *, stacked: bool = False) -> MeshBatch:
         """Place a host batch for the step: sharded over the mesh when
-        distributed (cross-host assembly on multi-process runs)."""
+        distributed (cross-host assembly on multi-process runs).
+        ``stacked=True`` for K-step stacked batches."""
         if self.mesh is None:
             return batch
         from gnot_tpu.parallel import mesh as mesh_lib, multihost
 
         if jax.process_count() > 1:
-            return multihost.global_batch(self.mesh, batch)
-        return mesh_lib.shard_batch(self.mesh, batch)
+            return multihost.global_batch(self.mesh, batch, stacked=stacked)
+        return mesh_lib.shard_batch(self.mesh, batch, stacked=stacked)
 
     def evaluate(self) -> float:
         if len(self.test_loader) == 0:
@@ -463,45 +523,111 @@ class Trainer:
             self.train_loader.set_epoch(epoch)
             t0 = time.perf_counter()
             losses, points = [], 0
+            k_dis = cfg.train.steps_per_dispatch
+
+            def run_single(batch):
+                lr = self.lr_fn(self.host_step, epoch)
+                self.state, loss = self.train_step(
+                    self.state,
+                    self._device_batch(batch),
+                    jnp.asarray(lr, jnp.float32),
+                )
+                self.host_step += 1
+                losses.append(loss)
+                if cfg.train.debug_checks and not np.isfinite(
+                    float(np.asarray(loss))
+                ):
+                    # Deterministic guard (jax_debug_nans does not
+                    # reliably fire on warm jit paths); the
+                    # sync-per-step cost is the debug-build trade.
+                    raise FloatingPointError(
+                        f"non-finite train loss at epoch {epoch}, "
+                        f"step {self.host_step}"
+                    )
+                if (
+                    self.metrics_sink is not None
+                    and cfg.train.log_every
+                    and self.host_step % cfg.train.log_every == 0
+                ):
+                    # float(loss) syncs; per-step logging is opt-in
+                    # and meant for coarse cadences.
+                    self.metrics_sink.log(
+                        step=self.host_step,
+                        epoch=epoch,
+                        loss=float(np.asarray(loss)),
+                        lr=lr,
+                    )
+
+            def run_group(group):
+                # One dispatch for len(group) steps: stacked batches +
+                # per-step LRs scanned on device (make_multi_train_step).
+                lrs = [
+                    self.lr_fn(self.host_step + i, epoch)
+                    for i in range(len(group))
+                ]
+                self.state, loss_k = self.multi_train_step(
+                    self.state,
+                    self._device_batch(stack_batches(group), stacked=True),
+                    jnp.asarray(lrs, dtype=jnp.float32),
+                )
+                start = self.host_step
+                self.host_step += len(group)
+                losses.append(loss_k)
+                if cfg.train.debug_checks and not np.all(
+                    np.isfinite(np.asarray(loss_k))
+                ):
+                    raise FloatingPointError(
+                        f"non-finite train loss at epoch {epoch}, "
+                        f"steps {start + 1}..{self.host_step}"
+                    )
+                if self.metrics_sink is not None and cfg.train.log_every:
+                    host_lk = None
+                    for i in range(len(group)):
+                        s = start + i + 1
+                        if s % cfg.train.log_every == 0:
+                            if host_lk is None:
+                                host_lk = np.asarray(loss_k)  # one sync
+                            self.metrics_sink.log(
+                                step=s,
+                                epoch=epoch,
+                                loss=float(host_lk[i]),
+                                lr=lrs[i],
+                            )
+
+            def shapes_key(batch):
+                return tuple(np.shape(l) for l in jax.tree.leaves(batch))
+
             with profiling.trace_epoch(
                 cfg.train.profile_dir, epoch, trace_at=trace_at
             ):
                 with profiling.annotate("train_epoch"):
+                    pending, pend_key = [], None
                     for batch in self.train_loader:
-                        lr = self.lr_fn(self.host_step, epoch)
-                        self.state, loss = self.train_step(
-                            self.state,
-                            self._device_batch(batch),
-                            jnp.asarray(lr, jnp.float32),
-                        )
-                        self.host_step += 1
-                        losses.append(loss)
                         points += batch.n_real_points
-                        if cfg.train.debug_checks and not np.isfinite(
-                            float(np.asarray(loss))
-                        ):
-                            # Deterministic guard (jax_debug_nans does
-                            # not reliably fire on warm jit paths); the
-                            # sync-per-step cost is the debug-build
-                            # trade.
-                            raise FloatingPointError(
-                                f"non-finite train loss at epoch {epoch}, "
-                                f"step {self.host_step}"
-                            )
-                        if (
-                            self.metrics_sink is not None
-                            and cfg.train.log_every
-                            and self.host_step % cfg.train.log_every == 0
-                        ):
-                            # float(loss) syncs; per-step logging is
-                            # opt-in and meant for coarse cadences.
-                            self.metrics_sink.log(
-                                step=self.host_step,
-                                epoch=epoch,
-                                loss=float(np.asarray(loss)),
-                                lr=lr,
-                            )
-                train_loss = float(np.mean([np.asarray(l) for l in losses]))
+                        if k_dis == 1:
+                            run_single(batch)
+                            continue
+                        key = shapes_key(batch)
+                        if pending and key != pend_key:
+                            # Bucket-shape change: the open group can't
+                            # stack further; run its members singly.
+                            for b in pending:
+                                run_single(b)
+                            pending = []
+                        pending.append(batch)
+                        pend_key = key
+                        if len(pending) == k_dis:
+                            run_group(pending)
+                            pending = []
+                    for b in pending:  # epoch-end remainder
+                        run_single(b)
+                train_loss = float(
+                    np.mean(
+                        np.concatenate(
+                            [np.atleast_1d(np.asarray(l)) for l in losses]
+                        )
+                    )
+                ) if losses else float("nan")
                 dt = time.perf_counter() - t0
                 # Reference's exact console line (main.py:105).
                 print(f"Epoch {epoch}, Loss: {train_loss}")
