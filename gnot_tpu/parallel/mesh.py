@@ -248,17 +248,21 @@ def make_sharded_train_step(
     )
 
 
+def _reject_pipe_multi(mesh: Mesh) -> None:
+    if mesh.shape.get("pipe", 1) > 1:
+        raise ValueError(
+            "steps_per_dispatch > 1 does not compose with the pipeline "
+            "mesh path; use single-step dispatch with pipe > 1"
+        )
+
+
 def make_sharded_multi_train_step(model, optim_cfg, loss_name: str, mesh: Mesh, state):
     """K-step scanned train step over the mesh (see
     trainer.make_multi_train_step): one dispatch, one program, all
     GSPMD collectives inside the scan body."""
     from gnot_tpu.train.trainer import train_step_body
 
-    if mesh.shape.get("pipe", 1) > 1:
-        raise ValueError(
-            "steps_per_dispatch > 1 does not compose with the pipeline "
-            "mesh path; use single-step dispatch with pipe > 1"
-        )
+    _reject_pipe_multi(mesh)
     _validate_gspmd(model, mesh)
     body = train_step_body(model, optim_cfg, loss_name)
 
@@ -278,7 +282,7 @@ def make_sharded_multi_train_step(model, optim_cfg, loss_name: str, mesh: Mesh, 
 def make_sharded_eval_step(model, loss_name: str, mesh: Mesh, state, microbatches: int = 0):
     """jit the eval (loss-only) step over the mesh; the scalar metric
     comes back replicated."""
-    from gnot_tpu.train.trainer import batch_loss
+    from gnot_tpu.train.trainer import eval_step_body
 
     if mesh.shape.get("pipe", 1) > 1:
         from gnot_tpu.parallel import pipeline
@@ -290,7 +294,23 @@ def make_sharded_eval_step(model, loss_name: str, mesh: Mesh, state, microbatche
     p_sh = state_shardings(mesh, state).params
     replicated = NamedSharding(mesh, P())
     return jax.jit(
-        lambda params, batch: batch_loss(model, params, batch, loss_name),
+        eval_step_body(model, loss_name),
+        in_shardings=(p_sh, None),
+        out_shardings=replicated,
+    )
+
+
+def make_sharded_multi_eval_step(model, loss_name: str, mesh: Mesh, state):
+    """K eval losses over K stacked batches in one sharded dispatch."""
+    from gnot_tpu.train.trainer import eval_step_body
+
+    _reject_pipe_multi(mesh)
+    _validate_gspmd(model, mesh)
+    body = eval_step_body(model, loss_name)
+    p_sh = state_shardings(mesh, state).params
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        lambda params, batches: jax.lax.map(lambda b: body(params, b), batches),
         in_shardings=(p_sh, None),
         out_shardings=replicated,
     )

@@ -139,12 +139,54 @@ def stack_batches(batches: list[MeshBatch]) -> MeshBatch:
     return jax.tree.map(lambda *xs: np.stack(xs), *batches)
 
 
-def make_eval_step(model: GNOT, loss_name: str) -> Callable:
-    @jax.jit
-    def eval_step(params, batch: MeshBatch):
+def eval_step_body(model: GNOT, loss_name: str) -> Callable:
+    """THE eval math — the one copy the single-device and sharded,
+    single- and multi-batch eval builders all wrap."""
+
+    def body(params, batch: MeshBatch):
         return batch_loss(model, params, batch, loss_name)
 
-    return eval_step
+    return body
+
+
+def make_eval_step(model: GNOT, loss_name: str) -> Callable:
+    return jax.jit(eval_step_body(model, loss_name))
+
+
+def make_multi_eval_step(model: GNOT, loss_name: str) -> Callable:
+    """K eval losses over K stacked batches in one dispatch (the eval
+    counterpart of make_multi_train_step)."""
+    body = eval_step_body(model, loss_name)
+
+    @jax.jit
+    def multi_eval(params, batches: MeshBatch):
+        return jax.lax.map(lambda b: body(params, b), batches)
+
+    return multi_eval
+
+
+def group_batches(batches, k: int):
+    """Group same-shape batches into runs of k for one-dispatch
+    execution: yields ``("group", [b1..bk])`` for full groups and
+    ``("single", b)`` for shape-change flushes and remainders. THE one
+    grouping discipline — the train and eval loops both iterate this,
+    so their dispatch sequences stay in lockstep across hosts (a
+    divergence would be a cross-host hang, not an error)."""
+    pending, key = [], None
+    for b in batches:
+        bk = tuple(np.shape(l) for l in jax.tree.leaves(b))
+        if pending and bk != key:
+            # Bucket-shape change: the open group can't stack further.
+            for p in pending:
+                yield "single", p
+            pending = []
+        pending.append(b)
+        key = bk
+        if len(pending) == k:
+            yield "group", pending
+            pending = []
+    for p in pending:  # remainder
+        yield "single", p
 
 
 def init_state(model: GNOT, optim_cfg: OptimConfig, sample_batch: MeshBatch, seed: int) -> TrainState:
@@ -292,6 +334,7 @@ class Trainer:
         self.metrics_sink = metrics_sink
         self.checkpointer = checkpointer
         self.multi_train_step = None
+        self.multi_eval_step = None
         self.state: TrainState | None = None
         self._forward = None  # jitted inference fn, built on first predict()
         self.best_metric = float("inf")
@@ -349,12 +392,18 @@ class Trainer:
                 self.multi_train_step = make_multi_train_step(
                     self.model, self.config.optim, self.config.train.loss
                 )
+                self.multi_eval_step = make_multi_eval_step(
+                    self.model, self.config.train.loss
+                )
             else:
                 from gnot_tpu.parallel import mesh as mesh_lib
 
                 self.multi_train_step = mesh_lib.make_sharded_multi_train_step(
                     self.model, self.config.optim, self.config.train.loss,
                     self.mesh, self.state,
+                )
+                self.multi_eval_step = mesh_lib.make_sharded_multi_eval_step(
+                    self.model, self.config.train.loss, self.mesh, self.state
                 )
         return self.state
 
@@ -405,6 +454,29 @@ class Trainer:
             # No test set: nothing to select a best checkpoint on
             # (np.mean([]) would propagate NaN into best-metric logic).
             return float("inf")
+        k = self.config.train.steps_per_dispatch
+        metrics: list[np.ndarray] = []
+        if k > 1 and self.multi_eval_step is not None:
+            # The SAME grouping iterator as the train loop (group_batches).
+            for kind, item in group_batches(self.test_loader, k):
+                if kind == "group":
+                    metrics.append(
+                        np.asarray(
+                            self.multi_eval_step(
+                                self.state.params,
+                                self._device_batch(
+                                    stack_batches(item), stacked=True
+                                ),
+                            )
+                        )
+                    )
+                else:
+                    metrics.append(
+                        np.asarray(
+                            self.eval_step(self.state.params, self._device_batch(item))
+                        )
+                    )
+            return float(np.mean(np.concatenate([np.atleast_1d(m) for m in metrics])))
         metrics = [
             np.asarray(self.eval_step(self.state.params, self._device_batch(b)))
             for b in self.test_loader
@@ -594,33 +666,25 @@ class Trainer:
                                 lr=lrs[i],
                             )
 
-            def shapes_key(batch):
-                return tuple(np.shape(l) for l in jax.tree.leaves(batch))
-
             with profiling.trace_epoch(
                 cfg.train.profile_dir, epoch, trace_at=trace_at
             ):
                 with profiling.annotate("train_epoch"):
-                    pending, pend_key = [], None
-                    for batch in self.train_loader:
-                        points += batch.n_real_points
-                        if k_dis == 1:
+                    if k_dis == 1:
+                        for batch in self.train_loader:
+                            points += batch.n_real_points
                             run_single(batch)
-                            continue
-                        key = shapes_key(batch)
-                        if pending and key != pend_key:
-                            # Bucket-shape change: the open group can't
-                            # stack further; run its members singly.
-                            for b in pending:
-                                run_single(b)
-                            pending = []
-                        pending.append(batch)
-                        pend_key = key
-                        if len(pending) == k_dis:
-                            run_group(pending)
-                            pending = []
-                    for b in pending:  # epoch-end remainder
-                        run_single(b)
+                    else:
+                        # The SAME grouping iterator evaluate() uses.
+                        for kind, item in group_batches(
+                            self.train_loader, k_dis
+                        ):
+                            if kind == "group":
+                                points += sum(b.n_real_points for b in item)
+                                run_group(item)
+                            else:
+                                points += item.n_real_points
+                                run_single(item)
                 train_loss = float(
                     np.mean(
                         np.concatenate(

@@ -337,4 +337,15 @@ def test_trainer_fit_steps_per_dispatch_matches_single(capsys):
     np.testing.assert_allclose(b1, b2, rtol=1e-5)
     lines1 = [l for l in out1.splitlines() if l.startswith("Epoch")]
     lines2 = [l for l in out2.splitlines() if l.startswith("Epoch")]
-    assert lines1 == lines2, f"console outputs diverge:\n{lines1}\n{lines2}"
+    assert len(lines1) == len(lines2) and lines1
+    for l1, l2 in zip(lines1, lines2):
+        p1, v1 = l1.rsplit(": ", 1)
+        p2, v2 = l2.rsplit(": ", 1)
+        assert p1 == p2
+        # Same math by construction (shared train_step_body), but the
+        # scanned and standalone programs may fuse float reductions
+        # differently — compare values, not reprs.
+        np.testing.assert_allclose(
+            float(v1), float(v2), rtol=1e-6,
+            err_msg=f"console outputs diverge: {l1!r} vs {l2!r}",
+        )
