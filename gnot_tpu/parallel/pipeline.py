@@ -41,7 +41,6 @@ matches the single-device step to float tolerance.
 
 from __future__ import annotations
 
-import functools
 from typing import Any
 
 import jax
@@ -330,10 +329,8 @@ def make_pipelined_train_step(
 ):
     """jit'd train step whose forward pipelines the block stack. The
     ``state`` must be in pipeline layout (init_pipeline_state)."""
-    import optax
-
     from gnot_tpu.ops.segment import LOSSES
-    from gnot_tpu.train.trainer import TrainState, make_optimizer
+    from gnot_tpu.train.trainer import train_step_body
 
     if "blocks" not in state.params:
         raise ValueError(
@@ -344,19 +341,21 @@ def make_pipelined_train_step(
     _validate(model.config, mesh, n_micro)
     cfg = model.config
 
-    def step(state: TrainState, batch: MeshBatch, lr):
-        def loss_fn(params):
-            preds = pipelined_forward(cfg, mesh, n_micro, params, batch)
-            return LOSSES[loss_name](preds, batch.y, batch.node_mask)
+    # The shared step math with the shard_map pipeline substituted as
+    # the forward.
+    body = train_step_body(
+        model,
+        optim_cfg,
+        loss_name,
+        loss_fn=lambda params, batch: LOSSES[loss_name](
+            pipelined_forward(cfg, mesh, n_micro, params, batch),
+            batch.y,
+            batch.node_mask,
+        ),
+    )
 
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        tx = make_optimizer(optim_cfg, lr)
-        updates, opt_state = tx.update(grads, state.opt_state, state.params)
-        params = optax.apply_updates(state.params, updates)
-        return (
-            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
-            loss,
-        )
+    def step(state, batch: MeshBatch, lr):
+        return body(state, (batch, lr))
 
     st_sh = state_shardings(mesh, state)
     replicated = NamedSharding(mesh, P())

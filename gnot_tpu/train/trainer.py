@@ -82,19 +82,27 @@ def batch_loss(model: GNOT, params, batch: MeshBatch, loss_name: str) -> jax.Arr
     return LOSSES[loss_name](preds, batch.y, batch.node_mask)
 
 
-def train_step_body(model: GNOT, optim_cfg: OptimConfig, loss_name: str):
+def train_step_body(
+    model: GNOT,
+    optim_cfg: OptimConfig,
+    loss_name: str,
+    *,
+    loss_fn: Callable | None = None,
+):
     """THE training-step math — the one copy every step builder wraps
-    (single-device, GSPMD-sharded, and the K-step scanned variants), so
+    (single-device, GSPMD-sharded, K-step scanned, and pipelined), so
     'numerically identical across dispatch modes' holds by construction.
     Shaped as a scan body: ``body(state, (batch, lr))``. The LR is a
     traced scalar: optax.adamw is pure, so building the transform inside
-    the compiled step is free and recompile-safe."""
+    the compiled step is free and recompile-safe. ``loss_fn(params,
+    batch)`` overrides the forward (the pipeline path substitutes its
+    shard_map forward); default is the standard ``batch_loss``."""
+    if loss_fn is None:
+        loss_fn = lambda p, batch: batch_loss(model, p, batch, loss_name)
 
     def body(state: TrainState, xs):
         batch, lr = xs
-        loss, grads = jax.value_and_grad(
-            lambda p: batch_loss(model, p, batch, loss_name)
-        )(state.params)
+        loss, grads = jax.value_and_grad(lambda p: loss_fn(p, batch))(state.params)
         tx = make_optimizer(optim_cfg, lr)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
@@ -171,7 +179,12 @@ def group_batches(batches, k: int):
     ``("single", b)`` for shape-change flushes and remainders. THE one
     grouping discipline — the train and eval loops both iterate this,
     so their dispatch sequences stay in lockstep across hosts (a
-    divergence would be a cross-host hang, not an error)."""
+    divergence would be a cross-host hang, not an error). ``k < 2``
+    degenerates to all-singles (the plain one-step dispatch path)."""
+    if k < 2:
+        for b in batches:
+            yield "single", b
+        return
     pending, key = [], None
     for b in batches:
         bk = tuple(np.shape(l) for l in jax.tree.leaves(b))
@@ -454,37 +467,35 @@ class Trainer:
             # No test set: nothing to select a best checkpoint on
             # (np.mean([]) would propagate NaN into best-metric logic).
             return float("inf")
-        k = self.config.train.steps_per_dispatch
+        # The SAME grouping iterator as the train loop (group_batches;
+        # all-singles when steps_per_dispatch is 1 or the multi builder
+        # is absent). In multi-process mode each batch is assembled
+        # globally (_device_batch -> global_batch), so every process
+        # computes the same full-test metric — no cross-host
+        # aggregation needed.
+        k = (
+            self.config.train.steps_per_dispatch
+            if self.multi_eval_step is not None
+            else 1
+        )
         metrics: list[np.ndarray] = []
-        if k > 1 and self.multi_eval_step is not None:
-            # The SAME grouping iterator as the train loop (group_batches).
-            for kind, item in group_batches(self.test_loader, k):
-                if kind == "group":
-                    metrics.append(
-                        np.asarray(
-                            self.multi_eval_step(
-                                self.state.params,
-                                self._device_batch(
-                                    stack_batches(item), stacked=True
-                                ),
-                            )
+        for kind, item in group_batches(self.test_loader, k):
+            if kind == "group":
+                metrics.append(
+                    np.asarray(
+                        self.multi_eval_step(
+                            self.state.params,
+                            self._device_batch(stack_batches(item), stacked=True),
                         )
                     )
-                else:
-                    metrics.append(
-                        np.asarray(
-                            self.eval_step(self.state.params, self._device_batch(item))
-                        )
+                )
+            else:
+                metrics.append(
+                    np.asarray(
+                        self.eval_step(self.state.params, self._device_batch(item))
                     )
-            return float(np.mean(np.concatenate([np.atleast_1d(m) for m in metrics])))
-        metrics = [
-            np.asarray(self.eval_step(self.state.params, self._device_batch(b)))
-            for b in self.test_loader
-        ]
-        # In multi-process mode each batch is assembled globally
-        # (_device_batch -> global_batch), so every process computes the
-        # same full-test metric — no cross-host aggregation needed.
-        return float(np.mean(metrics))
+                )
+        return float(np.mean(np.concatenate([np.atleast_1d(m) for m in metrics])))
 
     def predict(self, samples) -> list[np.ndarray]:
         """Inference: per-sample UNPADDED model outputs ``[n_i, out_dim]``.
@@ -670,21 +681,15 @@ class Trainer:
                 cfg.train.profile_dir, epoch, trace_at=trace_at
             ):
                 with profiling.annotate("train_epoch"):
-                    if k_dis == 1:
-                        for batch in self.train_loader:
-                            points += batch.n_real_points
-                            run_single(batch)
-                    else:
-                        # The SAME grouping iterator evaluate() uses.
-                        for kind, item in group_batches(
-                            self.train_loader, k_dis
-                        ):
-                            if kind == "group":
-                                points += sum(b.n_real_points for b in item)
-                                run_group(item)
-                            else:
-                                points += item.n_real_points
-                                run_single(item)
+                    # The SAME grouping iterator evaluate() uses
+                    # (all-singles at k=1).
+                    for kind, item in group_batches(self.train_loader, k_dis):
+                        if kind == "group":
+                            points += sum(b.n_real_points for b in item)
+                            run_group(item)
+                        else:
+                            points += item.n_real_points
+                            run_single(item)
                 train_loss = float(
                     np.mean(
                         np.concatenate(
