@@ -52,6 +52,13 @@ class ModelConfig:
     # trades ~1 extra forward of FLOPs for O(n_attn_layers) less
     # activation memory — the lever for long point clouds on one chip.
     remat: bool = False
+    # Run the block stack as ONE lax.scan over stacked per-layer params
+    # (the pipeline parameter layout) instead of n_attn_layers inlined
+    # block copies: XLA traces/compiles one block regardless of depth —
+    # the compile-time lever for deep configs. Same math; params live
+    # in the stacked layout (pipeline.stack_params converts). xla
+    # impls only.
+    scan_layers: bool = False
 
     def __post_init__(self) -> None:
         if self.n_attn_hidden_dim % self.n_head:
@@ -64,6 +71,10 @@ class ModelConfig:
             raise ValueError(f"unknown ffn_impl {self.ffn_impl!r}")
         if self.sp_collective not in ("psum", "ring"):
             raise ValueError(f"unknown sp_collective {self.sp_collective!r}")
+        if self.scan_layers and (
+            self.attention_impl != "xla" or self.ffn_impl != "xla"
+        ):
+            raise ValueError("scan_layers requires the xla attention/ffn impls")
 
 
 @dataclasses.dataclass(frozen=True)

@@ -93,6 +93,12 @@ def build_parser() -> argparse.ArgumentParser:
              "memory, ~1 extra forward of FLOPs — for long point clouds)"
     )
     p.add_argument(
+        "--scan_layers", action="store_true",
+        help="run the block stack as one lax.scan over stacked per-layer "
+             "params: XLA compiles one block regardless of depth (the "
+             "compile-time lever for deep configs); same math"
+    )
+    p.add_argument(
         "--predict_out", type=str, default="",
         help="after the run, write test-set predictions to this pickle "
              "as [X, Y_pred, theta, (f...)] records (reference schema, "
@@ -224,6 +230,7 @@ def model_config(cfg: Config, args: argparse.Namespace, train_samples) -> ModelC
         sp_collective=args.sp_collective,
         dtype=args.dtype,
         remat=args.remat,
+        scan_layers=args.scan_layers,
         **dims,
     )
 

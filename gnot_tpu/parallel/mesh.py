@@ -118,8 +118,19 @@ def _param_pspec(path: str, leaf) -> P:
     input axis carries E), producing the usual column->row TP pair with
     one psum at the block output. Expert-FFN hidden layers are
     column-sharded on the way in, row-sharded on the way out.
+
+    ``blocks/`` paths are the STACKED layout (scan_layers /
+    checkpoint-restored pipeline trees): a leading layer axis sits in
+    front of the ordinary block param shape — same rules, spec
+    prefixed with an unsharded layer dim.
     """
-    ndim = np.ndim(leaf)
+    if "blocks/" in path:
+        inner = _param_pspec_at(path, np.ndim(leaf) - 1)
+        return P(*((None,) + tuple(inner)))
+    return _param_pspec_at(path, np.ndim(leaf))
+
+
+def _param_pspec_at(path: str, ndim: int) -> P:
     is_kernel = path.endswith("kernel")
     if re.search(r"(query|key|value)/kernel$", path):
         return P(*([None] * (ndim - 1) + ["model"]))  # column (head) parallel
@@ -214,7 +225,8 @@ def _validate_gspmd(model, mesh: Mesh) -> None:
 
 
 def make_sharded_train_step(
-    model, optim_cfg, loss_name: str, mesh: Mesh, state, microbatches: int = 0
+    model, optim_cfg, loss_name: str, mesh: Mesh, state, microbatches: int = 0,
+    loss_fn=None,
 ):
     """jit the train step with explicit in/out shardings over the mesh.
 
@@ -227,13 +239,18 @@ def make_sharded_train_step(
     from gnot_tpu.train.trainer import train_step_body
 
     if mesh.shape.get("pipe", 1) > 1:
+        if loss_fn is not None:
+            raise ValueError(
+                "loss_fn overrides do not reach the pipeline path (it "
+                "builds its own pipelined forward); use pipe == 1"
+            )
         from gnot_tpu.parallel import pipeline
 
         return pipeline.make_pipelined_train_step(
             model, optim_cfg, loss_name, mesh, state, microbatches
         )
     _validate_gspmd(model, mesh)
-    body = train_step_body(model, optim_cfg, loss_name)
+    body = train_step_body(model, optim_cfg, loss_name, loss_fn=loss_fn)
 
     def step(state, batch: MeshBatch, lr):
         return body(state, (batch, lr))
@@ -256,7 +273,9 @@ def _reject_pipe_multi(mesh: Mesh) -> None:
         )
 
 
-def make_sharded_multi_train_step(model, optim_cfg, loss_name: str, mesh: Mesh, state):
+def make_sharded_multi_train_step(
+    model, optim_cfg, loss_name: str, mesh: Mesh, state, loss_fn=None
+):
     """K-step scanned train step over the mesh (see
     trainer.make_multi_train_step): one dispatch, one program, all
     GSPMD collectives inside the scan body."""
@@ -264,7 +283,7 @@ def make_sharded_multi_train_step(model, optim_cfg, loss_name: str, mesh: Mesh, 
 
     _reject_pipe_multi(mesh)
     _validate_gspmd(model, mesh)
-    body = train_step_body(model, optim_cfg, loss_name)
+    body = train_step_body(model, optim_cfg, loss_name, loss_fn=loss_fn)
 
     def multi_step(state, batches, lrs):
         return jax.lax.scan(body, state, (batches, lrs))
@@ -279,12 +298,19 @@ def make_sharded_multi_train_step(model, optim_cfg, loss_name: str, mesh: Mesh, 
     )
 
 
-def make_sharded_eval_step(model, loss_name: str, mesh: Mesh, state, microbatches: int = 0):
+def make_sharded_eval_step(
+    model, loss_name: str, mesh: Mesh, state, microbatches: int = 0, loss_fn=None
+):
     """jit the eval (loss-only) step over the mesh; the scalar metric
     comes back replicated."""
     from gnot_tpu.train.trainer import eval_step_body
 
     if mesh.shape.get("pipe", 1) > 1:
+        if loss_fn is not None:
+            raise ValueError(
+                "loss_fn overrides do not reach the pipeline path (it "
+                "builds its own pipelined forward); use pipe == 1"
+            )
         from gnot_tpu.parallel import pipeline
 
         return pipeline.make_pipelined_eval_step(
@@ -294,19 +320,19 @@ def make_sharded_eval_step(model, loss_name: str, mesh: Mesh, state, microbatche
     p_sh = state_shardings(mesh, state).params
     replicated = NamedSharding(mesh, P())
     return jax.jit(
-        eval_step_body(model, loss_name),
+        eval_step_body(model, loss_name, loss_fn=loss_fn),
         in_shardings=(p_sh, None),
         out_shardings=replicated,
     )
 
 
-def make_sharded_multi_eval_step(model, loss_name: str, mesh: Mesh, state):
+def make_sharded_multi_eval_step(model, loss_name: str, mesh: Mesh, state, loss_fn=None):
     """K eval losses over K stacked batches in one sharded dispatch."""
     from gnot_tpu.train.trainer import eval_step_body
 
     _reject_pipe_multi(mesh)
     _validate_gspmd(model, mesh)
-    body = eval_step_body(model, loss_name)
+    body = eval_step_body(model, loss_name, loss_fn=loss_fn)
     p_sh = state_shardings(mesh, state).params
     replicated = NamedSharding(mesh, P())
     return jax.jit(

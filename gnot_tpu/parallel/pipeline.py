@@ -130,6 +130,25 @@ def _split_micro(x, m: int, batch_axis: int):
     return jnp.moveaxis(x.reshape(new), batch_axis, 0)
 
 
+def _scan_blocks(cfg, block, stacked, scores, query, funcs, node_mask, func_mask):
+    """lax.scan of one block module over stacked per-layer params — THE
+    one block-application loop (the pipeline's per-stage compute and the
+    scan_layers forward both call this, so remat policy and block
+    wiring cannot drift between them)."""
+
+    def body(q, layer_p):
+        apply = lambda qq: block.apply(
+            {"params": layer_p}, scores, qq, funcs,
+            node_mask=node_mask, func_mask=func_mask,
+        )
+        if cfg.remat:
+            apply = jax.checkpoint(apply)
+        return apply(q), None
+
+    q, _ = jax.lax.scan(body, query, stacked)
+    return q
+
+
 def _pipe_blocks(
     cfg: ModelConfig,
     mesh: Mesh,
@@ -163,16 +182,7 @@ def _pipe_blocks(
         fm_m = _split_micro(func_mask, m, 1)
 
         def run_stage(sc, q, f, nm, fm):
-            def body(qc, layer_p):
-                apply = lambda qq: block.apply(
-                    {"params": layer_p}, sc, qq, f, node_mask=nm, func_mask=fm
-                )
-                if cfg.remat:
-                    apply = jax.checkpoint(apply)
-                return apply(qc), None
-
-            q, _ = jax.lax.scan(body, q, stacked_local)
-            return q
+            return _scan_blocks(cfg, block, stacked_local, sc, q, f, nm, fm)
 
         def tick(carry, t):
             q_state, outputs = carry
@@ -233,6 +243,42 @@ def _pipe_blocks(
         check_vma=False,
     )
     return mapped(stacked, scores, query, funcs, node_mask, func_mask)
+
+
+def stacked_forward(cfg: ModelConfig, params: dict, batch: MeshBatch):
+    """Full GNOT forward with the block stack as ONE ``lax.scan`` over
+    stacked per-layer params (the pipeline parameter layout, no mesh
+    schedule): XLA traces and compiles a single block regardless of
+    ``n_attn_layers`` — the compile-time lever for deep configs
+    (``ModelConfig.scan_layers``). Same math as GNOT.__call__ (the
+    block module comes from the same factory); works standalone or
+    under a GSPMD-sharded jit (mesh._param_pspec knows the stacked
+    ``blocks/`` layout)."""
+    from gnot_tpu.models import gnot
+
+    node_mask, func_mask = batch.node_mask, batch.func_mask
+    if cfg.attention_mode == "parity":
+        node_mask = func_mask = None
+    scores, query, funcs = _embed(cfg, params, batch.coords, batch.theta, batch.funcs)
+    block = gnot.block_module(cfg, funcs is not None)
+    query = _scan_blocks(
+        cfg, block, params["blocks"], scores, query, funcs, node_mask, func_mask
+    )
+    return _head(cfg, params, query)
+
+
+def init_stacked_state(model, optim_cfg: OptimConfig, sample_batch, seed: int):
+    """Stacked-layout TrainState for ``scan_layers`` (no mesh; GSPMD
+    callers shard it afterwards with mesh.shard_state, whose param
+    rules understand the ``blocks`` stack)."""
+    from gnot_tpu.train.trainer import TrainState, init_state, make_optimizer
+
+    base = init_state(model, optim_cfg, sample_batch, seed)
+    params = stack_params(base.params, model.config.n_attn_layers)
+    tx = make_optimizer(optim_cfg, optim_cfg.lr)
+    return TrainState(
+        params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32)
+    )
 
 
 def pipelined_forward(
@@ -311,14 +357,7 @@ def init_pipeline_state(model, optim_cfg: OptimConfig, sample_batch, seed: int, 
     The optimizer state is initialized fresh on the stacked tree (it is
     all zeros + a counter at step 0, so this is identical to stacking a
     standard init)."""
-    from gnot_tpu.train.trainer import TrainState, init_state, make_optimizer
-
-    base = init_state(model, optim_cfg, sample_batch, seed)
-    params = stack_params(base.params, model.config.n_attn_layers)
-    tx = make_optimizer(optim_cfg, optim_cfg.lr)
-    state = TrainState(
-        params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32)
-    )
+    state = init_stacked_state(model, optim_cfg, sample_batch, seed)
     return jax.tree.map(
         lambda leaf, sh: jax.device_put(leaf, sh), state, state_shardings(mesh, state)
     )

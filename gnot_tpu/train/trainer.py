@@ -114,8 +114,10 @@ def train_step_body(
     return body
 
 
-def make_train_step(model: GNOT, optim_cfg: OptimConfig, loss_name: str) -> Callable:
-    body = train_step_body(model, optim_cfg, loss_name)
+def make_train_step(
+    model: GNOT, optim_cfg: OptimConfig, loss_name: str, *, loss_fn=None
+) -> Callable:
+    body = train_step_body(model, optim_cfg, loss_name, loss_fn=loss_fn)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def train_step(state: TrainState, batch: MeshBatch, lr: jax.Array):
@@ -125,7 +127,7 @@ def make_train_step(model: GNOT, optim_cfg: OptimConfig, loss_name: str) -> Call
 
 
 def make_multi_train_step(
-    model: GNOT, optim_cfg: OptimConfig, loss_name: str
+    model: GNOT, optim_cfg: OptimConfig, loss_name: str, *, loss_fn=None
 ) -> Callable:
     """K training steps over K different batches as ONE compiled
     program: ``lax.scan`` over a MeshBatch whose leaves carry a leading
@@ -133,7 +135,7 @@ def make_multi_train_step(
     host->device dispatch per K steps — the lever when dispatch latency
     (remote tunnels, tiny models) rivals step compute. Numerically
     identical to K ``make_train_step`` calls."""
-    body = train_step_body(model, optim_cfg, loss_name)
+    body = train_step_body(model, optim_cfg, loss_name, loss_fn=loss_fn)
 
     @functools.partial(jax.jit, donate_argnums=(0,))
     def multi_step(state: TrainState, batches: MeshBatch, lrs: jax.Array):
@@ -147,9 +149,13 @@ def stack_batches(batches: list[MeshBatch]) -> MeshBatch:
     return jax.tree.map(lambda *xs: np.stack(xs), *batches)
 
 
-def eval_step_body(model: GNOT, loss_name: str) -> Callable:
+def eval_step_body(model: GNOT, loss_name: str, *, loss_fn=None) -> Callable:
     """THE eval math — the one copy the single-device and sharded,
-    single- and multi-batch eval builders all wrap."""
+    single- and multi-batch eval builders all wrap. ``loss_fn(params,
+    batch)`` overrides the forward (scan_layers substitutes the stacked
+    forward)."""
+    if loss_fn is not None:
+        return loss_fn
 
     def body(params, batch: MeshBatch):
         return batch_loss(model, params, batch, loss_name)
@@ -157,20 +163,32 @@ def eval_step_body(model: GNOT, loss_name: str) -> Callable:
     return body
 
 
-def make_eval_step(model: GNOT, loss_name: str) -> Callable:
-    return jax.jit(eval_step_body(model, loss_name))
+def make_eval_step(model: GNOT, loss_name: str, *, loss_fn=None) -> Callable:
+    return jax.jit(eval_step_body(model, loss_name, loss_fn=loss_fn))
 
 
-def make_multi_eval_step(model: GNOT, loss_name: str) -> Callable:
+def make_multi_eval_step(model: GNOT, loss_name: str, *, loss_fn=None) -> Callable:
     """K eval losses over K stacked batches in one dispatch (the eval
     counterpart of make_multi_train_step)."""
-    body = eval_step_body(model, loss_name)
+    body = eval_step_body(model, loss_name, loss_fn=loss_fn)
 
     @jax.jit
     def multi_eval(params, batches: MeshBatch):
         return jax.lax.map(lambda b: body(params, b), batches)
 
     return multi_eval
+
+
+def stacked_loss_fn(model_cfg, loss_name: str) -> Callable:
+    """loss_fn for the scan_layers (stacked-block) forward."""
+    from gnot_tpu.ops.segment import LOSSES
+    from gnot_tpu.parallel.pipeline import stacked_forward
+
+    def loss_fn(params, batch: MeshBatch):
+        preds = stacked_forward(model_cfg, params, batch)
+        return LOSSES[loss_name](preds, batch.y, batch.node_mask)
+
+    return loss_fn
 
 
 def group_batches(batches, k: int):
@@ -312,11 +330,22 @@ class Trainer:
         # instruments, and a global flag is the CLI's to own, not a
         # library constructor's); the trainer's own guard is the
         # host-side per-step finiteness check in fit().
+        # scan_layers: the stacked forward substitutes via loss_fn in
+        # every (non-pipeline) dispatch mode; the pipeline path scans
+        # its own stages already.
+        self._loss_fn = (
+            stacked_loss_fn(model_cfg, config.train.loss)
+            if model_cfg.scan_layers
+            and not (self.mesh is not None and self.mesh.shape.get("pipe", 1) > 1)
+            else None
+        )
         if self.mesh is None:
             self.train_step = make_train_step(
-                self.model, config.optim, config.train.loss
+                self.model, config.optim, config.train.loss, loss_fn=self._loss_fn
             )
-            self.eval_step = make_eval_step(self.model, config.train.loss)
+            self.eval_step = make_eval_step(
+                self.model, config.train.loss, loss_fn=self._loss_fn
+            )
         else:
             # Built lazily in initialize(): the sharded jits need the
             # state's sharding layout.
@@ -371,11 +400,22 @@ class Trainer:
                 self.model, self.config.optim, sample, self.config.train.seed,
                 self.mesh,
             )
+            already_sharded = True
+        elif self.model.config.scan_layers:
+            from gnot_tpu.parallel import pipeline
+
+            # Stacked layout (scan_layers): GSPMD sharding (if any)
+            # applies below — mesh._param_pspec knows the blocks stack.
+            self.state = pipeline.init_stacked_state(
+                self.model, self.config.optim, sample, self.config.train.seed
+            )
+            already_sharded = False
         else:
             self.state = init_state(
                 self.model, self.config.optim, sample, self.config.train.seed
             )
-        if self.mesh is not None and "blocks" not in self.state.params:
+            already_sharded = False
+        if self.mesh is not None and not already_sharded:
             from gnot_tpu.parallel import mesh as mesh_lib
 
             # Shard BEFORE any restore: Orbax then restores straight
@@ -395,28 +435,31 @@ class Trainer:
             self.train_step = mesh_lib.make_sharded_train_step(
                 self.model, self.config.optim, self.config.train.loss,
                 self.mesh, self.state, self.config.mesh.microbatches,
+                loss_fn=self._loss_fn,
             )
             self.eval_step = mesh_lib.make_sharded_eval_step(
                 self.model, self.config.train.loss, self.mesh, self.state,
-                self.config.mesh.microbatches,
+                self.config.mesh.microbatches, loss_fn=self._loss_fn,
             )
         if self.config.train.steps_per_dispatch > 1:
             if self.mesh is None:
                 self.multi_train_step = make_multi_train_step(
-                    self.model, self.config.optim, self.config.train.loss
+                    self.model, self.config.optim, self.config.train.loss,
+                    loss_fn=self._loss_fn,
                 )
                 self.multi_eval_step = make_multi_eval_step(
-                    self.model, self.config.train.loss
+                    self.model, self.config.train.loss, loss_fn=self._loss_fn
                 )
             else:
                 from gnot_tpu.parallel import mesh as mesh_lib
 
                 self.multi_train_step = mesh_lib.make_sharded_multi_train_step(
                     self.model, self.config.optim, self.config.train.loss,
-                    self.mesh, self.state,
+                    self.mesh, self.state, loss_fn=self._loss_fn,
                 )
                 self.multi_eval_step = mesh_lib.make_sharded_multi_eval_step(
-                    self.model, self.config.train.loss, self.mesh, self.state
+                    self.model, self.config.train.loss, self.mesh, self.state,
+                    loss_fn=self._loss_fn,
                 )
         return self.state
 
