@@ -76,6 +76,37 @@ def unstack_params(params: dict, n_layers: int) -> dict:
     return out
 
 
+def convert_state_layout(state, n_layers: int, to: str):
+    """Convert a full TrainState between the standard ``block_i`` layout
+    and the stacked ``blocks`` layout — INCLUDING the optimizer moments,
+    whose trees mirror the params — so a checkpoint written by a
+    ``--scan_layers`` / ``--mesh_pipe`` run can be resumed by a standard
+    run and vice versa. Operates on host/device values (pipe-sharded
+    states should be ``jax.device_get`` first). No-op if already in the
+    target layout."""
+    if to not in ("stacked", "standard"):
+        raise ValueError(f"unknown layout {to!r}")
+
+    def convert(node):
+        if isinstance(node, dict):
+            if to == "stacked" and "block_0" in node:
+                return stack_params(node, n_layers)
+            if to == "standard" and "blocks" in node:
+                return unstack_params(node, n_layers)
+            return {k: convert(v) for k, v in node.items()}
+        if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
+            return type(node)(*(convert(v) for v in node))
+        if isinstance(node, (tuple, list)):
+            return type(node)(convert(v) for v in node)
+        return node
+
+    import dataclasses as _dc
+
+    return _dc.replace(
+        state, params=convert(state.params), opt_state=convert(state.opt_state)
+    )
+
+
 # ---------------------------------------------------------------------------
 # Model pieces: standalone applications of the SAME module factories
 # GNOT.__call__ composes (models/gnot.py) against the corresponding

@@ -569,13 +569,32 @@ class Trainer:
                 )
         if self._forward is None:
             model = self.model
-            self._forward = jax.jit(
-                lambda params, batch: apply_batch(model, params, batch)
-            )
+            if "blocks" in self.state.params:
+                # Stacked layout (scan_layers / pipeline): run the
+                # stacked forward on the params as-is — no unstack, and
+                # no re-paying the per-depth compile that scan_layers
+                # exists to avoid.
+                from gnot_tpu.parallel.pipeline import stacked_forward
+
+                mc = model.config
+                self._forward = jax.jit(
+                    lambda params, batch: stacked_forward(mc, params, batch)
+                )
+            else:
+                self._forward = jax.jit(
+                    lambda params, batch: apply_batch(model, params, batch)
+                )
         forward = self._forward
-        params = (
-            self.gathered_standard_params() if multiproc else self.standard_params()
-        )
+        if multiproc:
+            from jax.experimental import multihost_utils
+
+            # Gather the raw (possibly stacked) tree; the forward above
+            # matches its layout.
+            params = multihost_utils.process_allgather(
+                self.state.params, tiled=True
+            )
+        else:
+            params = self.state.params
 
         samples = list(samples)
         n_real = len(samples)
