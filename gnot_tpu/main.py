@@ -62,6 +62,12 @@ def build_parser() -> argparse.ArgumentParser:
     # Framework knobs.
     p.add_argument("--backend", type=str, default="jax", choices=["jax", "torch"])
     p.add_argument(
+        "--compile_cache", type=str, default="",
+        help="persistent XLA compile-cache dir; default: a per-user "
+             "cache (re-runs skip the 30-90s first compiles). 'off' "
+             "disables"
+    )
+    p.add_argument(
         "--device_id", type=int, default=-1,
         help="pin single-device runs to jax.devices()[i] (the reference's "
              "--gpu_id, main.py:15); -1 = automatic. Multi-chip runs use "
@@ -343,6 +349,11 @@ def main(argv=None) -> float:
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    if args.compile_cache != "off":
+        from gnot_tpu.utils.cache import enable_compile_cache
+
+        enable_compile_cache(args.compile_cache or None)
 
     if args.device_id >= 0:
         import jax

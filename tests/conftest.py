@@ -19,24 +19,12 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 # Persistent compile cache: the suite is dominated by XLA compiles of
-# near-identical tiny programs; re-runs hit the cache instead. Per-user
-# path: a world-shared /tmp dir would fail for the second user on a
-# shared machine and mean executing artifacts another user could write.
-import tempfile
+# near-identical tiny programs; re-runs hit the cache instead. Shared
+# per-user location with the CLI (gnot_tpu/utils/cache.py), so tests
+# and CLI runs warm each other. GNOT_TEST_CACHE overrides the path;
+# set it to "off" (or empty) for clean-compile runs.
+_cache = os.environ.get("GNOT_TEST_CACHE")
+if _cache not in ("off", ""):
+    from gnot_tpu.utils.cache import enable_compile_cache
 
-_home = os.path.expanduser("~")
-if os.path.isabs(_home):
-    # User-owned location: nobody else can pre-create or write it.
-    _default_cache = os.path.join(
-        os.environ.get("XDG_CACHE_HOME") or os.path.join(_home, ".cache"),
-        "gnot_jax_cache",
-    )
-else:  # stripped container env without HOME: uid-scoped tmp fallback
-    _default_cache = os.path.join(
-        tempfile.gettempdir(), f"gnot_jax_cache_{os.getuid()}"
-    )
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.environ.get("GNOT_TEST_CACHE", _default_cache),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    enable_compile_cache(_cache)
