@@ -28,17 +28,31 @@ def default_cache_dir() -> str:
 
 def enable_compile_cache(path: str | None = None) -> str:
     """Turn the persistent cache on (call before tracing). Returns the
-    cache path in effect. Idempotent: if a cache dir is already
-    configured (e.g. the test conftest's hermetic path) and no explicit
-    ``path`` is given, the existing configuration wins — in-process
-    ``main()`` calls must not silently redirect it."""
+    cache path in effect ("" when disabled).
+
+    Resolution order for a default (``path=None``) call:
+    ``GNOT_COMPILE_CACHE`` env (``off``/empty disables, a path
+    overrides; ``GNOT_TEST_CACHE`` accepted as an alias) → an
+    already-configured ``jax_compilation_cache_dir`` (e.g. a hermetic
+    test path — in-process ``main()`` calls must not silently redirect
+    it) → the per-user default. The env override is what makes
+    ``GNOT_COMPILE_CACHE=off`` give genuinely clean-compile runs even
+    through code paths that enable the cache themselves."""
     import jax
 
     if path is None:
-        existing = getattr(jax.config, "jax_compilation_cache_dir", None)
-        if existing:
-            return existing
-    path = path or default_cache_dir()
+        env = os.environ.get("GNOT_COMPILE_CACHE")
+        if env is None:
+            env = os.environ.get("GNOT_TEST_CACHE")
+        if env is not None and env.strip() in ("off", ""):
+            return ""
+        if env:
+            path = env
+        else:
+            existing = getattr(jax.config, "jax_compilation_cache_dir", None)
+            if existing:
+                return existing
+            path = default_cache_dir()
     jax.config.update("jax_compilation_cache_dir", path)
     # Cache everything that took meaningful compile time.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

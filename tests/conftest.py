@@ -21,10 +21,10 @@ jax.config.update("jax_platforms", "cpu")
 # Persistent compile cache: the suite is dominated by XLA compiles of
 # near-identical tiny programs; re-runs hit the cache instead. Shared
 # per-user location with the CLI (gnot_tpu/utils/cache.py), so tests
-# and CLI runs warm each other. GNOT_TEST_CACHE overrides the path;
-# set it to "off" (or empty) for clean-compile runs.
-_cache = os.environ.get("GNOT_TEST_CACHE")
-if _cache not in ("off", ""):
-    from gnot_tpu.utils.cache import enable_compile_cache
+# and CLI runs warm each other. GNOT_COMPILE_CACHE (alias:
+# GNOT_TEST_CACHE) overrides the path; "off" or empty gives
+# clean-compile runs — honored inside enable_compile_cache, so tests
+# that call main() in-process can't silently re-enable the cache.
+from gnot_tpu.utils.cache import enable_compile_cache
 
-    enable_compile_cache(_cache)
+enable_compile_cache()

@@ -98,6 +98,29 @@ def test_two_process_distributed_training(tmp_path):
         assert all(np.isfinite(float(x)) for x in a)
 
 
+def test_two_process_expert_parallel(tmp_path):
+    """Expert parallelism under ``jax.distributed``: global mesh
+    data=2 x expert=2 over 2 hosts x 2 devices (each host's devices
+    split the expert stack; the gated-combine psum rides inside the
+    host, the gradient psum crosses hosts)."""
+    args = [
+        "--n_attn_layers", "1", "--n_attn_hidden_dim", "16",
+        "--n_mlp_num_layers", "1", "--n_mlp_hidden_dim", "16",
+        "--n_input_hidden_dim", "16", "--n_expert", "2", "--n_head", "2",
+        "--n_train", "8", "--n_test", "8", "--batch_size", "2",
+        "--synthetic", "ns2d", "--distributed",
+        "--mesh_data", "2", "--mesh_expert", "2", "--epochs", "2",
+    ]
+    outs = _run_pair(tmp_path, args)
+    for pat in (
+        r"Epoch \d+, Loss: ([\d.eE+-]+)",
+        r"Epoch \d+, Test Metric: ([\d.eE+-]+)",
+    ):
+        a, b = re.findall(pat, outs[0]), re.findall(pat, outs[1])
+        assert a and a == b, f"process outputs diverge for {pat}: {a} vs {b}"
+        assert all(np.isfinite(float(x)) for x in a)
+
+
 def test_two_process_pipeline_parallel(tmp_path):
     """Pipeline parallelism under ``jax.distributed``: global mesh
     data=2 x pipe=2 over 2 hosts x 2 devices (the pipe axis stays

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Hardware validation sweep: drives the CLI across the feature matrix
+# on the attached accelerator (one run per feature; ~1-5 min each, the
+# persistent compile cache makes re-runs much faster). Exits nonzero if
+# any configuration fails. Logs land in ${SWEEP_LOG_DIR:-/tmp}.
+#
+# The pytest suite pins itself to 8 virtual CPU devices, so this script
+# is the hardware-side complement (same role as
+# tools/validate_tpu_kernels.py for the pallas kernels).
+set -u
+# Private log dir by default: predictable world-shared /tmp names would
+# collide (or be squattable) for the second user on a shared host.
+LOGDIR="${SWEEP_LOG_DIR:-$(mktemp -d -t gnot_sweep.XXXXXX)}"
+echo "sweep logs: $LOGDIR"
+ARCH="--n_attn_layers 2 --n_attn_hidden_dim 64 --n_mlp_num_layers 2
+      --n_mlp_hidden_dim 64 --n_input_hidden_dim 64 --n_head 4
+      --epochs 2 --n_train 8 --n_test 4"
+CKPT="$LOGDIR/sweep_ckpt.$$"
+fail=0
+run() {
+  name="$1"; shift
+  if timeout 600 python -m gnot_tpu.main $ARCH "$@" > "$LOGDIR/sweep_$name.log" 2>&1; then
+    best=$(grep -E "Best Test Metric|Eval \(best" "$LOGDIR/sweep_$name.log" | tail -1)
+    echo "OK   $name  ($best)"
+  else
+    echo "FAIL $name (see $LOGDIR/sweep_$name.log)"; fail=1
+  fi
+}
+run darcy_f32      --synthetic darcy2d
+run ns2d_bf16      --synthetic ns2d --dtype bfloat16
+run elas_remat     --synthetic elasticity --remat
+run induct_scan    --synthetic inductor2d --scan_layers
+run heat_k4        --synthetic heatsink3d --steps_per_dispatch 4 --batch_size 4
+run darcy_parity   --synthetic darcy2d --attention_mode parity --no_bucket
+run ns2d_pallas    --synthetic ns2d --attention_impl pallas
+run darcy_ckpt     --synthetic darcy2d --checkpoint_dir "$CKPT" --checkpoint_every 1 \
+                   --predict_out "$LOGDIR/sweep_preds.pkl" --export_torch "$LOGDIR/sweep_model.pth"
+run darcy_resume   --synthetic darcy2d --checkpoint_dir "$CKPT" --eval_only
+rm -rf "$CKPT"
+exit $fail
