@@ -72,18 +72,21 @@ def build(step_dtype: str, attention_impl: str = "xla", n_points: int = 1024, ba
 
 def time_steps(
     step, state, batch, lr, n_warmup: int, n_steps: int, device,
-    fused: bool = False,
+    fused: bool = False, repeats: int = 1,
 ) -> float:
-    """Returns real-mesh-points/sec for the train step on `device`.
+    """Returns real-mesh-points/sec for the train step on `device`,
+    best of ``repeats`` timed windows (dispatch/tunnel stalls only ever
+    subtract from measured throughput, so best-of-N is the faithful
+    estimator of device capability).
 
     ``fused=True`` compiles the n_steps iterations into ONE program
     (lax.scan over the step), so the measurement contains zero per-step
     host dispatch — the robust mode when the device sits behind a
     remote tunnel whose per-call latency varies. Default off: the
     per-step loop is what training actually does."""
-    state = jax.device_put(state, device)
     dbatch = jax.device_put(batch, device)
     lr = jax.device_put(lr, device)
+    multi = None
     if fused:
 
         @functools.partial(jax.jit, static_argnums=(3,), donate_argnums=(0,))
@@ -95,25 +98,31 @@ def time_steps(
             state, losses = jax.lax.scan(body, state, None, length=n)
             return state, losses[-1]
 
-        # Warm with the SAME static length the timed call uses — a
-        # different length is a different compiled program, and the
-        # compile would land inside the timed region.
-        state, loss = multi(state, dbatch, lr, n_steps)
+    best = 0.0
+    for _ in range(max(1, repeats)):
+        # Fresh copy per window: the jitted step/multi donates its
+        # state argument.
+        s = jax.device_put(jax.tree.map(jnp.copy, state), device)
+        if fused:
+            # Warm with the SAME static length the timed call uses — a
+            # different length would be a different compiled program,
+            # and the compile would land inside the timed region. The
+            # jitted `multi` is shared across windows, so trace+compile
+            # happens once.
+            s, loss = multi(s, dbatch, lr, n_steps)
+            jax.block_until_ready(loss)
+            t0 = time.perf_counter()
+            s, loss = multi(s, dbatch, lr, n_steps)
+        else:
+            for _ in range(max(1, n_warmup)):  # >=1: the first call compiles
+                s, loss = step(s, dbatch, lr)
+            jax.block_until_ready(loss)
+            t0 = time.perf_counter()
+            for _ in range(n_steps):
+                s, loss = step(s, dbatch, lr)
         jax.block_until_ready(loss)
-        t0 = time.perf_counter()
-        state, loss = multi(state, dbatch, lr, n_steps)
-        jax.block_until_ready(loss)
-        dt = time.perf_counter() - t0
-        return batch.n_real_points * n_steps / dt
-    for _ in range(max(1, n_warmup)):  # >=1: the first call compiles
-        state, loss = step(state, dbatch, lr)
-    jax.block_until_ready(loss)
-    t0 = time.perf_counter()
-    for _ in range(n_steps):
-        state, loss = step(state, dbatch, lr)
-    jax.block_until_ready(loss)
-    dt = time.perf_counter() - t0
-    return batch.n_real_points * n_steps / dt
+        best = max(best, batch.n_real_points * n_steps / (time.perf_counter() - t0))
+    return best
 
 
 def time_torch_steps(batch, mc, lr: float, n_warmup: int, n_steps: int) -> float:
@@ -150,6 +159,13 @@ def time_torch_steps(batch, mc, lr: float, n_warmup: int, n_steps: int) -> float
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--steps", type=int, default=20)
+    p.add_argument(
+        "--repeats", type=int, default=3,
+        help="timed repetitions; the REPORTED value is the best one. "
+             "Dispatch/tunnel stalls only ever subtract from measured "
+             "throughput, so best-of-N is the faithful estimator of "
+             "device capability (the standard benchmarking practice)"
+    )
     p.add_argument(
         "--fused_steps", action="store_true",
         help="compile the timed steps into one lax.scan program (no "
@@ -196,7 +212,7 @@ def main():
     )
     value = time_steps(
         step, state, batch, lr, args.warmup, args.steps, accel,
-        fused=args.fused_steps,
+        fused=args.fused_steps, repeats=args.repeats,
     )
     if args.mem_stats:
         import sys
@@ -223,16 +239,26 @@ def main():
     else:
         # f32 CPU baseline — the reference's numeric regime — at the
         # SAME workload, so vs_baseline is purely a hardware ratio.
+        # Best-of-N on the baseline too — an asymmetric estimator would
+        # bias vs_baseline upward.
         if args.baseline == "torch":
             batch_c, mc_c = build_data(
                 "float32", args.n_points, args.batch_size, args.config
             )
-            cpu_value = time_torch_steps(batch_c, mc_c, 1e-3, 1, args.cpu_steps)
+            cpu_value = max(
+                time_torch_steps(
+                    batch_c, mc_c, 1e-3, 1 if i == 0 else 0, args.cpu_steps
+                )
+                for i in range(max(1, args.repeats))
+            )
         else:
             step_c, state_c, batch_c, _ = build(
                 "float32", "xla", args.n_points, args.batch_size, config=args.config
             )
-            cpu_value = time_steps(step_c, state_c, batch_c, lr, 1, args.cpu_steps, cpu)
+            cpu_value = time_steps(
+                step_c, state_c, batch_c, lr, 1, args.cpu_steps, cpu,
+                repeats=args.repeats,
+            )
         vs_baseline = value / cpu_value
 
     print(
