@@ -349,3 +349,21 @@ def test_trainer_fit_steps_per_dispatch_matches_single(capsys):
             float(v1), float(v2), rtol=1e-6,
             err_msg=f"console outputs diverge: {l1!r} vs {l2!r}",
         )
+
+
+def test_same_seed_reproduces_run(capsys):
+    """Two Trainer.fit runs with identical config and seed produce
+    identical console losses/metrics (init, shuffle order, and the
+    whole compiled path are deterministic)."""
+
+    def run():
+        cfg, mc, train, test = small_setup(epochs=2, n_train=8, n_test=4)
+        best = Trainer(cfg, mc, train, test).fit()
+        return best, capsys.readouterr().out
+
+    b1, out1 = run()
+    b2, out2 = run()
+    assert b1 == b2
+    l1 = [l for l in out1.splitlines() if l.startswith("Epoch")]
+    l2 = [l for l in out2.splitlines() if l.startswith("Epoch")]
+    assert l1 and l1 == l2
