@@ -1,0 +1,21 @@
+"""Shared assertions for the test suite (not collected by pytest)."""
+
+import numpy as np
+
+
+def assert_epoch_lines_close(out_a: str, out_b: str, rtol: float) -> None:
+    """Compare two runs' reference-format console outputs line by line:
+    same Epoch-line structure, numeric values equal to ``rtol``. The
+    values come from different compiled programs, which may fuse float
+    reductions differently — compare parsed floats, not reprs."""
+    lines_a = [l for l in out_a.splitlines() if l.startswith("Epoch")]
+    lines_b = [l for l in out_b.splitlines() if l.startswith("Epoch")]
+    assert len(lines_a) == len(lines_b) and lines_a
+    for a, b in zip(lines_a, lines_b):
+        prefix_a, val_a = a.rsplit(": ", 1)
+        prefix_b, val_b = b.rsplit(": ", 1)
+        assert prefix_a == prefix_b
+        np.testing.assert_allclose(
+            float(val_a), float(val_b), rtol=rtol,
+            err_msg=f"console outputs diverge: {a!r} vs {b!r}",
+        )

@@ -273,17 +273,12 @@ def test_trainer_fit_scan_layers_matches_standard(capsys):
         preds = t.predict(samples[:2])
         return best, preds, capsys.readouterr().out
 
+    from helpers import assert_epoch_lines_close
+
     b_std, p_std, out_std = run(False)
     b_scan, p_scan, out_scan = run(True)
     np.testing.assert_allclose(b_std, b_scan, rtol=1e-5)
-    l1 = [l for l in out_std.splitlines() if l.startswith("Epoch")]
-    l2 = [l for l in out_scan.splitlines() if l.startswith("Epoch")]
-    assert len(l1) == len(l2) and l1
-    for a, b in zip(l1, l2):
-        pa, va = a.rsplit(": ", 1)
-        pb, vb = b.rsplit(": ", 1)
-        assert pa == pb
-        np.testing.assert_allclose(float(va), float(vb), rtol=1e-5)
+    assert_epoch_lines_close(out_std, out_scan, rtol=1e-5)
     for a, b in zip(p_std, p_scan):
         np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
 

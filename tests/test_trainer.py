@@ -20,7 +20,8 @@ def small_setup(tmp_path=None, epochs=3, **flag_overrides):
         "--synthetic", "darcy2d",
     ]
     for k, v in flag_overrides.items():
-        argv += [f"--{k}", str(v)]
+        # value None -> bare store_true flag
+        argv += [f"--{k}"] if v is None else [f"--{k}", str(v)]
     args = build_parser().parse_args(argv)
     cfg = config_from_args(args)
     train, test = datasets.load(cfg.data)
@@ -332,23 +333,12 @@ def test_trainer_fit_steps_per_dispatch_matches_single(capsys):
         best = Trainer(cfg, mc, train, test).fit()
         return best, capsys.readouterr().out
 
+    from helpers import assert_epoch_lines_close
+
     b1, out1 = run(1)
     b2, out2 = run(2)
     np.testing.assert_allclose(b1, b2, rtol=1e-5)
-    lines1 = [l for l in out1.splitlines() if l.startswith("Epoch")]
-    lines2 = [l for l in out2.splitlines() if l.startswith("Epoch")]
-    assert len(lines1) == len(lines2) and lines1
-    for l1, l2 in zip(lines1, lines2):
-        p1, v1 = l1.rsplit(": ", 1)
-        p2, v2 = l2.rsplit(": ", 1)
-        assert p1 == p2
-        # Same math by construction (shared train_step_body), but the
-        # scanned and standalone programs may fuse float reductions
-        # differently — compare values, not reprs.
-        np.testing.assert_allclose(
-            float(v1), float(v2), rtol=1e-6,
-            err_msg=f"console outputs diverge: {l1!r} vs {l2!r}",
-        )
+    assert_epoch_lines_close(out1, out2, rtol=1e-6)
 
 
 def test_same_seed_reproduces_run(capsys):
@@ -367,3 +357,23 @@ def test_same_seed_reproduces_run(capsys):
     l1 = [l for l in out1.splitlines() if l.startswith("Epoch")]
     l2 = [l for l in out2.splitlines() if l.startswith("Epoch")]
     assert l1 and l1 == l2
+
+
+def test_scan_layers_with_steps_per_dispatch(capsys):
+    """The two compile/dispatch levers compose: scan_layers' stacked
+    loss_fn threads through the multi-step scanned builders, matching
+    the plain run's console output."""
+
+    from helpers import assert_epoch_lines_close
+
+    def run(extra):
+        cfg, mc, train, test = small_setup(
+            epochs=2, n_train=8, n_test=4, batch_size=2, **extra
+        )
+        best = Trainer(cfg, mc, train, test).fit()
+        return best, capsys.readouterr().out
+
+    b_plain, out_plain = run({})
+    b_both, out_both = run({"scan_layers": None, "steps_per_dispatch": 2})
+    np.testing.assert_allclose(b_plain, b_both, rtol=1e-5)
+    assert_epoch_lines_close(out_plain, out_both, rtol=1e-5)
