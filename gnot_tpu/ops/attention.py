@@ -74,6 +74,15 @@ def normalized_linear_attention(
     k_sum = jnp.sum(k, axis=2)
     # alpha = 1 / <q, k_sum> : [B, H, Lq, 1]
     denom = jnp.einsum("bhld,bhd->bhl", q, k_sum)
+    if kv_mask is not None:
+        # An all-masked key set (a record with an empty input function) has
+        # k_sum == 0 exactly — softmaxed k rows are strictly positive, so
+        # any unmasked row makes denom > 0. Select 1 there so the (also
+        # exactly zero) numerator yields a clean 0 contribution instead of
+        # inf * 0 = nan. No-op whenever at least one key survives the mask;
+        # parity mode (kv_mask=None) is left untouched to match the
+        # reference bit-for-bit.
+        denom = jnp.where(denom == 0.0, 1.0, denom)
     alpha = 1.0 / (denom + eps)
     # k^T v : [B, H, D, D] — the hot MXU contraction.
     kv = jnp.einsum("bhld,bhle->bhde", k, v)

@@ -240,6 +240,10 @@ def _apply_kernel(q_ref, kv_ref, ksum_ref, out_ref, qs_ref, *, n_head):
     denom = jax.lax.dot_general(
         qs * ksum, bd, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
+    # All-masked function slab: ksum == 0 → denom == 0 with a zero
+    # numerator; select 1 so the contribution is 0, not nan (the softmaxed
+    # k rows are strictly positive, so any surviving key makes denom > 0).
+    denom = jnp.where(denom == 0.0, 1.0, denom)
     out = jnp.dot(qs, kv, preferred_element_type=jnp.float32) / denom
     out_ref[0, 0] = out.astype(out_ref.dtype)
 
@@ -280,6 +284,7 @@ def _apply_ref(q, kv, ksum, n_head: int):
     kvm = kv * bd
     # Per-head <q, k_sum>, broadcast to the head's lanes via bd.
     denom = jnp.einsum("fble,ed->fbld", qs[None] * ksum, bd)
+    denom = jnp.where(denom == 0.0, 1.0, denom)  # all-masked slab → 0, not nan
     out = jnp.einsum("bld,fbde->fble", qs, kvm) / denom
     return out.astype(q.dtype), qs.astype(q.dtype)
 

@@ -299,10 +299,13 @@ def make_sharded_multi_train_step(
 
 
 def make_sharded_eval_step(
-    model, loss_name: str, mesh: Mesh, state, microbatches: int = 0, loss_fn=None
+    model, loss_name: str, mesh: Mesh, state, microbatches: int = 0, loss_fn=None,
+    per_sample: bool = False,
 ):
     """jit the eval (loss-only) step over the mesh; the scalar metric
-    comes back replicated."""
+    comes back replicated. ``per_sample=True`` returns the replicated
+    ``[B]`` per-graph metric vector instead (the ragged-tail eval path;
+    a passed ``loss_fn`` must then itself be per-sample)."""
     from gnot_tpu.train.trainer import eval_step_body
 
     if mesh.shape.get("pipe", 1) > 1:
@@ -314,13 +317,14 @@ def make_sharded_eval_step(
         from gnot_tpu.parallel import pipeline
 
         return pipeline.make_pipelined_eval_step(
-            model, loss_name, mesh, state, microbatches
+            model, loss_name, mesh, state, microbatches, per_sample=per_sample
         )
 
+    _validate_gspmd(model, mesh)
     p_sh = state_shardings(mesh, state).params
     replicated = NamedSharding(mesh, P())
     return jax.jit(
-        eval_step_body(model, loss_name, loss_fn=loss_fn),
+        eval_step_body(model, loss_name, loss_fn=loss_fn, per_sample=per_sample),
         in_shardings=(p_sh, None),
         out_shardings=replicated,
     )

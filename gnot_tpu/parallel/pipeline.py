@@ -331,7 +331,7 @@ def pipelined_forward(
 # Train / eval steps and state layout
 
 
-def _validate(cfg: ModelConfig, mesh: Mesh, n_micro: int):
+def _validate(cfg: ModelConfig, mesh: Mesh):
     s = mesh.shape["pipe"]
     if cfg.attention_impl != "xla" or cfg.ffn_impl != "xla":
         raise ValueError(
@@ -364,7 +364,11 @@ def validate_local_batch(
 
 
 def resolve_microbatches(mesh: Mesh, microbatches: int) -> int:
-    """0 -> one microbatch per stage (bubble = (S-1)/(2S-1))."""
+    """0 (the documented auto value) -> one microbatch per stage
+    (bubble = (S-1)/(2S-1)); negatives are rejected rather than silently
+    coerced."""
+    if microbatches < 0:
+        raise ValueError(f"microbatches must be >= 0, got {microbatches}")
     return microbatches if microbatches > 0 else mesh.shape["pipe"]
 
 
@@ -388,6 +392,9 @@ def init_pipeline_state(model, optim_cfg: OptimConfig, sample_batch, seed: int, 
     The optimizer state is initialized fresh on the stacked tree (it is
     all zeros + a counter at step 0, so this is identical to stacking a
     standard init)."""
+    # Validate up front so e.g. n_attn_layers % pipe != 0 surfaces as the
+    # intended ValueError here, not as an uneven-sharding device_put error.
+    _validate(model.config, mesh)
     state = init_stacked_state(model, optim_cfg, sample_batch, seed)
     return jax.tree.map(
         lambda leaf, sh: jax.device_put(leaf, sh), state, state_shardings(mesh, state)
@@ -408,7 +415,7 @@ def make_pipelined_train_step(
             "(init_pipeline_state), not the standard block_i layout"
         )
     n_micro = resolve_microbatches(mesh, microbatches)
-    _validate(model.config, mesh, n_micro)
+    _validate(model.config, mesh)
     cfg = model.config
 
     # The shared step math with the shard_map pipeline substituted as
@@ -437,8 +444,11 @@ def make_pipelined_train_step(
     )
 
 
-def make_pipelined_eval_step(model, loss_name: str, mesh: Mesh, state, microbatches: int = 0):
-    from gnot_tpu.ops.segment import LOSSES
+def make_pipelined_eval_step(
+    model, loss_name: str, mesh: Mesh, state, microbatches: int = 0,
+    per_sample: bool = False,
+):
+    from gnot_tpu.ops.segment import LOSSES, PER_SAMPLE_LOSSES
 
     if "blocks" not in state.params:
         raise ValueError(
@@ -446,13 +456,14 @@ def make_pipelined_eval_step(model, loss_name: str, mesh: Mesh, state, microbatc
             "(init_pipeline_state), not the standard block_i layout"
         )
     n_micro = resolve_microbatches(mesh, microbatches)
-    _validate(model.config, mesh, n_micro)
+    _validate(model.config, mesh)
     cfg = model.config
     p_sh = state_shardings(mesh, state).params
     replicated = NamedSharding(mesh, P())
+    table = PER_SAMPLE_LOSSES if per_sample else LOSSES
 
     def eval_fn(params, batch: MeshBatch):
         preds = pipelined_forward(cfg, mesh, n_micro, params, batch)
-        return LOSSES[loss_name](preds, batch.y, batch.node_mask)
+        return table[loss_name](preds, batch.y, batch.node_mask)
 
     return jax.jit(eval_fn, in_shardings=(p_sh, None), out_shardings=replicated)

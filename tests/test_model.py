@@ -374,3 +374,34 @@ def test_forward_parity_darcy_full_resolution():
         GNOT(mc).apply({"params": params}, b.coords, b.theta, b.funcs)
     )
     assert float(np.max(np.abs(got - want))) < 1e-4
+
+
+def test_empty_input_function_is_finite():
+    """A record with an *empty* input function — its func_mask row is all
+    zeros — must give finite outputs and gradients. k_sum is exactly zero
+    there, so without the denominator guard (ops/attention.py) the
+    normalizer would be 1/0 -> inf and the (zero) numerator would turn it
+    into nan. The guarded contribution is a clean 0."""
+    mc = ModelConfig(**SMALL)
+    rng = np.random.default_rng(3)
+    coords, theta, funcs = make_inputs(rng)
+    node_mask = np.ones(coords.shape[:2], np.float32)
+    func_mask = np.ones((SMALL["n_input_functions"],) + funcs.shape[1:3], np.float32)
+    func_mask[1, 0, :] = 0.0  # sample 0's second input function is empty
+
+    params, out = init_and_apply(
+        mc, coords, theta, funcs, node_mask=node_mask, func_mask=func_mask
+    )
+    assert np.isfinite(np.asarray(out)).all()
+
+    def loss(p):
+        y = GNOT(mc).apply(
+            {"params": p}, coords, theta, funcs,
+            node_mask=node_mask, func_mask=func_mask,
+        )
+        return jnp.mean(y * y)
+
+    g = jax.grad(loss)(params)
+    assert all(
+        np.isfinite(np.asarray(x)).all() for x in jax.tree_util.tree_leaves(g)
+    ), "all-masked input function produced non-finite gradients"

@@ -350,3 +350,35 @@ def test_sharded_step_pallas_requires_mesh_on_model():
     mesh = mesh_lib.make_mesh(MeshConfig(data=2, seq=1, model=1), jax.devices()[:2])
     with pytest.raises(ValueError, match="mesh"):
         mesh_lib.make_sharded_train_step(model, OptimConfig(), "rel_l2", mesh, state)
+
+
+def test_pallas_empty_input_function_is_finite():
+    """Pallas twin of test_model.py::test_empty_input_function_is_finite:
+    an all-masked function slab reaches nla_apply with ksum == 0; the
+    kernel's denominator guard must yield 0, not nan."""
+    import dataclasses as _dc
+
+    mc = SMALL_PALLAS
+    samples = datasets.synth_ns2d(2, n_points=16)
+    batch = next(iter(Loader(samples, 2, bucket=False)))
+    func_mask = np.array(batch.func_mask)
+    func_mask[0, 0, :] = 0.0  # sample 0's only input function is empty
+
+    model = GNOT(mc)
+    params = model.init(
+        jax.random.key(0), batch.coords, batch.theta, batch.funcs,
+        node_mask=batch.node_mask, func_mask=func_mask,
+    )["params"]
+
+    def loss(p):
+        y = model.apply(
+            {"params": p}, batch.coords, batch.theta, batch.funcs,
+            node_mask=batch.node_mask, func_mask=func_mask,
+        )
+        return jnp.mean(y * y)
+
+    val, g = jax.value_and_grad(loss)(params)
+    assert np.isfinite(float(val))
+    assert all(
+        np.isfinite(np.asarray(x)).all() for x in jax.tree_util.tree_leaves(g)
+    )

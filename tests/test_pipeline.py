@@ -174,6 +174,13 @@ def test_pipeline_validation():
     # layers not divisible by pipe
     with pytest.raises(ValueError, match="divisible"):
         pipeline.make_pipelined_train_step(model, optim, "rel_l2", mesh, sp)
+    # ... and already at state init, before any device_put can fail on
+    # uneven sharding
+    with pytest.raises(ValueError, match="divisible"):
+        pipeline.init_pipeline_state(model, optim, batch, 0, mesh)
+    # negative microbatches is a typo, not "auto"
+    with pytest.raises(ValueError, match="microbatches"):
+        pipeline.resolve_microbatches(mesh, -2)
     # pipe composes with data only
     with pytest.raises(ValueError, match="data axis only"):
         mesh_lib.make_mesh(MeshConfig(data=1, seq=2, pipe=2), jax.devices()[:4])

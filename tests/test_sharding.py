@@ -214,6 +214,10 @@ def test_expert_axis_divisibility_validated():
     mesh = mesh_lib.make_mesh(MeshConfig(data=4, expert=2))
     with pytest.raises(ValueError, match="divisible"):
         mesh_lib.make_sharded_train_step(model, OptimConfig(), "rel_l2", mesh, state)
+    # The eval-only builder (--eval_only path) must raise the same clear
+    # error, not an opaque XLA partitioning failure mid-compile.
+    with pytest.raises(ValueError, match="divisible"):
+        mesh_lib.make_sharded_eval_step(model, "rel_l2", mesh, state)
 
 
 def test_mesh_validation():
