@@ -56,4 +56,22 @@ def mse_loss(predictions: Array, targets: Array, mask: Array) -> Array:
     return jnp.mean(per_graph)
 
 
+def rel_l2_per_sample(predictions: Array, targets: Array, mask: Array) -> Array:
+    """``[B]`` per-graph relative L2 (channel-averaged) — the per-sample
+    decomposition of ``rel_l2_loss``: the batch mean of this vector is
+    the scalar loss (up to fp reduction order). Used by the distributed
+    ragged-tail eval, which pads the last test batch with repeats and
+    must drop them from the metric on the host."""
+    num = masked_segment_sum((predictions - targets) ** 2, mask)
+    den = masked_segment_sum(targets**2, mask)
+    return jnp.mean(jnp.sqrt(num / den), axis=1)
+
+
+def mse_per_sample(predictions: Array, targets: Array, mask: Array) -> Array:
+    """``[B]`` per-graph node-mean squared error (channel-averaged)."""
+    per_graph = masked_segment_mean((predictions - targets) ** 2, mask)
+    return jnp.mean(per_graph, axis=1)
+
+
 LOSSES = {"rel_l2": rel_l2_loss, "mse": mse_loss}
+PER_SAMPLE_LOSSES = {"rel_l2": rel_l2_per_sample, "mse": mse_per_sample}

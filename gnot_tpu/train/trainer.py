@@ -16,6 +16,7 @@ scalar argument so schedule changes never trigger recompiles.
 from __future__ import annotations
 
 import functools
+import itertools
 import time
 from typing import Any, Callable
 
@@ -28,7 +29,7 @@ import optax
 from gnot_tpu.config import Config, ModelConfig, OptimConfig
 from gnot_tpu.data.batch import Loader, MeshBatch
 from gnot_tpu.models.gnot import GNOT
-from gnot_tpu.ops.segment import LOSSES
+from gnot_tpu.ops.segment import LOSSES, PER_SAMPLE_LOSSES
 from gnot_tpu.train.schedule import make_lr_fn
 from gnot_tpu.utils import profiling
 
@@ -149,16 +150,22 @@ def stack_batches(batches: list[MeshBatch]) -> MeshBatch:
     return jax.tree.map(lambda *xs: np.stack(xs), *batches)
 
 
-def eval_step_body(model: GNOT, loss_name: str, *, loss_fn=None) -> Callable:
+def eval_step_body(
+    model: GNOT, loss_name: str, *, loss_fn=None, per_sample: bool = False
+) -> Callable:
     """THE eval math — the one copy the single-device and sharded,
     single- and multi-batch eval builders all wrap. ``loss_fn(params,
     batch)`` overrides the forward (scan_layers substitutes the stacked
-    forward)."""
+    forward). ``per_sample=True`` returns the ``[B]`` per-graph metric
+    vector instead of the batch scalar (the distributed ragged-tail
+    eval slices the real rows out on the host)."""
     if loss_fn is not None:
         return loss_fn
+    table = PER_SAMPLE_LOSSES if per_sample else LOSSES
 
     def body(params, batch: MeshBatch):
-        return batch_loss(model, params, batch, loss_name)
+        preds = apply_batch(model, params, batch)
+        return table[loss_name](preds, batch.y, batch.node_mask)
 
     return body
 
@@ -179,14 +186,15 @@ def make_multi_eval_step(model: GNOT, loss_name: str, *, loss_fn=None) -> Callab
     return multi_eval
 
 
-def stacked_loss_fn(model_cfg, loss_name: str) -> Callable:
+def stacked_loss_fn(model_cfg, loss_name: str, *, per_sample: bool = False) -> Callable:
     """loss_fn for the scan_layers (stacked-block) forward."""
-    from gnot_tpu.ops.segment import LOSSES
     from gnot_tpu.parallel.pipeline import stacked_forward
+
+    table = PER_SAMPLE_LOSSES if per_sample else LOSSES
 
     def loss_fn(params, batch: MeshBatch):
         preds = stacked_forward(model_cfg, params, batch)
-        return LOSSES[loss_name](preds, batch.y, batch.node_mask)
+        return table[loss_name](preds, batch.y, batch.node_mask)
 
     return loss_fn
 
@@ -254,6 +262,7 @@ class Trainer:
     ):
         self.config = config
         self.mesh = None
+        self._eval_tail = 0  # real samples in a repeat-padded tail eval batch
         drop_remainder = config.data.drop_remainder
         pad_nodes = config.data.pad_nodes
         pad_funcs = config.data.pad_funcs
@@ -298,10 +307,19 @@ class Trainer:
                 )
             if len(train_samples) % config.data.batch_size:
                 drop_remainder = True  # partial batches can't shard
-            if len(test_samples) % config.data.batch_size:
-                raise ValueError(
-                    f"distributed eval needs n_test ({len(test_samples)}) "
-                    f"divisible by batch_size ({config.data.batch_size})"
+            tail = len(test_samples) % config.data.batch_size
+            if tail:
+                # The reference evaluates the ragged tail batch
+                # (main.py:113-132). A short batch can't shard over the
+                # mesh, so pad it with repeats of the last sample and
+                # drop them from the metric (predict's discipline,
+                # see evaluate()). Multi-process runs require
+                # n_test % n_process == 0 (main.py), so every host's
+                # local tail has the same length — same batch count,
+                # no cross-host divergence.
+                self._eval_tail = tail
+                test_samples = list(test_samples) + [test_samples[-1]] * (
+                    config.data.batch_size - tail
                 )
         pallas_mesh = (
             self.mesh if model_cfg.attention_impl == "pallas" else None
@@ -377,6 +395,7 @@ class Trainer:
         self.checkpointer = checkpointer
         self.multi_train_step = None
         self.multi_eval_step = None
+        self._tail_eval_step = None
         self.state: TrainState | None = None
         self._forward = None  # jitted inference fn, built on first predict()
         self.best_metric = float("inf")
@@ -441,6 +460,21 @@ class Trainer:
                 self.model, self.config.train.loss, self.mesh, self.state,
                 self.config.mesh.microbatches, loss_fn=self._loss_fn,
             )
+            if self._eval_tail:
+                # Per-sample metric vector for the repeat-padded tail
+                # batch; evaluate() slices the real rows on the host.
+                tail_loss_fn = (
+                    stacked_loss_fn(
+                        self.model.config, self.config.train.loss, per_sample=True
+                    )
+                    if self._loss_fn is not None
+                    else None
+                )
+                self._tail_eval_step = mesh_lib.make_sharded_eval_step(
+                    self.model, self.config.train.loss, self.mesh, self.state,
+                    self.config.mesh.microbatches, loss_fn=tail_loss_fn,
+                    per_sample=True,
+                )
         if self.config.train.steps_per_dispatch > 1:
             if self.mesh is None:
                 self.multi_train_step = make_multi_train_step(
@@ -521,8 +555,15 @@ class Trainer:
             if self.multi_eval_step is not None
             else 1
         )
+        # Ragged distributed test set: the final batch was padded with
+        # repeats of the last sample (__init__); peel it off the grouped
+        # iteration and score it per-sample so the repeats drop out. The
+        # loader doesn't shuffle, so the tail is the last batch; divert
+        # it while streaming (keeps the prefetch overlap — no list()).
+        it = iter(self.test_loader)
+        n_full = len(self.test_loader) - (1 if self._eval_tail else 0)
         metrics: list[np.ndarray] = []
-        for kind, item in group_batches(self.test_loader, k):
+        for kind, item in group_batches(itertools.islice(it, n_full), k):
             if kind == "group":
                 metrics.append(
                     np.asarray(
@@ -538,6 +579,24 @@ class Trainer:
                         self.eval_step(self.state.params, self._device_batch(item))
                     )
                 )
+        if self._eval_tail:
+            per = np.asarray(
+                self._tail_eval_step(
+                    self.state.params, self._device_batch(next(it))
+                )
+            )
+            # The global batch concatenates per-host batches in process
+            # order; each host contributed _eval_tail real samples then
+            # repeats. Mean over the real rows == the batch-mean the
+            # single-device ragged tail batch would produce.
+            bs = self.config.data.batch_size
+            real = np.concatenate(
+                [
+                    np.arange(p * bs, p * bs + self._eval_tail)
+                    for p in range(jax.process_count())
+                ]
+            )
+            metrics.append(np.mean(per[real]))
         return float(np.mean(np.concatenate([np.atleast_1d(m) for m in metrics])))
 
     def predict(self, samples) -> list[np.ndarray]:

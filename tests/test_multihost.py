@@ -98,3 +98,65 @@ def test_distributed_trainer_matches_single_device():
     single, dist = build(False), build(True)
     np.testing.assert_allclose(single.evaluate(), dist.evaluate(), rtol=1e-5)
     np.testing.assert_allclose(single.fit(), dist.fit(), rtol=1e-4)
+
+
+@pytest.mark.parametrize(
+    "overrides",
+    [
+        {"mesh.data": 4, "mesh.model": 2},
+        {"mesh.data": 2, "mesh.seq": 2, "mesh.model": 2},
+        # pipe microbatches need batch/data >= 2 per shard
+        {"mesh.data": 4, "mesh.pipe": 2, "data.batch_size": 8},
+        {"mesh.data": 4, "mesh.model": 2, "model.scan_layers": True},
+    ],
+    ids=["dp-tp", "dp-sp-tp", "dp-pipe", "dp-tp-scan"],
+)
+def test_distributed_eval_ragged_test_set(overrides):
+    """n_test=10 with batch_size=4: distributed eval pads the tail batch
+    with repeats and drops them from the metric (VERDICT r3 #6) — the
+    metric must equal the single-device trainer's, which evaluates the
+    ragged tail batch natively like the reference (main.py:113-132)."""
+    from gnot_tpu import config as config_lib
+    from gnot_tpu.config import ModelConfig
+    from gnot_tpu.train.trainer import Trainer
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    mc = ModelConfig(
+        input_dim=2,
+        theta_dim=1,
+        input_func_dim=3,
+        out_dim=1,
+        n_input_functions=1,
+        n_attn_layers=2,
+        n_attn_hidden_dim=32,
+        n_mlp_num_layers=1,
+        n_mlp_hidden_dim=32,
+        n_input_hidden_dim=32,
+        n_expert=2,
+        n_head=4,
+        scan_layers=bool(overrides.pop("model.scan_layers", False)),
+    )
+    train = datasets.synth_ns2d(8, n_points=64, seed=2)
+    test = datasets.synth_ns2d(10, n_points=64, seed=3)
+    bs = overrides.pop("data.batch_size", 4)  # same bs both builds: the
+    # metric is a mean of batch means, so batching must match.
+
+    def build(distributed, mc_=mc):
+        cfg = config_lib.make_config(
+            **{
+                "data.batch_size": bs,
+                "train.epochs": 1,
+                "train.distributed": distributed,
+                **(overrides if distributed else {}),
+            }
+        )
+        t = Trainer(cfg, mc_, train, test)
+        t.initialize()
+        return t
+
+    import dataclasses as _dc
+
+    single = build(False, _dc.replace(mc, scan_layers=False))
+    dist = build(True)
+    np.testing.assert_allclose(single.evaluate(), dist.evaluate(), rtol=1e-5)
