@@ -23,21 +23,69 @@ from gnot_tpu.data.batch import MeshSample
 
 
 def load_pickle(path: str) -> list[MeshSample]:
-    """Read a reference-schema pickle: list of [X, Y, theta, (f...)]."""
+    """Read a reference-schema pickle: list of ``[X, Y, theta, (f...)]``.
+
+    Accepts everything the reference's ``NS2dDataset`` ingests
+    (``/root/reference/dataset.py:7,30-38``): X/Y as numpy arrays of any
+    float dtype (the reference casts via ``.float()``) or torch tensors
+    (``np.asarray`` takes either), theta as a raw scalar / 0-d / 1-d
+    value (kept uncast by the reference), input functions as a tuple or
+    list (both truthy-checked there), possibly absent or empty.
+    Malformed records raise a ValueError naming the record and the
+    expected schema, not an index/broadcast error from deep inside."""
     with open(path, "rb") as f:
         records = pickle.load(f)
-    samples = []
-    for rec in records:
-        x, y, theta = rec[0], rec[1], rec[2]
-        funcs = tuple(np.asarray(fi, np.float32) for fi in rec[3]) if len(rec) > 3 and rec[3] else ()
-        samples.append(
-            MeshSample(
-                coords=np.asarray(x, np.float32),
-                y=np.asarray(y, np.float32),
-                theta=np.atleast_1d(np.asarray(theta, np.float32)),
-                funcs=funcs,
-            )
+    if not isinstance(records, (list, tuple)):
+        raise ValueError(
+            f"{path}: expected a pickled list of [X, Y, theta, (f...)] "
+            f"records, got {type(records).__name__}"
         )
+    samples = []
+    for i, rec in enumerate(records):
+        if not isinstance(rec, (list, tuple)) or len(rec) < 3:
+            raise ValueError(
+                f"{path}: record {i} must be [X, Y, theta, (f...)] with "
+                f"at least 3 entries, got "
+                + (f"{len(rec)} entries" if isinstance(rec, (list, tuple))
+                   else type(rec).__name__)
+            )
+        x, y, theta = rec[0], rec[1], rec[2]
+        try:
+            x = np.asarray(x, np.float32)
+            y = np.asarray(y, np.float32)
+            theta = np.atleast_1d(np.asarray(theta, np.float32))
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"{path}: record {i} has non-numeric X/Y/theta: {e}"
+            ) from e
+        if x.ndim != 2 or y.ndim != 2 or x.shape[0] != y.shape[0]:
+            raise ValueError(
+                f"{path}: record {i} needs X [n, d] and Y [n, c] with "
+                f"matching n, got X {x.shape} and Y {y.shape}"
+            )
+        raw_funcs = rec[3] if len(rec) > 3 else ()
+        if raw_funcs is None:
+            raw_funcs = ()
+        if not isinstance(raw_funcs, (list, tuple)):
+            # Not `if rec[3]:` — an ndarray/tensor container would raise
+            # an ambiguous-truthiness error with no record context here.
+            raise ValueError(
+                f"{path}: record {i} input functions must be a tuple or "
+                f"list of [m, d] arrays, got {type(raw_funcs).__name__}"
+            )
+        try:
+            funcs = tuple(np.asarray(fi, np.float32) for fi in raw_funcs)
+        except (TypeError, ValueError) as e:
+            raise ValueError(
+                f"{path}: record {i} has a non-numeric input function: {e}"
+            ) from e
+        for j, fi in enumerate(funcs):
+            if fi.ndim != 2:
+                raise ValueError(
+                    f"{path}: record {i} input function {j} must be "
+                    f"[m, d], got shape {fi.shape}"
+                )
+        samples.append(MeshSample(coords=x, y=y, theta=theta, funcs=funcs))
     return samples
 
 

@@ -171,3 +171,104 @@ def test_loader_epoch_shuffle_resumable():
     assert [b.theta.tobytes() for b in resumed] == orders[2]
     # and epochs actually differ from each other
     assert orders[0] != orders[1]
+
+
+def make_reference_contract_records():
+    """Records EXACTLY as the reference's NS2dDataset ingests them
+    (/root/reference/dataset.py:7,30-38): X/Y numpy (any float dtype —
+    the reference casts with .float()), theta kept raw (scalar, 0-d, or
+    array), input functions tuple- OR list-wrapped (truthiness-checked
+    there), torch tensors accepted anywhere np.asarray is (from_numpy
+    sources are numpy, but torch-written pickles carry tensors)."""
+    import torch
+
+    rng = np.random.default_rng(11)
+
+    def xy(n, d=2, c=1, dtype=np.float64):
+        return (
+            rng.normal(size=(n, d)).astype(dtype),
+            rng.normal(size=(n, c)).astype(dtype),
+        )
+
+    x0, y0 = xy(7)
+    x1, y1 = xy(5)
+    x2, y2 = xy(9, dtype=np.float32)
+    x3, y3 = xy(4)
+    return [
+        # tuple-wrapped float64 funcs, scalar python-float theta
+        [x0, y0, 0.25, (rng.normal(size=(6, 3)), rng.normal(size=(8, 3)))],
+        # list-wrapped funcs, 0-d numpy theta
+        [x1, y1, np.float64(1.5), [rng.normal(size=(3, 3)).astype(np.float32)]],
+        # torch-tensor X/Y/funcs, 1-d theta
+        [
+            torch.from_numpy(x2),
+            torch.from_numpy(y2),
+            np.array([0.1, 0.2]),
+            (torch.from_numpy(rng.normal(size=(5, 3)).astype(np.float32)),),
+        ],
+        # empty input functions (reference: `if input_function:` is False)
+        [x3, y3, np.array([0.3]), ()],
+    ]
+
+
+def test_load_pickle_reference_contract(tmp_path):
+    import pickle
+
+    records = make_reference_contract_records()
+    p = tmp_path / "ref_contract.pkl"
+    with open(p, "wb") as f:
+        pickle.dump(records, f)
+
+    samples = datasets.load_pickle(str(p))
+    assert len(samples) == 4
+    for s, rec in zip(samples, records):
+        assert s.coords.dtype == np.float32 and s.coords.ndim == 2
+        assert s.y.dtype == np.float32 and s.y.shape[0] == s.coords.shape[0]
+        assert s.theta.dtype == np.float32 and s.theta.ndim == 1
+        np.testing.assert_allclose(
+            s.coords, np.asarray(rec[0], np.float32), rtol=1e-6
+        )
+        assert isinstance(s.funcs, tuple)
+        for fi, raw in zip(s.funcs, rec[3]):
+            assert fi.dtype == np.float32 and fi.ndim == 2
+            np.testing.assert_allclose(fi, np.asarray(raw, np.float32), rtol=1e-6)
+    assert samples[3].funcs == ()
+    assert float(samples[0].theta[0]) == pytest.approx(0.25)
+
+
+@pytest.mark.parametrize(
+    "record,match",
+    [
+        (["just-one-entry"], "at least 3 entries"),
+        (None, "must be \\[X, Y, theta"),
+        ([np.zeros((4, 2)), np.zeros((5, 1)), 0.0, ()], "matching n"),
+        ([np.zeros(4), np.zeros((4, 1)), 0.0, ()], "X \\(4,\\)"),
+        ([np.zeros((4, 2)), np.zeros((4, 1)), "nan?", ()], "non-numeric"),
+        ([np.zeros((4, 2)), np.zeros((4, 1)), 0.0, (np.zeros(3),)], "must be"),
+        # ndarray funcs container: a clear message, not an
+        # ambiguous-truthiness error
+        ([np.zeros((4, 2)), np.zeros((4, 1)), 0.0, np.ones((5, 3))], "tuple or list"),
+    ],
+)
+def test_load_pickle_malformed_record_messages(record, match, tmp_path):
+    """Malformed records raise a ValueError naming the record and the
+    schema — not an IndexError / broadcast error from deep inside."""
+    import pickle
+
+    good = [np.zeros((4, 2), np.float32), np.zeros((4, 1), np.float32), 0.0, ()]
+    p = tmp_path / "bad.pkl"
+    with open(p, "wb") as f:
+        pickle.dump([good, record], f)
+    with pytest.raises(ValueError, match=match) as exc:
+        datasets.load_pickle(str(p))
+    assert "record 1" in str(exc.value)
+
+
+def test_load_pickle_non_list_toplevel(tmp_path):
+    import pickle
+
+    p = tmp_path / "notalist.pkl"
+    with open(p, "wb") as f:
+        pickle.dump({"x": 1}, f)
+    with pytest.raises(ValueError, match="pickled list"):
+        datasets.load_pickle(str(p))
