@@ -46,6 +46,15 @@ class ModelConfig:
     # default) or "ring" (S-1 ppermute hops; ops/collectives.py).
     # The xla impl's SP collectives are scheduled by XLA — unaffected.
     sp_collective: str = "psum"
+    # GELU flavor for every MLP: "erf" (torch nn.GELU default — the
+    # reference's op, reference model.py:8) or "tanh" (the standard
+    # tanh approximation). "" auto-resolves to "erf" in parity mode
+    # (bit-faithfulness) and "tanh" otherwise: exact erf is VPU-bound
+    # on TPU and measures ~2x the whole forward pass at the default
+    # architecture (docs/performance.md), while tanh-GELU changes
+    # activations by ~1e-3 and final quality within noise (the quality
+    # gates run against the erf-based torch oracle and still pass).
+    gelu: str = ""
     # Compute dtype for the encoder stack; params stay float32.
     dtype: str = "float32"
     # Rematerialize each attention block in backward (jax.checkpoint):
@@ -65,6 +74,20 @@ class ModelConfig:
             raise ValueError("n_attn_hidden_dim must be divisible by n_head")
         if self.attention_mode not in ("parity", "masked"):
             raise ValueError(f"unknown attention_mode {self.attention_mode!r}")
+        if not self.gelu:
+            object.__setattr__(
+                self,
+                "gelu",
+                "erf" if self.attention_mode == "parity" else "tanh",
+            )
+        if self.gelu not in ("erf", "tanh"):
+            raise ValueError(f"unknown gelu {self.gelu!r}")
+        if self.attention_mode == "parity" and self.gelu != "erf":
+            raise ValueError(
+                "parity mode reproduces the reference bit-for-bit and "
+                "requires gelu='erf' (torch nn.GELU); tanh-GELU is the "
+                "masked-mode TPU default"
+            )
         if self.attention_impl not in ("xla", "pallas"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
         if self.ffn_impl not in ("xla", "pallas"):

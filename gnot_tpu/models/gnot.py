@@ -48,6 +48,7 @@ class HNABlock(nn.Module):
     parity: bool = False
     attention_impl: str = "xla"
     ffn_impl: str = "xla"
+    gelu: str = "erf"
     mesh: Any = None
     sp_collective: str = "psum"
 
@@ -79,6 +80,7 @@ class HNABlock(nn.Module):
             self.n_mlp_hidden_dim,
             dtype=self.dtype,
             ffn_impl=self.ffn_impl,
+            gelu=self.gelu,
             name="ffn1",
         )(cross, scores)
         query = query + ffn1
@@ -101,6 +103,7 @@ class HNABlock(nn.Module):
             self.n_mlp_hidden_dim,
             dtype=self.dtype,
             ffn_impl=self.ffn_impl,
+            gelu=self.gelu,
             name="ffn2",
         )(self_out, scores)
         return query + ffn2
@@ -127,6 +130,7 @@ def gating_module(cfg: ModelConfig) -> Mlp:
         cfg.n_mlp_hidden_dim,
         cfg.n_expert,
         dtype=model_dtype(cfg),
+        gelu=cfg.gelu,
         name="gating",
     )
 
@@ -151,6 +155,7 @@ def x_embed_module(cfg: ModelConfig) -> Mlp:
         cfg.n_input_hidden_dim,
         cfg.n_input_hidden_dim,
         dtype=model_dtype(cfg),
+        gelu=cfg.gelu,
         name="x_embed",
     )
 
@@ -169,6 +174,7 @@ def func_embed_module(cfg: ModelConfig):
         cfg.n_mlp_hidden_dim,
         cfg.n_input_hidden_dim,
         model_dtype(cfg),
+        cfg.gelu,
         name="input_func_mlps",
     )
 
@@ -194,6 +200,7 @@ def block_module(
         parity=cfg.attention_mode == "parity",
         attention_impl=cfg.attention_impl,
         ffn_impl=cfg.ffn_impl,
+        gelu=cfg.gelu,
         mesh=mesh,
         sp_collective=cfg.sp_collective,
         name=name,
@@ -207,6 +214,7 @@ def out_module(cfg: ModelConfig) -> Mlp:
         cfg.n_mlp_hidden_dim,
         cfg.out_dim,
         dtype=model_dtype(cfg),
+        gelu=cfg.gelu,
         name="out_mlp",
     )
 

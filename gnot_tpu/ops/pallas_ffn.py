@@ -108,7 +108,19 @@ def _gelu_exact(x: Array) -> Array:
     return 0.5 * x * (1.0 + _erf_f32(x * inv_sqrt2))
 
 
-def _ffn_kernel(x_ref, s_ref, *refs, n_expert: int, n_linears: int):
+def _gelu_tanh(x: Array) -> Array:
+    """tanh-approximated GELU (``jax.nn.gelu(approximate=True)``) — the
+    masked-mode default (config.gelu): ~2x cheaper than exact erf on the
+    TPU VPU. Mosaic has a native ``tanh``."""
+    c = jnp.float32(0.7978845608028654)  # sqrt(2/pi)
+    return 0.5 * x * (1.0 + jnp.tanh(c * (x + jnp.float32(0.044715) * x * x * x)))
+
+
+def _gelu(x: Array, gelu: str) -> Array:
+    return _gelu_tanh(x) if gelu == "tanh" else _gelu_exact(x)
+
+
+def _ffn_kernel(x_ref, s_ref, *refs, n_expert: int, n_linears: int, gelu: str):
     k_refs = refs[:n_linears]
     b_refs = refs[n_linears : 2 * n_linears]
     out_ref = refs[2 * n_linears]
@@ -128,7 +140,7 @@ def _ffn_kernel(x_ref, s_ref, *refs, n_expert: int, n_linears: int):
                 + b_refs[i][e].astype(jnp.float32)  # [1, out] row broadcast
             )
             if i < n_linears - 1:
-                h = _gelu_exact(h)
+                h = _gelu(h, gelu)
         acc = acc + scores[:, e][:, None] * h
     out_ref[0] = acc.astype(out_ref.dtype)
 
@@ -137,7 +149,7 @@ def _round_up(n: int, m: int) -> int:
     return (n + m - 1) // m * m
 
 
-def _ffn_call(x, scores, kernels, biases, interpret: bool):
+def _ffn_call(x, scores, kernels, biases, interpret: bool, gelu: str):
     b, l, _ = x.shape
     n_expert = kernels[0].shape[0]
     n_linears = len(kernels)
@@ -154,7 +166,7 @@ def _ffn_call(x, scores, kernels, biases, interpret: bool):
 
     out = pl.pallas_call(
         functools.partial(
-            _ffn_kernel, n_expert=n_expert, n_linears=n_linears
+            _ffn_kernel, n_expert=n_expert, n_linears=n_linears, gelu=gelu
         ),
         grid=(b, lp // tl),
         in_specs=[
@@ -169,7 +181,7 @@ def _ffn_call(x, scores, kernels, biases, interpret: bool):
     return out[:, :l]
 
 
-def _reference_impl(x, scores, kernels, biases):
+def _reference_impl(x, scores, kernels, biases, gelu: str = "erf"):
     """Einsum/jnp form with the kernel's f32 semantics (backward source
     + test oracle). Matches the XLA GatedExpertFfn math
     (models/layers.py) — per-expert MLP, gate-weighted sum — with the
@@ -186,13 +198,13 @@ def _reference_impl(x, scores, kernels, biases):
             + bb.astype(jnp.float32)[:, None, None, :]
         )
         if i < n - 1:
-            h = _gelu_exact(h)
+            h = _gelu(h, gelu)
     out = jnp.einsum("eblo,ble->blo", h, scores.astype(jnp.float32))
     return out.astype(x.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
-def fused_gated_ffn(x, scores, kernels, biases, interpret: bool | None = None):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def fused_gated_ffn(x, scores, kernels, biases, interpret: bool | None = None, gelu: str = "erf"):
     """Fused gated expert FFN.
 
     Args:
@@ -206,20 +218,20 @@ def fused_gated_ffn(x, scores, kernels, biases, interpret: bool | None = None):
       ``[B, L, Dout]`` gate-combined expert outputs.
     """
     interpret = _interpret_default() if interpret is None else interpret
-    return _ffn_call(x, scores, list(kernels), list(biases), interpret)
+    return _ffn_call(x, scores, list(kernels), list(biases), interpret, gelu)
 
 
-def _fused_fwd(x, scores, kernels, biases, interpret):
+def _fused_fwd(x, scores, kernels, biases, interpret, gelu):
     interpret = _interpret_default() if interpret is None else interpret
-    out = _ffn_call(x, scores, list(kernels), list(biases), interpret)
+    out = _ffn_call(x, scores, list(kernels), list(biases), interpret, gelu)
     return out, (x, scores, kernels, biases)
 
 
-def _fused_bwd(interpret, residuals, g):
+def _fused_bwd(interpret, gelu, residuals, g):
     del interpret
     x, scores, kernels, biases = residuals
     _, vjp = jax.vjp(
-        lambda x_, s_, k_, b_: _reference_impl(x_, s_, k_, b_),
+        lambda x_, s_, k_, b_: _reference_impl(x_, s_, k_, b_, gelu),
         x,
         scores,
         kernels,
