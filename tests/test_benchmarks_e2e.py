@@ -176,3 +176,39 @@ def test_empty_test_set_trains_without_nan():
     trainer = Trainer(cfg, mc, train, [])
     best = trainer.fit()
     assert best == float("inf")  # no eval, but training completed
+
+
+def test_bench_scan_marginal_matches_persstep_on_cpu():
+    """The bench's scan_marginal estimator (two K-step scanned windows,
+    marginal difference) must agree with the per-step dispatch loop on a
+    locally-attached device, where per-step timing is trustworthy — the
+    evidence that the marginal is per-step device time, not a
+    scan artifact. Tiny model so the check stays fast."""
+    import sys
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+    from gnot_tpu.config import ModelConfig, OptimConfig
+    from gnot_tpu.data.batch import Loader
+    from gnot_tpu.models.gnot import GNOT
+    from gnot_tpu.train.trainer import init_state, make_train_step
+
+    samples = datasets.synth_ns2d(2, n_points=64)
+    batch = next(iter(Loader(samples, 2)))
+    mc = ModelConfig(**TINY, **datasets.infer_model_dims(samples))
+    model = GNOT(mc)
+    optim = OptimConfig()
+    state = init_state(model, optim, batch, seed=0)
+    step = make_train_step(model, optim, "rel_l2")
+    dev = jax.devices()[0]
+    lr = jnp.asarray(1e-3, jnp.float32)
+
+    per_scan = bench.time_scan_marginal(step, state, batch, lr, dev, 4, 16, 2)
+    per_step = bench.time_steps(step, state, batch, lr, 2, 16, dev, repeats=2)
+    assert per_scan > 0 and np.isfinite(per_scan)
+    assert per_step > 0 and np.isfinite(per_step)
+    # Same device work; generous bound for host-loop overhead and CI noise.
+    assert 0.2 < per_scan / per_step < 5.0

@@ -1,0 +1,57 @@
+"""Honest per-step timing probe for the tunnel-attached chip.
+
+A thin CLI over ``bench.time_scan_marginal`` — the one copy of the
+estimator: K-step scanned programs at two lengths, marginal ms/step
+(the constant dispatch/tunnel round-trip cancels in the difference),
+HARD-FETCH sync (``block_until_ready`` has been observed returning
+early on the axon platform), transient-error retries.
+
+Usage: python tools/honest_probe.py [--dtype bfloat16] [--attention_impl xla]
+       [--ffn_impl xla] [--config ns2d] [--n_points 1024] [--batch_size 4]
+       [--k1 25] [--k2 100] [--windows 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    p = argparse.ArgumentParser()
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--attention_impl", default="xla")
+    p.add_argument("--ffn_impl", default="xla")
+    p.add_argument("--config", default="ns2d")
+    p.add_argument("--n_points", type=int, default=1024)
+    p.add_argument("--batch_size", type=int, default=4)
+    p.add_argument("--k1", type=int, default=25)
+    p.add_argument("--k2", type=int, default=100)
+    p.add_argument("--windows", type=int, default=3)
+    p.add_argument("--remat", action="store_true")
+    args = p.parse_args()
+
+    import bench
+
+    step, state, batch, mc = bench.build(
+        args.dtype, args.attention_impl, args.n_points, args.batch_size,
+        args.ffn_impl, args.config, args.remat,
+    )
+    per = bench.time_scan_marginal(
+        step, state, batch, jnp.asarray(1e-3, jnp.float32), jax.devices()[0],
+        args.k1, args.k2, args.windows,
+    )
+    label = f"{args.dtype} attn={args.attention_impl} ffn={args.ffn_impl} {args.config}"
+    print(
+        f"{label}: {per * 1e3:.2f} ms/step  "
+        f"{batch.n_real_points / per / 1e6:.3f}M pts/s"
+    )
+
+
+if __name__ == "__main__":
+    main()
