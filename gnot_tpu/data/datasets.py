@@ -63,6 +63,11 @@ def load_pickle(path: str) -> list[MeshSample]:
                 f"{path}: record {i} needs X [n, d] and Y [n, c] with "
                 f"matching n, got X {x.shape} and Y {y.shape}"
             )
+        if theta.ndim != 1:
+            raise ValueError(
+                f"{path}: record {i} theta must be a scalar or 1-d "
+                f"vector, got shape {theta.shape}"
+            )
         raw_funcs = rec[3] if len(rec) > 3 else ()
         if raw_funcs is None:
             raw_funcs = ()

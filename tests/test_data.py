@@ -248,6 +248,8 @@ def test_load_pickle_reference_contract(tmp_path):
         # ndarray funcs container: a clear message, not an
         # ambiguous-truthiness error
         ([np.zeros((4, 2)), np.zeros((4, 1)), 0.0, np.ones((5, 3))], "tuple or list"),
+        # 2-d theta would break query_features' broadcast deep inside
+        ([np.zeros((4, 2)), np.zeros((4, 1)), np.zeros((1, 2)), ()], "theta"),
     ],
 )
 def test_load_pickle_malformed_record_messages(record, match, tmp_path):
