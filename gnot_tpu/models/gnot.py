@@ -123,6 +123,21 @@ def model_dtype(cfg: ModelConfig):
     return jnp.dtype(cfg.dtype) if cfg.dtype != "float32" else None
 
 
+def precision_scope(cfg: ModelConfig):
+    """Parity mode exists to reproduce the torch oracle; on TPU the
+    default matmul precision accumulates bf16 passes and costs ~1e-4 of
+    agreement by itself (docs/performance.md, hardware parity note).
+    Pin full-f32 contractions so the mode means the same thing on every
+    backend (no-op on CPU). THE one scope every parity-capable forward
+    enters: GNOT.__call__, pipeline.stacked_forward, and
+    pipeline.pipelined_forward."""
+    import contextlib
+
+    if cfg.attention_mode == "parity":
+        return jax.default_matmul_precision("highest")
+    return contextlib.nullcontext()
+
+
 def gating_module(cfg: ModelConfig) -> Mlp:
     """Geometry gating MLP (model.py:148)."""
     return Mlp(
@@ -245,9 +260,24 @@ class GNOT(nn.Module):
         node_mask: Array | None = None,
         func_mask: Array | None = None,
     ) -> Array:
-        cfg = self.config
-        if cfg.attention_mode == "parity":
+        if self.config.attention_mode == "parity":
             node_mask = func_mask = None
+        with precision_scope(self.config):
+            return self._gnot_forward(
+                coords, theta, input_functions,
+                node_mask=node_mask, func_mask=func_mask,
+            )
+
+    def _gnot_forward(
+        self,
+        coords: Array,
+        theta: Array,
+        input_functions: Array | None,
+        *,
+        node_mask: Array | None,
+        func_mask: Array | None,
+    ) -> Array:
+        cfg = self.config
 
         # Geometry gating on raw coordinates, computed once (model.py:155-156).
         scores = gating_scores(gating_module(cfg)(coords))

@@ -290,12 +290,15 @@ def stacked_forward(cfg: ModelConfig, params: dict, batch: MeshBatch):
     node_mask, func_mask = batch.node_mask, batch.func_mask
     if cfg.attention_mode == "parity":
         node_mask = func_mask = None
-    scores, query, funcs = _embed(cfg, params, batch.coords, batch.theta, batch.funcs)
-    block = gnot.block_module(cfg, funcs is not None)
-    query = _scan_blocks(
-        cfg, block, params["blocks"], scores, query, funcs, node_mask, func_mask
-    )
-    return _head(cfg, params, query)
+    with gnot.precision_scope(cfg):
+        scores, query, funcs = _embed(
+            cfg, params, batch.coords, batch.theta, batch.funcs
+        )
+        block = gnot.block_module(cfg, funcs is not None)
+        query = _scan_blocks(
+            cfg, block, params["blocks"], scores, query, funcs, node_mask, func_mask
+        )
+        return _head(cfg, params, query)
 
 
 def init_stacked_state(model, optim_cfg: OptimConfig, sample_batch, seed: int):
@@ -317,14 +320,20 @@ def pipelined_forward(
 ):
     """Full GNOT forward with the block stack pipelined (params in
     pipeline layout)."""
+    from gnot_tpu.models import gnot
+
     node_mask, func_mask = batch.node_mask, batch.func_mask
     if cfg.attention_mode == "parity":
         node_mask = func_mask = None
-    scores, query, funcs = _embed(cfg, params, batch.coords, batch.theta, batch.funcs)
-    query = _pipe_blocks(
-        cfg, mesh, n_micro, params["blocks"], scores, query, funcs, node_mask, func_mask
-    )
-    return _head(cfg, params, query)
+    with gnot.precision_scope(cfg):
+        scores, query, funcs = _embed(
+            cfg, params, batch.coords, batch.theta, batch.funcs
+        )
+        query = _pipe_blocks(
+            cfg, mesh, n_micro, params["blocks"], scores, query, funcs,
+            node_mask, func_mask,
+        )
+        return _head(cfg, params, query)
 
 
 # ---------------------------------------------------------------------------

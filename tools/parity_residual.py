@@ -1,0 +1,108 @@
+"""On-chip parity residual vs the torch CPU oracle, with an error budget.
+
+Parity mode now pins jax.default_matmul_precision('highest')
+(models/gnot.py), so the full-f32 forward on TPU should agree with the
+torch CPU reference to the same order as CPU-vs-CPU. This script
+measures the end-to-end residual on the default platform and
+decomposes the remaining floor per op class:
+
+* matmul: chip f32 dot (highest precision) vs numpy f64-rounded-f32;
+* erf-GELU: chip jax.nn.gelu(approximate=False) vs torch nn.GELU;
+* feature softmax: chip f32 softmax vs torch F.softmax.
+
+Usage: python tools/parity_residual.py [--grid_n 16] [--small_arch]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--grid_n", type=int, default=16)
+    p.add_argument("--small_arch", action="store_true",
+                   help="2 layers / 64 wide (the round-3 probe config) "
+                        "instead of the reference default")
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import torch
+
+    from gnot_tpu.config import ModelConfig
+    from gnot_tpu.data import datasets
+    from gnot_tpu.data.batch import collate
+    from gnot_tpu.interop.torch_oracle import build_reference_model, state_dict_to_flax
+    from gnot_tpu.models.gnot import GNOT
+
+    dev = jax.devices()[0]
+    print(f"platform: {dev.platform} ({getattr(dev, 'device_kind', '?')})")
+
+    samples = datasets.synth_darcy2d(2, seed=9, grid_n=args.grid_n)
+    b = collate(samples, bucket=False)
+    arch = (
+        dict(n_attn_layers=2, n_attn_hidden_dim=64, n_mlp_num_layers=2,
+             n_mlp_hidden_dim=64, n_input_hidden_dim=64, n_expert=2, n_head=4)
+        if args.small_arch
+        else {}
+    )
+    mc = ModelConfig(
+        **datasets.infer_model_dims(samples), **arch, attention_mode="parity"
+    )
+
+    torch.manual_seed(4)
+    ref = build_reference_model(mc)
+    ref.eval()
+    with torch.no_grad():
+        want = ref(
+            torch.from_numpy(b.coords),
+            torch.from_numpy(b.theta),
+            [torch.from_numpy(f) for f in b.funcs],
+        ).numpy()
+
+    params = state_dict_to_flax(ref.state_dict(), mc)
+    got = np.asarray(
+        jax.jit(
+            lambda p, c, t, f: GNOT(mc).apply({"params": p}, c, t, f)
+        )(params, b.coords, b.theta, b.funcs)
+    )
+    resid = float(np.max(np.abs(got - want)))
+    scale = float(np.max(np.abs(want)))
+    print(f"full-model forward residual (parity mode, auto-highest): "
+          f"{resid:.3e} abs  ({resid / scale:.3e} of max |out|)")
+
+    # ---- error budget -----------------------------------------------------
+    rng = np.random.default_rng(0)
+    # matmul at the model's hot shape
+    m, k, n = 4096 if not args.small_arch else 512, 256, 256
+    A = rng.normal(size=(m, k)).astype(np.float32)
+    B = rng.normal(size=(k, n)).astype(np.float32)
+    exact = (A.astype(np.float64) @ B.astype(np.float64)).astype(np.float32)
+    for prec in ("default", "highest"):
+        with jax.default_matmul_precision(prec):
+            chip = np.asarray(jax.jit(jnp.dot)(A, B))
+        print(f"matmul [{m}x{k}x{n}] f32 {prec}: max|err| = "
+              f"{np.max(np.abs(chip - exact)):.3e} "
+              f"(rel {np.max(np.abs(chip - exact)) / np.max(np.abs(exact)):.3e})")
+
+    x = rng.normal(size=(1 << 16,)).astype(np.float32) * 3
+    t_gelu = torch.nn.GELU()(torch.from_numpy(x)).numpy()
+    j_gelu = np.asarray(jax.jit(lambda v: jax.nn.gelu(v, approximate=False))(x))
+    print(f"erf-GELU: chip vs torch max|err| = {np.max(np.abs(j_gelu - t_gelu)):.3e}")
+
+    xs = rng.normal(size=(1024, 32)).astype(np.float32)
+    t_sm = torch.nn.functional.softmax(torch.from_numpy(xs), dim=-1).numpy()
+    j_sm = np.asarray(jax.jit(lambda v: jax.nn.softmax(v, axis=-1))(xs))
+    print(f"feature softmax (D=32): chip vs torch max|err| = "
+          f"{np.max(np.abs(j_sm - t_sm)):.3e}")
+
+
+if __name__ == "__main__":
+    main()
