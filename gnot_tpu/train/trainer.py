@@ -610,11 +610,13 @@ class Trainer:
         the tail batch is filled with repeats of the last sample so
         every batch shards evenly; the repeats are dropped on return.
 
-        Multi-process runs: sharded params span non-addressable
-        devices, so the global values are gathered onto every host
-        (``process_allgather`` — a collective; ALL processes must call
-        predict together, with the same samples) and the forward runs
-        on local devices. Every process returns the full predictions.
+        Multi-process runs: the forward runs SHARDED on the mesh —
+        params stay in their mesh layout (no host-side
+        ``process_allgather``, which would not scale past toy sizes);
+        only the output is replicated (an on-device collective over
+        ICI). ALL processes must call predict together with the same
+        samples: each host feeds its contiguous slice of every global
+        batch and every process returns the full predictions.
         """
         multiproc = jax.process_count() > 1
         if self.state is None:
@@ -632,28 +634,28 @@ class Trainer:
                 # Stacked layout (scan_layers / pipeline): run the
                 # stacked forward on the params as-is — no unstack, and
                 # no re-paying the per-depth compile that scan_layers
-                # exists to avoid.
+                # exists to avoid. Pipe-sharded block stacks gather
+                # on-device under GSPMD (an ICI all-gather of ~MBs,
+                # not a host collective).
                 from gnot_tpu.parallel.pipeline import stacked_forward
 
                 mc = model.config
+                fwd = lambda params, batch: stacked_forward(mc, params, batch)
+            else:
+                fwd = lambda params, batch: apply_batch(model, params, batch)
+            if self.mesh is not None:
+                # Replicate the output so every host can read the full
+                # prediction rows (multiproc) / no cross-shard fetches
+                # are needed (single-process mesh).
+                from jax.sharding import NamedSharding, PartitionSpec
+
                 self._forward = jax.jit(
-                    lambda params, batch: stacked_forward(mc, params, batch)
+                    fwd, out_shardings=NamedSharding(self.mesh, PartitionSpec())
                 )
             else:
-                self._forward = jax.jit(
-                    lambda params, batch: apply_batch(model, params, batch)
-                )
+                self._forward = jax.jit(fwd)
         forward = self._forward
-        if multiproc:
-            from jax.experimental import multihost_utils
-
-            # Gather the raw (possibly stacked) tree; the forward above
-            # matches its layout.
-            params = multihost_utils.process_allgather(
-                self.state.params, tiled=True
-            )
-        else:
-            params = self.state.params
+        params = self.state.params
 
         samples = list(samples)
         n_real = len(samples)
@@ -677,23 +679,42 @@ class Trainer:
                             f"{f.shape[0]} points but the fixed pad length "
                             f"is {pf}; rebuild with larger pad_funcs"
                         )
-        if not multiproc and self.mesh is not None and n_real % bs:
-            samples = samples + [samples[-1]] * (bs - n_real % bs)
+        nproc = jax.process_count()
+        if multiproc and self.mesh is None:
+            raise ValueError(
+                "multi-process predict() requires the distributed "
+                "trainer (a mesh) — run with --distributed"
+            )
+        # One dispatch covers `group` sample rows: the global batch
+        # concatenates every host's bs-row slice in process order, so
+        # global row r of dispatch i is samples[i*group + r].
+        group = bs * nproc if self.mesh is not None else bs
+        if self.mesh is not None and n_real % group:
+            samples = samples + [samples[-1]] * (group - n_real % group)
+        if multiproc:
+            p_idx = jax.process_index()
+            loader_samples = []
+            for i in range(0, len(samples), group):
+                loader_samples.extend(samples[i + p_idx * bs : i + (p_idx + 1) * bs])
+        else:
+            loader_samples = samples
         loader = Loader(
-            samples,
+            loader_samples,
             bs,
             bucket=self.config.data.bucket,
             pad_nodes=self.train_loader.pad_nodes,
             pad_funcs=self.train_loader.pad_funcs,
         )
         outs: list[np.ndarray] = []
-        for batch in loader:
-            # Multi-process: params were gathered, so the forward runs
-            # on this host's local device — no cross-host batch assembly.
-            db = batch if multiproc else self._device_batch(batch)
+        for bi, batch in enumerate(loader):
+            # Multi-process: _device_batch assembles the global batch
+            # from the per-host slices; the forward runs sharded and
+            # returns the replicated [group, L, out] prediction.
+            db = self._device_batch(batch)
             out = np.asarray(forward(params, db))
-            lengths = np.sum(np.asarray(batch.node_mask), axis=1).astype(int)
-            outs.extend(out[i, :n] for i, n in enumerate(lengths))
+            for j in range(out.shape[0]):
+                idx = bi * group + j
+                outs.append(out[j, : samples[idx].coords.shape[0]])
         return outs[:n_real]
 
     def evaluate_from_checkpoint(self) -> float:
