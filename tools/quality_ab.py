@@ -1,0 +1,209 @@
+"""Full-scale quality A/B: torch reference vs jax on Darcy2d 64x64.
+
+Runs the reference-default GNOT architecture (4 layers / 256 wide /
+3 experts / 8 heads — /root/reference/main.py:16-22) on the Darcy2d
+64x64-grid config (BASELINE.json configs[0]) at the reference training
+regime (AdamW 1e-3, per-epoch OneCycle with the reference's stepping
+bug, batch 4) from the SAME initial weights (torch.manual_seed(0) ->
+state_dict_to_flax) and the SAME per-epoch batch composition, and
+writes one JSONL line per epoch: {"backend", "epoch", "train_loss",
+"test_metric"}.
+
+One backend per invocation so the slow torch-CPU side can run in the
+background while jax variants run on the TPU:
+
+  python tools/quality_ab.py --backend torch --out ab.jsonl
+  python tools/quality_ab.py --backend jax --variant parity_f32 --out ab.jsonl
+  python tools/quality_ab.py --backend jax --variant masked_tanh_f32 --out ab.jsonl
+  python tools/quality_ab.py --backend jax --variant masked_tanh_bf16 --out ab.jsonl
+
+The committed artifact lives at docs/artifacts/quality_ab_darcy64.jsonl;
+the summary table is in docs/performance.md. tests/test_quality_gate.py
+::test_full_scale_quality_ab re-runs this end to end when RUN_SLOW_AB=1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+VARIANTS = {
+    # attention_mode, gelu, dtype
+    "parity_f32": ("parity", "erf", "float32"),
+    "masked_erf_f32": ("masked", "erf", "float32"),
+    "masked_tanh_f32": ("masked", "tanh", "float32"),
+    "masked_tanh_bf16": ("masked", "tanh", "bfloat16"),
+}
+
+
+def build_setup(args):
+    from gnot_tpu.config import ModelConfig, OptimConfig
+    from gnot_tpu.data import datasets
+    from gnot_tpu.data.batch import Loader, collate
+    from gnot_tpu.train.schedule import make_lr_fn
+
+    train = datasets.synth_darcy2d(args.n_train, seed=11, grid_n=args.grid_n)
+    test = datasets.synth_darcy2d(args.n_test, seed=12, grid_n=args.grid_n)
+    dims = datasets.infer_model_dims(train)
+
+    rng = np.random.default_rng(7)
+    epoch_batches = []
+    for _ in range(args.epochs):
+        order = rng.permutation(len(train))
+        epoch_batches.append(
+            [
+                collate([train[i] for i in order[s : s + args.batch]], bucket=False)
+                for s in range(0, len(train), args.batch)
+            ]
+        )
+    test_batches = list(Loader(test, args.batch, bucket=False, prefetch=0))
+    optim = OptimConfig()
+    lr_fn = make_lr_fn(
+        optim, steps_per_epoch=len(epoch_batches[0]), epochs=args.epochs
+    )
+    return dims, epoch_batches, test_batches, optim, lr_fn
+
+
+def log_line(out, **kw):
+    with open(out, "a") as f:
+        f.write(json.dumps(kw) + "\n")
+    print(json.dumps(kw), flush=True)
+
+
+def run_torch(args):
+    import torch
+
+    from gnot_tpu.config import ModelConfig
+    from gnot_tpu.interop.torch_oracle import build_reference_model, torch_rel_l2
+
+    torch.set_num_threads(os.cpu_count() or 1)
+    dims, epoch_batches, test_batches, optim, lr_fn = build_setup(args)
+    mc = ModelConfig(**dims, attention_mode="parity")
+
+    def tt(b):
+        return (
+            torch.from_numpy(b.coords),
+            torch.from_numpy(b.theta),
+            [torch.from_numpy(f) for f in b.funcs],
+        )
+
+    torch.manual_seed(0)
+    model = build_reference_model(mc)
+    opt = torch.optim.AdamW(model.parameters(), lr=optim.lr)
+    for epoch in range(args.epochs):
+        lr = lr_fn(0, epoch)
+        for g in opt.param_groups:
+            g["lr"] = lr
+        losses = []
+        for b in epoch_batches[epoch]:
+            loss = torch_rel_l2(
+                model(*tt(b)), torch.from_numpy(b.y), torch.from_numpy(b.node_mask)
+            )
+            opt.zero_grad()
+            loss.backward()
+            opt.step()
+            losses.append(float(loss))
+        with torch.no_grad():
+            metric = float(
+                np.mean(
+                    [
+                        float(
+                            torch_rel_l2(
+                                model(*tt(b)),
+                                torch.from_numpy(b.y),
+                                torch.from_numpy(b.node_mask),
+                            )
+                        )
+                        for b in test_batches
+                    ]
+                )
+            )
+        log_line(
+            args.out,
+            backend="torch",
+            variant="parity_f32",
+            epoch=epoch,
+            train_loss=float(np.mean(losses)),
+            test_metric=metric,
+        )
+
+
+def run_jax(args):
+    import jax
+    import jax.numpy as jnp
+    import torch
+
+    from gnot_tpu.config import ModelConfig
+    from gnot_tpu.interop.torch_oracle import build_reference_model, state_dict_to_flax
+    from gnot_tpu.models.gnot import GNOT
+    from gnot_tpu.train.trainer import (
+        TrainState,
+        make_eval_step,
+        make_multi_eval_step,
+        make_multi_train_step,
+        make_optimizer,
+        stack_batches,
+    )
+
+    mode, gelu, dtype = VARIANTS[args.variant]
+    dims, epoch_batches, test_batches, optim, lr_fn = build_setup(args)
+    mc = ModelConfig(**dims, attention_mode=mode, gelu=gelu, dtype=dtype)
+
+    # Same init as the torch run: the reference model's own initializer.
+    torch.manual_seed(0)
+    init_mc = ModelConfig(**dims, attention_mode="parity")
+    params = jax.tree.map(
+        jnp.asarray,
+        state_dict_to_flax(build_reference_model(init_mc).state_dict(), init_mc),
+    )
+    model = GNOT(mc)
+    tx = make_optimizer(optim, optim.lr)
+    state = TrainState(
+        params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32)
+    )
+    # One dispatch per epoch (all batches share a shape on the regular
+    # grid) — the tunnel-latency lever; numerically identical to
+    # per-step dispatch (tests pin it).
+    multi_step = make_multi_train_step(model, optim, "rel_l2")
+    multi_eval = make_multi_eval_step(model, "rel_l2")
+    stacked_test = stack_batches(test_batches)
+
+    for epoch in range(args.epochs):
+        lrs = jnp.full((len(epoch_batches[epoch]),), lr_fn(0, epoch), jnp.float32)
+        state, losses = multi_step(state, stack_batches(epoch_batches[epoch]), lrs)
+        metric = float(np.mean(np.asarray(multi_eval(state.params, stacked_test))))
+        log_line(
+            args.out,
+            backend="jax",
+            variant=args.variant,
+            epoch=epoch,
+            train_loss=float(np.mean(np.asarray(losses))),
+            test_metric=metric,
+        )
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--backend", choices=["torch", "jax"], required=True)
+    p.add_argument("--variant", choices=sorted(VARIANTS), default="parity_f32")
+    p.add_argument("--grid_n", type=int, default=64)
+    p.add_argument("--n_train", type=int, default=32)
+    p.add_argument("--n_test", type=int, default=16)
+    p.add_argument("--epochs", type=int, default=24)
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--out", type=str, required=True)
+    args = p.parse_args()
+    if args.backend == "torch":
+        run_torch(args)
+    else:
+        run_jax(args)
+
+
+if __name__ == "__main__":
+    main()
