@@ -270,7 +270,7 @@ def main():
              "CPU (jax) or the reference PyTorch eager step (torch)"
     )
     p.add_argument("--dtype", type=str, default="bfloat16", choices=["float32", "bfloat16"])
-    p.add_argument("--attention_impl", type=str, default="xla", choices=["xla", "pallas"])
+    p.add_argument("--attention_impl", type=str, default="xla", choices=["xla"])
     p.add_argument("--ffn_impl", type=str, default="xla", choices=["xla", "pallas"])
     p.add_argument("--n_points", type=int, default=1024)
     p.add_argument("--batch_size", type=int, default=4)

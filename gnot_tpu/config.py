@@ -32,20 +32,16 @@ class ModelConfig:
     # "parity": unmasked padding, pollution-faithful to the reference.
     # "masked": correct masking; results independent of pad lengths.
     attention_mode: str = "masked"
-    # "xla": attention as fused einsums (GSPMD-shardable, the mesh path).
-    # "pallas": fused single-pass VMEM kernel (ops/pallas_attention.py);
-    # on a mesh it dispatches through shard_map (model must carry the mesh).
+    # "xla" is the only attention impl: the hand-written pallas kernel
+    # lost the honest A/B at every scale (2.4x at L=1k, 1.6x at L=16k —
+    # docs/performance.md "Why the fused attention kernel lost") and its
+    # model-level dispatch was retired in round 4. The kernels survive
+    # in ops/pallas_attention.py as validated kernel research.
     attention_impl: str = "xla"
     # "xla": batched-GEMM expert FFN (GSPMD-shardable). "pallas": whole
     # expert stack tile-resident in VMEM (ops/pallas_ffn.py);
     # single-device / DP only.
     ffn_impl: str = "xla"
-    # Collective schedule combining the sequence-parallel attention
-    # partials on the pallas shard_map path: "psum" (one fused
-    # all-reduce — optimal for the fixed-size Gram payload, the
-    # default) or "ring" (S-1 ppermute hops; ops/collectives.py).
-    # The xla impl's SP collectives are scheduled by XLA — unaffected.
-    sp_collective: str = "psum"
     # GELU flavor for every MLP: "erf" (torch nn.GELU default — the
     # reference's op, reference model.py:8) or "tanh" (the standard
     # tanh approximation). "" auto-resolves to "erf" in parity mode
@@ -88,12 +84,18 @@ class ModelConfig:
                 "requires gelu='erf' (torch nn.GELU); tanh-GELU is the "
                 "masked-mode TPU default"
             )
-        if self.attention_impl not in ("xla", "pallas"):
+        if self.attention_impl == "pallas":
+            raise ValueError(
+                "attention_impl='pallas' was retired in round 4: the "
+                "fused kernel measured slower than the XLA einsum path "
+                "at every scale under honest timing (docs/performance.md"
+                " 'Why the fused attention kernel lost'). The kernels "
+                "remain in ops/pallas_attention.py for research use."
+            )
+        if self.attention_impl != "xla":
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
         if self.ffn_impl not in ("xla", "pallas"):
             raise ValueError(f"unknown ffn_impl {self.ffn_impl!r}")
-        if self.sp_collective not in ("psum", "ring"):
-            raise ValueError(f"unknown sp_collective {self.sp_collective!r}")
         if self.scan_layers and (
             self.attention_impl != "xla" or self.ffn_impl != "xla"
         ):

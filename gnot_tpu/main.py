@@ -84,20 +84,14 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument(
         "--attention_impl", type=str, default="xla", choices=["xla", "pallas"],
-        help="pallas: experimental fused VMEM attention kernel — measured "
-             "SLOWER than the default xla path at every scale (honest "
-             "round-4 timing: 2.4x at L=1k, 1.6x at L=16k; see "
-             "docs/performance.md); kept for kernel research"
+        help="xla is the only supported impl; the pallas kernel lost the "
+             "honest A/B at every scale (2.4x at L=1k, 1.6x at L=16k) and "
+             "its model dispatch was retired in round 4 — passing pallas "
+             "raises with the dead-end analysis pointer"
     )
     p.add_argument(
         "--ffn_impl", type=str, default="xla", choices=["xla", "pallas"],
         help="pallas: VMEM-resident fused expert FFN (single-device / DP)"
-    )
-    p.add_argument(
-        "--sp_collective", type=str, default="psum", choices=["psum", "ring"],
-        help="sequence-parallel combine schedule on the pallas attention "
-             "mesh path: one fused psum (default) or a ring of ppermute "
-             "hops (ops/collectives.py)"
     )
     p.add_argument("--dtype", type=str, default="float32", choices=["float32", "bfloat16"])
     p.add_argument(
@@ -241,7 +235,6 @@ def model_config(cfg: Config, args: argparse.Namespace, train_samples) -> ModelC
         gelu=args.gelu,
         attention_impl=args.attention_impl,
         ffn_impl=args.ffn_impl,
-        sp_collective=args.sp_collective,
         dtype=args.dtype,
         remat=args.remat,
         scan_layers=args.scan_layers,

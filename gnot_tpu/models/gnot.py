@@ -46,11 +46,8 @@ class HNABlock(nn.Module):
     n_input_functions: int = 0
     dtype: Any = None
     parity: bool = False
-    attention_impl: str = "xla"
     ffn_impl: str = "xla"
     gelu: str = "erf"
-    mesh: Any = None
-    sp_collective: str = "psum"
 
     @nn.compact
     def __call__(
@@ -68,9 +65,6 @@ class HNABlock(nn.Module):
             self.n_input_functions,
             dtype=self.dtype,
             parity=self.parity,
-            attention_impl=self.attention_impl,
-            mesh=self.mesh,
-            sp_collective=self.sp_collective,
             name="cross_attention",
         )(query, input_functions, query_mask=node_mask, func_mask=func_mask)
         ffn1 = GatedExpertFfn(
@@ -91,9 +85,6 @@ class HNABlock(nn.Module):
             0,
             dtype=self.dtype,
             parity=self.parity,
-            attention_impl=self.attention_impl,
-            mesh=self.mesh,
-            sp_collective=self.sp_collective,
             name="self_attention",
         )(query, query_mask=node_mask)
         ffn2 = GatedExpertFfn(
@@ -198,7 +189,6 @@ def block_module(
     cfg: ModelConfig,
     has_funcs: bool,
     *,
-    mesh: Any = None,
     name: str | None = None,
     remat: bool = False,
 ) -> HNABlock:
@@ -213,11 +203,8 @@ def block_module(
         cfg.n_input_functions if has_funcs else 0,
         dtype=model_dtype(cfg),
         parity=cfg.attention_mode == "parity",
-        attention_impl=cfg.attention_impl,
         ffn_impl=cfg.ffn_impl,
         gelu=cfg.gelu,
-        mesh=mesh,
-        sp_collective=cfg.sp_collective,
         name=name,
     )
 
@@ -239,16 +226,9 @@ def finalize_output(out: Array) -> Array:
 
 
 class GNOT(nn.Module):
-    """Full GNOT model (reference model.py:142-172).
-
-    ``mesh``: optional device mesh for attention_impl='pallas' on
-    multi-device runs — attention dispatches through shard_map (DP/SP/TP;
-    see ops/pallas_attention.fused_nla_sp). Requires batch % data,
-    sequence lengths % seq, and n_head % model divisibility.
-    """
+    """Full GNOT model (reference model.py:142-172)."""
 
     config: ModelConfig
-    mesh: Any = None
 
     @nn.compact
     def __call__(
@@ -295,7 +275,6 @@ class GNOT(nn.Module):
             query = block_module(
                 cfg,
                 funcs is not None,
-                mesh=self.mesh,
                 name=f"block_{i}",
                 remat=cfg.remat,
             )(scores, query, funcs, node_mask=node_mask, func_mask=func_mask)

@@ -22,7 +22,6 @@ from gnot_tpu.ops.attention import (
     normalized_linear_attention,
     split_heads,
 )
-from gnot_tpu.ops.pallas_attention import fused_nla, fused_nla_sp
 from gnot_tpu.ops.pallas_ffn import fits_vmem, fused_gated_ffn
 
 Array = jax.Array
@@ -110,26 +109,6 @@ def _stacked_dense(features: int, fan_in: int, *, name: str, dtype=None):
     )
 
 
-def _dispatch_fused_nla(q, k, v, mask, n_head, mesh, sp_collective="psum"):
-    """Route to the single-device kernel or the shard_map'd distributed
-    form, mapping the standard mesh axis names (parallel/mesh.py AXES)."""
-    if mesh is None:
-        return fused_nla(q, k, v, mask, n_head)
-    axes = mesh.axis_names
-    return fused_nla_sp(
-        q,
-        k,
-        v,
-        mask,
-        n_head,
-        mesh,
-        data_axis="data" if "data" in axes else None,
-        seq_axis="seq" if "seq" in axes else None,
-        model_axis="model" if "model" in axes else None,
-        sp_collective=sp_collective,
-    )
-
-
 class LinearAttention(nn.Module):
     """Heterogeneous normalized linear attention (model.py:33-107).
 
@@ -156,15 +135,6 @@ class LinearAttention(nn.Module):
     # pad-invariance in masked mode, since the interleaved merge leaks
     # padded-row garbage into real rows).
     parity: bool = False
-    # "xla": einsum formulation; "pallas": fused VMEM kernel
-    # (ops/pallas_attention.py). Numerically equivalent.
-    attention_impl: str = "xla"
-    # Device mesh for the pallas impl on multi-device runs: attention is
-    # dispatched through shard_map (DP over "data", SP psum over "seq",
-    # head-group TP over "model"). None = single-device pallas_call.
-    mesh: Any = None
-    # SP combine schedule on the pallas mesh path: "psum" | "ring".
-    sp_collective: str = "psum"
 
     def _merge(self, x: Array) -> Array:
         if self.parity:
@@ -182,12 +152,6 @@ class LinearAttention(nn.Module):
         func_mask: Array | None = None,
     ) -> Array:
         e, h = self.n_embed, self.n_head
-        use_pallas = self.attention_impl == "pallas"
-        if use_pallas and self.parity:
-            # Parity mode replicates the reference's interleaved head
-            # merge (see above); the fused kernel produces the correct
-            # merge, so parity runs stay on the XLA path.
-            raise ValueError("attention_impl='pallas' is incompatible with parity mode")
         q_proj = torch_dense(e, query.shape[-1], name="query", dtype=self.dtype)(query)
 
         if self.n_input_functions > 0:
@@ -203,24 +167,14 @@ class LinearAttention(nn.Module):
             v_proj = _stacked_dense(e, fan_in, name="value", dtype=self.dtype)(
                 input_functions
             )
-            if use_pallas:
-                mask = func_mask
-                if mask is None:
-                    mask = jnp.ones(k_proj.shape[:3], k_proj.dtype)
-                out_f, res_q = _dispatch_fused_nla(
-                    q_proj, k_proj, v_proj, mask, h, self.mesh,
-                    self.sp_collective,
-                )
-                res = res_q + jnp.mean(out_f, axis=0)
-            else:
-                q = feature_softmax(split_heads(q_proj, h))
-                k = feature_softmax(jax.vmap(lambda t: split_heads(t, h))(k_proj))
-                v = jax.vmap(lambda t: split_heads(t, h))(v_proj)
-                mask_axis = None if func_mask is None else 0
-                out = jax.vmap(_nla_positional, in_axes=(None, 0, 0, mask_axis))(
-                    q, k, v, func_mask
-                )  # [F, B, H, Lq, D]
-                res = self._merge(q) + self._merge(jnp.mean(out, axis=0))
+            q = feature_softmax(split_heads(q_proj, h))
+            k = feature_softmax(jax.vmap(lambda t: split_heads(t, h))(k_proj))
+            v = jax.vmap(lambda t: split_heads(t, h))(v_proj)
+            mask_axis = None if func_mask is None else 0
+            out = jax.vmap(_nla_positional, in_axes=(None, 0, 0, mask_axis))(
+                q, k, v, func_mask
+            )  # [F, B, H, Lq, D]
+            res = self._merge(q) + self._merge(jnp.mean(out, axis=0))
         else:
             k_proj = torch_dense(e, query.shape[-1], name="key", dtype=self.dtype)(
                 query
@@ -228,21 +182,11 @@ class LinearAttention(nn.Module):
             v_proj = torch_dense(e, query.shape[-1], name="value", dtype=self.dtype)(
                 query
             )
-            if use_pallas:
-                mask = query_mask
-                if mask is None:
-                    mask = jnp.ones(k_proj.shape[:2], k_proj.dtype)
-                out_f, res_q = _dispatch_fused_nla(
-                    q_proj, k_proj[None], v_proj[None], mask[None], h,
-                    self.mesh, self.sp_collective,
-                )
-                res = res_q + out_f[0]
-            else:
-                q = feature_softmax(split_heads(q_proj, h))
-                k = feature_softmax(split_heads(k_proj, h))
-                v = split_heads(v_proj, h)
-                out = normalized_linear_attention(q, k, v, kv_mask=query_mask)
-                res = self._merge(q) + self._merge(out)
+            q = feature_softmax(split_heads(q_proj, h))
+            k = feature_softmax(split_heads(k_proj, h))
+            v = split_heads(v_proj, h)
+            out = normalized_linear_attention(q, k, v, kv_mask=query_mask)
+            res = self._merge(q) + self._merge(out)
 
         return torch_dense(e, e, name="fc_out", dtype=self.dtype)(res)
 

@@ -56,10 +56,11 @@ def make_mesh(cfg: MeshConfig, devices=None) -> Mesh:
             f"mesh {data}x{seq}x{model}x{expert}x{pipe} "
             f"(data x seq x model x expert x pipe) does not cover {n} devices"
         )
-    if pipe > 1 and (seq > 1 or model > 1 or expert > 1):
+    if pipe > 1 and (seq > 1 or expert > 1):
         raise ValueError(
-            "pipe > 1 composes with the data axis only (the pipeline is "
-            "a shard_map schedule, not a GSPMD axis); set seq=model=expert=1"
+            "pipe > 1 composes with the data and model axes only (the "
+            "pipeline is a partially-manual shard_map: data/pipe are "
+            "mapped, model stays a GSPMD auto axis); set seq=expert=1"
         )
     arr = np.asarray(devices).reshape(data, seq, model, expert, pipe)
     return Mesh(arr, AXES)
@@ -211,17 +212,6 @@ def _validate_gspmd(model, mesh: Mesh) -> None:
             "ffn_impl='pallas' is single-device/DP only (no shard_map "
             "form yet); use ffn_impl='xla' on a mesh"
         )
-    if getattr(model.config, "attention_impl", "xla") == "pallas":
-        # pallas_call is not GSPMD-partitionable, but the model can run
-        # it distributed through shard_map when built with this mesh
-        # (GNOT(cfg, mesh=mesh) -> ops/pallas_attention.fused_nla_sp).
-        if getattr(model, "mesh", None) is not mesh:
-            raise ValueError(
-                "attention_impl='pallas' on a mesh requires the model to "
-                "be constructed with that mesh (GNOT(cfg, mesh=mesh)) so "
-                "attention dispatches through shard_map; or use "
-                "attention_impl='xla'"
-            )
 
 
 def make_sharded_train_step(

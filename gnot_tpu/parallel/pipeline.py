@@ -26,9 +26,13 @@ permute inside ``lax.scan``), so the same schedule serves forward and
 backward; the backward pass replays the ring in reverse.
 
 The pipeline composes with the ``data`` axis (each data shard runs its
-own pipeline over the same stage devices) and requires
-``seq == model == expert == 1``, ``attention_impl == ffn_impl == 'xla'``,
-and ``n_attn_layers % pipe == 0``.
+own pipeline over the same stage devices) and with the ``model`` axis:
+the shard_map maps ``data``/``pipe`` manually while ``model`` stays an
+XLA GSPMD *auto* axis, so tensor parallelism inside a stage is the
+ordinary sharding-annotation kind (state_shardings puts heads / FFN
+hidden over ``model``; GSPMD inserts the psums). Requires
+``seq == expert == 1``, ``ffn_impl == 'xla'``, and
+``n_attn_layers % pipe == 0``.
 
 Parameter layout: pipeline states store the block stack under
 ``params["blocks"]`` (leading layer axis, pipe-sharded) instead of the
@@ -266,11 +270,17 @@ def _pipe_blocks(
         None if node_mask is None else P("data", None),
         None if func_mask is None else P(None, "data", None),
     ]
+    # Partially-manual shard_map: data/pipe are MAPPED (the schedule is
+    # explicit), every other mesh axis stays an XLA GSPMD "auto" axis —
+    # in particular ``model``, so tensor parallelism inside a stage is
+    # the ordinary sharding-annotation kind (state_shardings puts heads
+    # / FFN hidden over model and GSPMD inserts the psums).
     mapped = jax.shard_map(
         local_fn,
         mesh=mesh,
         in_specs=tuple(in_specs),
         out_specs=P("data", None, None),
+        axis_names={"data", "pipe"},
         check_vma=False,
     )
     return mapped(stacked, scores, query, funcs, node_mask, func_mask)
@@ -351,8 +361,10 @@ def _validate(cfg: ModelConfig, mesh: Mesh):
             f"n_attn_layers={cfg.n_attn_layers} must be divisible by the "
             f"mesh pipe axis ({s})"
         )
-    if any(mesh.shape[a] > 1 for a in ("seq", "model", "expert")):
-        raise ValueError("pipe > 1 requires seq == model == expert == 1")
+    if any(mesh.shape[a] > 1 for a in ("seq", "expert")):
+        raise ValueError(
+            "pipe > 1 composes with data and model only; seq == expert == 1"
+        )
 
 
 def validate_local_batch(
@@ -384,13 +396,22 @@ def resolve_microbatches(mesh: Mesh, microbatches: int) -> int:
 def state_shardings(mesh: Mesh, state) -> Any:
     """Pipeline-layout state: the ``blocks`` stack (and its optimizer
     moments, whose paths mirror the params) shards its layer axis over
-    ``pipe``; everything else replicates."""
+    ``pipe`` and its inner block axes by the standard TP rules (heads /
+    FFN hidden over ``model`` — mesh._param_pspec_at, the ONE copy of
+    those rules); everything outside the stack takes the plain GSPMD
+    rules (mesh._param_pspec), so embeds/head TP compose too."""
+    from gnot_tpu.parallel.mesh import _param_pspec, _param_pspec_at, _path_str
 
     def rule(path, leaf):
-        keys = [str(getattr(k, "key", getattr(k, "name", k))) for k in path]
-        if np.ndim(leaf) > 0 and "blocks" in keys:
-            return NamedSharding(mesh, P(*(["pipe"] + [None] * (np.ndim(leaf) - 1))))
-        return NamedSharding(mesh, P())
+        if np.ndim(leaf) == 0:
+            return NamedSharding(mesh, P())
+        p = _path_str(path)
+        keys = p.split("/")
+        if "blocks" in keys:
+            sub = p[p.index("blocks/") + len("blocks/"):] if "blocks/" in p else ""
+            inner = _param_pspec_at(sub, np.ndim(leaf) - 1)
+            return NamedSharding(mesh, P(*(("pipe",) + tuple(inner))))
+        return NamedSharding(mesh, P(*_param_pspec(p, leaf)))
 
     return jax.tree_util.tree_map_with_path(rule, state)
 

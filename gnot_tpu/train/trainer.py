@@ -321,10 +321,7 @@ class Trainer:
                 test_samples = list(test_samples) + [test_samples[-1]] * (
                     config.data.batch_size - tail
                 )
-        pallas_mesh = (
-            self.mesh if model_cfg.attention_impl == "pallas" else None
-        )
-        self.model = GNOT(model_cfg, mesh=pallas_mesh)
+        self.model = GNOT(model_cfg)
         self.train_loader = Loader(
             train_samples,
             config.data.batch_size,
@@ -621,13 +618,6 @@ class Trainer:
         multiproc = jax.process_count() > 1
         if self.state is None:
             self.initialize()
-        if multiproc:
-            if self.model.mesh is not None:
-                raise NotImplementedError(
-                    "multi-process predict() with the pallas attention "
-                    "impl (mesh-carrying model) is unsupported; use the "
-                    "default xla impl"
-                )
         if self._forward is None:
             model = self.model
             if "blocks" in self.state.params:

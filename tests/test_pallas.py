@@ -4,7 +4,6 @@ Runs in pallas interpreter mode on the CPU test platform (the kernel
 auto-selects interpret off-TPU); the same code path compiles on TPU.
 """
 
-import dataclasses
 
 import jax
 import jax.numpy as jnp
@@ -12,9 +11,6 @@ import numpy as np
 import pytest
 
 from gnot_tpu.config import ModelConfig
-from gnot_tpu.data import datasets
-from gnot_tpu.data.batch import Loader
-from gnot_tpu.models.gnot import GNOT
 from gnot_tpu.ops.pallas_attention import _reference_impl, fused_nla
 
 
@@ -125,43 +121,27 @@ def test_reference_impl_matches_xla_ops():
     )
 
 
-def test_model_forward_pallas_matches_xla():
-    """Full GNOT forward: pallas attention == xla attention."""
-    mc = ModelConfig(
-        input_dim=2,
-        theta_dim=2,
-        input_func_dim=3,
-        out_dim=2,
-        n_input_functions=1,
-        n_attn_layers=2,
-        n_attn_hidden_dim=32,
-        n_mlp_num_layers=2,
-        n_mlp_hidden_dim=32,
-        n_input_hidden_dim=32,
-        n_expert=2,
-        n_head=4,
-    )
-    samples = datasets.synth_elasticity(4, base_points=40)  # ragged -> real masks
-    batch = next(iter(Loader(samples, 4)))
-
-    model_xla = GNOT(mc)
-    params = model_xla.init(
-        jax.random.key(0),
-        batch.coords,
-        batch.theta,
-        batch.funcs,
-        node_mask=batch.node_mask,
-        func_mask=batch.func_mask,
-    )["params"]
-    model_pallas = GNOT(dataclasses.replace(mc, attention_impl="pallas"))
-
-    args = (batch.coords, batch.theta, batch.funcs)
-    kw = dict(node_mask=batch.node_mask, func_mask=batch.func_mask)
-    out_xla = model_xla.apply({"params": params}, *args, **kw)
-    out_pallas = model_pallas.apply({"params": params}, *args, **kw)
-    np.testing.assert_allclose(
-        np.asarray(out_pallas), np.asarray(out_xla), rtol=1e-4, atol=1e-5
-    )
+def test_model_attention_impl_pallas_retired():
+    """The model-level pallas attention dispatch was retired in round 4
+    (lost the honest A/B at every scale); the config rejects it with a
+    pointer to the dead-end analysis. The kernels in
+    ops/pallas_attention.py remain tested above."""
+    with pytest.raises(ValueError, match="retired"):
+        ModelConfig(
+            input_dim=2,
+            theta_dim=1,
+            input_func_dim=3,
+            out_dim=1,
+            n_input_functions=1,
+            n_attn_layers=1,
+            n_attn_hidden_dim=16,
+            n_mlp_num_layers=1,
+            n_mlp_hidden_dim=16,
+            n_input_hidden_dim=16,
+            n_expert=2,
+            n_head=2,
+            attention_impl="pallas",
+        )
 
 
 def test_fused_nla_sp_matches_single_device():
@@ -259,126 +239,24 @@ def test_ring_allreduce_matches_psum_generic():
     np.testing.assert_allclose(np.asarray(ring), np.asarray(ps), rtol=1e-6, atol=1e-6)
 
 
-def test_pallas_rejects_parity():
-    mc = ModelConfig(
-        input_dim=2,
-        theta_dim=1,
-        input_func_dim=3,
-        out_dim=1,
-        n_input_functions=1,
-        n_attn_layers=1,
-        n_attn_hidden_dim=16,
-        n_mlp_num_layers=1,
-        n_mlp_hidden_dim=16,
-        n_input_hidden_dim=16,
-        n_expert=2,
-        n_head=2,
-        attention_mode="parity",
-        attention_impl="pallas",
-    )
-    samples = datasets.synth_ns2d(2, n_points=16)
-    batch = next(iter(Loader(samples, 2, bucket=False)))
-    model = GNOT(mc)
-    with pytest.raises(ValueError, match="parity"):
-        model.init(
-            jax.random.key(0), batch.coords, batch.theta, batch.funcs
-        )
-
-
-SMALL_PALLAS = ModelConfig(
-    input_dim=2,
-    theta_dim=1,
-    input_func_dim=3,
-    out_dim=1,
-    n_input_functions=1,
-    n_attn_layers=2,
-    n_attn_hidden_dim=32,
-    n_mlp_num_layers=2,
-    n_mlp_hidden_dim=32,
-    n_input_hidden_dim=32,
-    n_expert=3,
-    n_head=4,
-    attention_impl="pallas",
-)
-
-
-def test_sharded_train_step_with_pallas_matches_single_device():
-    """Full sharded train step on a DP x SP x TP mesh with the pallas
-    attention dispatched through shard_map == single-device xla step."""
-    from gnot_tpu.config import MeshConfig, OptimConfig
-    from gnot_tpu.parallel import mesh as mesh_lib
-    from gnot_tpu.train.trainer import init_state, make_train_step
-
-    if len(jax.devices()) < 8:
-        pytest.skip("needs 8 (virtual) devices")
-    optim = OptimConfig()
-    samples = datasets.synth_ns2d(8, n_points=64)
-    batch = next(iter(Loader(samples, 8)))
-
-    ref_model = GNOT(dataclasses.replace(SMALL_PALLAS, attention_impl="xla"))
-    state = init_state(ref_model, optim, batch, seed=0)
-    single = make_train_step(ref_model, optim, "rel_l2")
-    state1, loss1 = single(
-        jax.tree.map(jnp.copy, state), batch, jnp.asarray(1e-3, jnp.float32)
-    )
-
-    mesh = mesh_lib.make_mesh(MeshConfig(data=2, seq=2, model=2))
-    model = GNOT(SMALL_PALLAS, mesh=mesh)
-    sharded_state = mesh_lib.shard_state(mesh, state)
-    step = mesh_lib.make_sharded_train_step(model, optim, "rel_l2", mesh, sharded_state)
-    sharded_batch = mesh_lib.shard_batch(mesh, batch)
-    state2, loss2 = step(sharded_state, sharded_batch, jnp.asarray(1e-3, jnp.float32))
-
-    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
-    for a, b in zip(jax.tree.leaves(state1.params), jax.tree.leaves(state2.params)):
-        np.testing.assert_allclose(
-            np.asarray(a), np.asarray(jax.device_get(b)), rtol=2e-4, atol=2e-5
-        )
-
-
-def test_sharded_step_pallas_requires_mesh_on_model():
-    from gnot_tpu.config import MeshConfig, OptimConfig
-    from gnot_tpu.parallel import mesh as mesh_lib
-    from gnot_tpu.train.trainer import init_state
-
-    if len(jax.devices()) < 2:
-        pytest.skip("needs multiple devices")
-    samples = datasets.synth_ns2d(2, n_points=16)
-    batch = next(iter(Loader(samples, 2)))
-    model = GNOT(SMALL_PALLAS)  # no mesh attached
-    state = init_state(model, OptimConfig(), batch, seed=0)
-    mesh = mesh_lib.make_mesh(MeshConfig(data=2, seq=1, model=1), jax.devices()[:2])
-    with pytest.raises(ValueError, match="mesh"):
-        mesh_lib.make_sharded_train_step(model, OptimConfig(), "rel_l2", mesh, state)
-
-
 def test_pallas_empty_input_function_is_finite():
-    """Pallas twin of test_model.py::test_empty_input_function_is_finite:
+    """Op-level twin of test_model.py::test_empty_input_function_is_finite:
     an all-masked function slab reaches nla_apply with ksum == 0; the
-    kernel's denominator guard must yield 0, not nan."""
-    import dataclasses as _dc
+    kernel's denominator guard must yield 0, not nan — forward and
+    backward."""
+    rng = np.random.default_rng(5)
+    b, l, e, h, f = 2, 16, 32, 4, 2
+    q = rng.normal(size=(b, l, e)).astype(np.float32)
+    k = rng.normal(size=(f, b, l, e)).astype(np.float32)
+    v = rng.normal(size=(f, b, l, e)).astype(np.float32)
+    mask = np.ones((f, b, l), np.float32)
+    mask[1, 0, :] = 0.0  # sample 0's second input function is empty
 
-    mc = SMALL_PALLAS
-    samples = datasets.synth_ns2d(2, n_points=16)
-    batch = next(iter(Loader(samples, 2, bucket=False)))
-    func_mask = np.array(batch.func_mask)
-    func_mask[0, 0, :] = 0.0  # sample 0's only input function is empty
+    def loss(q, k, v):
+        out, qs = fused_nla(q, k, v, mask, h)
+        return jnp.mean(out**2) + jnp.mean(qs**2)
 
-    model = GNOT(mc)
-    params = model.init(
-        jax.random.key(0), batch.coords, batch.theta, batch.funcs,
-        node_mask=batch.node_mask, func_mask=func_mask,
-    )["params"]
-
-    def loss(p):
-        y = model.apply(
-            {"params": p}, batch.coords, batch.theta, batch.funcs,
-            node_mask=batch.node_mask, func_mask=func_mask,
-        )
-        return jnp.mean(y * y)
-
-    val, g = jax.value_and_grad(loss)(params)
+    val, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
     assert np.isfinite(float(val))
-    assert all(
-        np.isfinite(np.asarray(x)).all() for x in jax.tree_util.tree_leaves(g)
-    )
+    for g in grads:
+        assert np.isfinite(np.asarray(g)).all()

@@ -76,6 +76,10 @@ def assert_params_match(single_params, pipe_params, n_layers, **tol):
         (MeshConfig(data=2, pipe=2), 2, 0),  # 1 block/stage, M = S
         (MeshConfig(data=1, pipe=2), 4, 4),  # 2 blocks/stage, M > S
         (MeshConfig(data=4, pipe=2), 2, 2),  # composed with DP
+        # composed with DP AND TP: pipe is the manual shard_map axis,
+        # model stays a GSPMD auto axis inside the stages
+        (MeshConfig(data=2, model=2, pipe=2), 2, 0),
+        (MeshConfig(data=1, model=2, pipe=2), 4, 4),
     ],
 )
 def test_pipelined_step_matches_single_device(mesh_cfg, n_layers, micro):
@@ -90,7 +94,7 @@ def test_pipelined_step_matches_single_device(mesh_cfg, n_layers, micro):
     single = make_train_step(model, optim, "rel_l2")
     s1, loss1 = single(state, batch, lr)
 
-    n_dev = mesh_cfg.data * mesh_cfg.pipe
+    n_dev = mesh_cfg.data * mesh_cfg.model * mesh_cfg.pipe
     mesh = mesh_lib.make_mesh(mesh_cfg, jax.devices()[:n_dev])
     sp = pipeline.init_pipeline_state(model, optim, batch, 0, mesh)
     sp = restack_into(sp, host_params, mesh, n_layers)
@@ -181,8 +185,8 @@ def test_pipeline_validation():
     # negative microbatches is a typo, not "auto"
     with pytest.raises(ValueError, match="microbatches"):
         pipeline.resolve_microbatches(mesh, -2)
-    # pipe composes with data only
-    with pytest.raises(ValueError, match="data axis only"):
+    # pipe composes with data and model only
+    with pytest.raises(ValueError, match="data and model"):
         mesh_lib.make_mesh(MeshConfig(data=1, seq=2, pipe=2), jax.devices()[:4])
     # standard-layout state rejected
     std = init_state(sp_model, optim, batch, seed=0)
