@@ -10,7 +10,7 @@ from gnot_tpu.config import Config, DataConfig, MeshConfig, ModelConfig, OptimCo
 from gnot_tpu.data.batch import Loader, MeshBatch, MeshSample, collate
 from gnot_tpu.models.gnot import GNOT
 
-__version__ = "0.3.0"
+__version__ = "0.4.0"
 
 __all__ = [
     "Config",

@@ -154,3 +154,96 @@ def test_quality_gate(config):
     assert gap < 0.01, f"quality gate: torch best {t_best}, jax best {j_best}, gap {gap:.4f}"
     # In practice the trajectories track far tighter than the 1% gate.
     assert gap < 2e-3, f"trajectory drift unexpectedly large: {gap:.5f}"
+
+
+ARTIFACTS = os.path.join(os.path.dirname(__file__), "..", "docs", "artifacts")
+
+
+def _load_ab(name, base=None):
+    import json
+
+    path = os.path.join(base or ARTIFACTS, name)
+    if not os.path.exists(path):
+        pytest.skip(f"artifact {name} not present")
+    by = {}
+    for line in open(path):
+        r = json.loads(line)
+        by.setdefault((r["backend"], r["variant"]), {})[r["epoch"]] = r["test_metric"]
+    return by
+
+
+def _series(by, backend, variant):
+    assert (backend, variant) in by, (
+        f"artifact incomplete: missing the ({backend}, {variant}) series "
+        f"(have {sorted(by)}); tools/quality_ab.py runs one backend per "
+        "invocation — regenerate the missing one"
+    )
+    return by[(backend, variant)]
+
+
+def test_full_scale_quality_ab_artifact():
+    """The committed full-scale A/B artifact (reference-default
+    architecture, Darcy2d 64x64, tools/quality_ab.py) keeps torch and
+    jax inside the BASELINE 1% gate — the recorded curves actually
+    track to ~0.02% epoch by epoch, TPU-vs-torch-CPU."""
+    by = _load_ab("quality_ab_darcy64.jsonl")
+    torch_curve = _series(by, "torch", "parity_f32")
+    jax_curve = _series(by, "jax", "parity_f32")
+    common = sorted(set(torch_curve) & set(jax_curve))
+    assert len(common) >= 20, f"A/B artifact too short: {len(common)} epochs"
+    for e in common:
+        gap = abs(jax_curve[e] - torch_curve[e]) / torch_curve[e]
+        assert gap < 0.01, f"epoch {e}: torch {torch_curve[e]} vs jax {jax_curve[e]}"
+    t_best = min(torch_curve[e] for e in common)
+    j_best = min(jax_curve[e] for e in common)
+    assert abs(j_best - t_best) / t_best < 0.01
+    # The TPU-native defaults (masked + tanh-GELU) must be recorded too
+    # and land in the same quality regime as the oracle.
+    for variant in ("masked_tanh_f32", "masked_tanh_bf16"):
+        v_best = min(_series(by, "jax", variant).values())
+        assert v_best <= t_best * 1.1, (variant, v_best, t_best)
+
+
+def test_bf16_quality_gate_artifact():
+    """100-epoch bf16-vs-f32 gate at the reference-default architecture
+    (licenses the bf16 headline throughput): bf16 must not DEGRADE the
+    best metric by more than 1%. The recorded run has bf16 slightly
+    BETTER (0.0631 vs 0.0698 — late-training trajectory wobble at the
+    noisy optimum swamps dtype effects), which passes trivially; the
+    gate exists to catch a real bf16 quality loss."""
+    by = _load_ab("bf16_gate_darcy64.jsonl")
+    f32 = min(_series(by, "jax", "masked_tanh_f32").values())
+    bf16 = min(_series(by, "jax", "masked_tanh_bf16").values())
+    assert bf16 <= f32 * 1.01, f"bf16 {bf16} degrades vs f32 {f32}"
+
+
+@pytest.mark.skipif(
+    not os.environ.get("RUN_SLOW_AB"),
+    reason="full-scale A/B re-run takes ~hours of torch-CPU; set RUN_SLOW_AB=1",
+)
+def test_full_scale_quality_ab_rerun(tmp_path):
+    """End-to-end re-run of the full-scale A/B (torch CPU + jax) at a
+    reduced epoch count; asserts the <=1% gap the committed artifact
+    records at 24 epochs."""
+    import argparse
+    import sys
+
+    tools_dir = os.path.join(os.path.dirname(__file__), "..", "tools")
+    sys.path.insert(0, tools_dir)
+    try:
+        import quality_ab
+
+        out = str(tmp_path / "ab.jsonl")
+        base = dict(grid_n=64, n_train=8, n_test=8, epochs=4, batch=4, out=out)
+        quality_ab.run_torch(
+            argparse.Namespace(backend="torch", variant="parity_f32", **base)
+        )
+        quality_ab.run_jax(
+            argparse.Namespace(backend="jax", variant="parity_f32", **base)
+        )
+    finally:
+        sys.path.remove(tools_dir)
+    by = _load_ab("ab.jsonl", base=str(tmp_path))
+    t_best = min(_series(by, "torch", "parity_f32").values())
+    j_best = min(_series(by, "jax", "parity_f32").values())
+    assert abs(j_best - t_best) / t_best < 0.01

@@ -19,7 +19,8 @@ background while jax variants run on the TPU:
 
 The committed artifact lives at docs/artifacts/quality_ab_darcy64.jsonl;
 the summary table is in docs/performance.md. tests/test_quality_gate.py
-::test_full_scale_quality_ab re-runs this end to end when RUN_SLOW_AB=1.
+::test_full_scale_quality_ab_rerun re-runs this end to end when
+RUN_SLOW_AB=1.
 """
 
 from __future__ import annotations
