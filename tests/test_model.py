@@ -405,3 +405,29 @@ def test_empty_input_function_is_finite():
     assert all(
         np.isfinite(np.asarray(x)).all() for x in jax.tree_util.tree_leaves(g)
     ), "all-masked input function produced non-finite gradients"
+
+
+def test_gelu_config_validation():
+    """config.gelu: auto-resolution and the parity/erf enforcement."""
+    assert ModelConfig(**SMALL).gelu == "tanh"  # masked default
+    assert ModelConfig(**SMALL, attention_mode="parity").gelu == "erf"
+    assert ModelConfig(**SMALL, gelu="erf").gelu == "erf"
+    with pytest.raises(ValueError, match="parity"):
+        ModelConfig(**SMALL, attention_mode="parity", gelu="tanh")
+    with pytest.raises(ValueError, match="unknown gelu"):
+        ModelConfig(**SMALL, gelu="relu")
+
+
+def test_gelu_tanh_vs_erf_forward_close():
+    """The tanh approximation changes activations by ~1e-3 — the two
+    flavors must stay close on the same weights (the quality gates
+    prove the training-level equivalence; this pins the op level)."""
+    mc_t = ModelConfig(**SMALL)            # tanh
+    mc_e = ModelConfig(**SMALL, gelu="erf")
+    coords, theta, funcs = make_inputs(np.random.default_rng(5))
+    model_t, model_e = GNOT(mc_t), GNOT(mc_e)
+    params = model_t.init(jax.random.key(0), coords, theta, funcs)["params"]
+    out_t = np.asarray(model_t.apply({"params": params}, coords, theta, funcs))
+    out_e = np.asarray(model_e.apply({"params": params}, coords, theta, funcs))
+    assert np.max(np.abs(out_t - out_e)) < 0.05
+    assert np.max(np.abs(out_t - out_e)) > 0  # genuinely different ops

@@ -139,7 +139,12 @@ def test_pipelined_forward_masked_ragged():
     np.testing.assert_allclose(out_pipe, out_single, rtol=2e-4, atol=2e-5)
 
 
-def test_pipeline_eval_step_matches():
+@pytest.mark.parametrize(
+    "mesh_cfg",
+    [MeshConfig(data=2, pipe=2), MeshConfig(data=2, model=2, pipe=2)],
+    ids=["dp-pipe", "dp-tp-pipe"],
+)
+def test_pipeline_eval_step_matches(mesh_cfg):
     model = GNOT(SMALL)
     optim = OptimConfig()
     batch = make_batch()
@@ -149,7 +154,8 @@ def test_pipeline_eval_step_matches():
 
     loss1 = float(batch_loss(model, state.params, batch, "rel_l2"))
 
-    mesh = mesh_lib.make_mesh(MeshConfig(data=2, pipe=2), jax.devices()[:4])
+    n_dev = mesh_cfg.data * mesh_cfg.model * mesh_cfg.pipe
+    mesh = mesh_lib.make_mesh(mesh_cfg, jax.devices()[:n_dev])
     sp = pipeline.init_pipeline_state(model, optim, batch, 0, mesh)
     sp = restack_into(sp, host_params, mesh, SMALL.n_attn_layers)
     ev = mesh_lib.make_sharded_eval_step(model, "rel_l2", mesh, sp)
