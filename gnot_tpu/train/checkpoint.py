@@ -34,9 +34,17 @@ import orbax.checkpoint as ocp
 
 
 class Checkpointer:
-    def __init__(self, directory: str):
+    def __init__(self, directory: str, extra_meta: dict | None = None):
+        """``extra_meta`` is provenance recorded in every sidecar —
+        notably the RESOLVED model numerics (gelu flavor, attention
+        mode, dtype). The masked-mode default gelu changed erf->tanh in
+        round 4, so a checkpoint's training-time flavor can differ from
+        a later config's auto-resolution; restore warns on mismatch so
+        the ~1e-3 activation shift never lands silently (pass --gelu
+        explicitly to pin it)."""
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
+        self.extra_meta = dict(extra_meta or {})
         self._ckptr = ocp.StandardCheckpointer()
         # Saves kicked off but whose meta is not yet committed:
         # (name, meta dict, committed dir basename).
@@ -102,9 +110,9 @@ class Checkpointer:
             tick += 1
             dirname = f"{name}.{epoch}r{tick}"
         self._ckptr.save(os.path.join(self.directory, dirname), state, force=True)
-        self._pending.append(
-            (name, {"epoch": epoch, "best_metric": best_metric, "dir": dirname}, dirname)
-        )
+        meta = {"epoch": epoch, "best_metric": best_metric, "dir": dirname}
+        meta.update(self.extra_meta)
+        self._pending.append((name, meta, dirname))
 
     def wait(self) -> None:
         """Block until any in-flight save has committed (and publish its
@@ -131,6 +139,20 @@ class Checkpointer:
         path = os.path.join(self.directory, meta.get("dir", name))
         if not os.path.isdir(path):
             return None
+        mismatch = {
+            k: (meta[k], v)
+            for k, v in self.extra_meta.items()
+            if k in meta and meta[k] != v
+        }
+        if mismatch and jax.process_index() == 0:
+            detail = ", ".join(
+                f"{k}: checkpoint={a!r} current={b!r}" for k, (a, b) in mismatch.items()
+            )
+            print(
+                f"warning: restoring '{name}' checkpoint trained under "
+                f"different numerics ({detail}) — pass the matching flags "
+                "(e.g. --gelu) to reproduce its training-time behavior"
+            )
         state = self._ckptr.restore(path, target)
         return state, int(meta["epoch"]), float(meta["best_metric"])
 

@@ -234,7 +234,10 @@ def test_full_scale_quality_ab_rerun(tmp_path):
         import quality_ab
 
         out = str(tmp_path / "ab.jsonl")
-        base = dict(grid_n=64, n_train=8, n_test=8, epochs=4, batch=4, out=out)
+        base = dict(
+            config="darcy2d", size=None, grid_n=64,
+            n_train=8, n_test=8, epochs=4, batch=4, out=out,
+        )
         quality_ab.run_torch(
             argparse.Namespace(backend="torch", variant="parity_f32", **base)
         )
