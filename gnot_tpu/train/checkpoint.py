@@ -144,15 +144,30 @@ class Checkpointer:
             for k, v in self.extra_meta.items()
             if k in meta and meta[k] != v
         }
-        if mismatch and jax.process_index() == 0:
-            detail = ", ".join(
-                f"{k}: checkpoint={a!r} current={b!r}" for k, (a, b) in mismatch.items()
-            )
-            print(
-                f"warning: restoring '{name}' checkpoint trained under "
-                f"different numerics ({detail}) — pass the matching flags "
-                "(e.g. --gelu) to reproduce its training-time behavior"
-            )
+        missing = [k for k in self.extra_meta if k not in meta]
+        if jax.process_index() == 0:
+            if mismatch:
+                detail = ", ".join(
+                    f"{k}: checkpoint={a!r} current={b!r}"
+                    for k, (a, b) in mismatch.items()
+                )
+                print(
+                    f"warning: restoring '{name}' checkpoint trained under "
+                    f"different numerics ({detail}) — pass the matching flags "
+                    "(e.g. --gelu) to reproduce its training-time behavior"
+                )
+            elif missing and len(missing) == len(self.extra_meta):
+                # Pre-provenance sidecar (saved before round 5): it may
+                # have been trained under the old masked-mode default
+                # (erf-GELU, pre-r4) — the exact silent-flip scenario
+                # the provenance exists to catch, so say so.
+                print(
+                    f"note: '{name}' checkpoint predates numerics "
+                    "provenance (no gelu/attention_mode/dtype in its "
+                    "sidecar); if it was trained before the tanh-GELU "
+                    "default, pass --gelu erf to restore its "
+                    "training-time activation"
+                )
         state = self._ckptr.restore(path, target)
         return state, int(meta["epoch"]), float(meta["best_metric"])
 

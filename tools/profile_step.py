@@ -1,0 +1,132 @@
+"""Per-op time breakdown of the headline training step (VERDICT r4 #2).
+
+Captures a ``jax.profiler`` device trace of the NS2d-1k bf16 jitted
+train step (the BENCH headline workload: reference-default
+architecture, B=4, L=1024) and aggregates the ``/device:TPU:0``
+"XLA Ops" timeline into a per-op table: MXU work (dot/fusion-with-dot)
+vs elementwise fusions vs copies vs everything else.  The trace is a
+one-dispatch K-step ``lax.scan`` (same program bench.py times), so the
+breakdown describes exactly the step the headline MFU comes from.
+
+Writes (committed under docs/artifacts/):
+  * ``profile_breakdown.json`` — the aggregated table + totals;
+  * the raw ``*.xplane.pb`` stays under --trace_dir for ad-hoc
+    Perfetto/XProf inspection (too big to commit).
+
+Usage:  python tools/profile_step.py [--k 20] [--dtype bfloat16]
+        [--out docs/artifacts/profile_breakdown.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def family(event_name: str) -> str:
+    """Instruction-family key: the HLO instruction name with ``%`` and
+    the uniquifying ``.N`` suffix stripped (XLA names instructions
+    after their opcode or a descriptive fused pattern, e.g.
+    ``%multiply_add_fusion.645`` -> ``multiply_add_fusion``)."""
+    base = event_name.split(" = ")[0].lstrip("%")
+    return re.sub(r"[.\d]+$", "", base)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--k", type=int, default=20, help="steps in the traced scan")
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--config", default="ns2d")
+    p.add_argument("--n_points", type=int, default=1024)
+    p.add_argument("--trace_dir", default="/tmp/gnot_profile")
+    p.add_argument("--out", default="docs/artifacts/profile_breakdown.json")
+    p.add_argument("--top", type=int, default=25)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    import bench
+
+    step, state, batch, _ = bench.build(args.dtype, config=args.config,
+                                        n_points=args.n_points)
+    lr = jnp.asarray(1e-3, jnp.float32)
+    multi = bench._scan_program(step)
+    copy_tree = jax.jit(lambda s: jax.tree.map(jnp.copy, s))
+
+    # Compile outside the trace; hard-fetch completion (axon tunnel:
+    # block_until_ready is untrustworthy, docs/performance.md).
+    s = copy_tree(state)
+    s2, loss = multi(s, batch, lr, args.k)
+    bench._hard_sync(s2, loss)
+
+    s = copy_tree(state)
+    with jax.profiler.trace(args.trace_dir):
+        s2, loss = multi(s, batch, lr, args.k)
+        bench._hard_sync(s2, loss)
+
+    pbs = sorted(glob.glob(os.path.join(args.trace_dir, "**/*.xplane.pb"),
+                           recursive=True), key=os.path.getmtime)
+    pd = jax.profiler.ProfileData.from_file(pbs[-1])
+    tpu = next(pl for pl in pd.planes if "TPU" in pl.name)
+    by_line = {ln.name: list(ln.events) for ln in tpu.lines}
+
+    module_ps = sum(e.duration_ns for e in by_line.get("XLA Modules", []))
+    # The scanned program is one big `while`; its timeline event spans
+    # every child op, so it is reported separately, NOT summed with
+    # the children (that would double-count the whole step).
+    fams: dict[str, dict] = {}
+    wrapper_ns = 0.0
+    for e in by_line["XLA Ops"]:
+        fam = family(e.name)
+        if fam == "while":
+            wrapper_ns += e.duration_ns
+            continue
+        d = fams.setdefault(fam, {"ns": 0.0, "count": 0, "hlo": e.name[:200]})
+        d["ns"] += e.duration_ns
+        d["count"] += 1
+    total_ops_ns = sum(v["ns"] for v in fams.values())
+
+    top = sorted(fams.items(), key=lambda kv: -kv[1]["ns"])[: args.top]
+    result = {
+        "workload": {
+            "config": args.config, "dtype": args.dtype, "k_steps": args.k,
+            "n_points": args.n_points, "batch": 4,
+        },
+        "device": jax.devices()[0].device_kind,
+        "module_total_ms_per_step": module_ps / 1e6 / args.k,
+        "while_wrapper_ms_per_step": wrapper_ns / 1e6 / args.k,
+        "ops_total_ms_per_step": total_ops_ns / 1e6 / args.k,
+        "op_families": [
+            {
+                "family": k,
+                "ms_per_step": round(v["ns"] / 1e6 / args.k, 4),
+                "pct_of_ops": round(100 * v["ns"] / total_ops_ns, 2),
+                "count_per_step": v["count"] / args.k,
+                "example_hlo": v["hlo"],
+            }
+            for k, v in top
+        ],
+    }
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps({k: result[k] for k in
+                      ("module_total_ms_per_step", "while_wrapper_ms_per_step",
+                       "ops_total_ms_per_step")}, indent=1))
+    for f_ in result["op_families"]:
+        print(f'{f_["ms_per_step"]:8.4f}ms {f_["pct_of_ops"]:5.1f}% '
+              f'x{f_["count_per_step"]:6.1f}  {f_["family"]}')
+    print(f"full breakdown -> {args.out}; raw trace under {args.trace_dir}")
+
+
+if __name__ == "__main__":
+    main()
