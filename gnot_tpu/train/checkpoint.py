@@ -156,15 +156,16 @@ class Checkpointer:
                     f"different numerics ({detail}) — pass the matching flags "
                     "(e.g. --gelu) to reproduce its training-time behavior"
                 )
-            elif missing and len(missing) == len(self.extra_meta):
-                # Pre-provenance sidecar (saved before round 5): it may
-                # have been trained under the old masked-mode default
-                # (erf-GELU, pre-r4) — the exact silent-flip scenario
-                # the provenance exists to catch, so say so.
+            elif missing:
+                # Sidecar lacks some provenance keys (pre-round-5
+                # checkpoints lack all of them; future key additions
+                # leave older sidecars partially covered): the numerics
+                # check cannot vouch for those keys, so say so — the
+                # erf->tanh default flip is the canonical silent hazard.
                 print(
-                    f"note: '{name}' checkpoint predates numerics "
-                    "provenance (no gelu/attention_mode/dtype in its "
-                    "sidecar); if it was trained before the tanh-GELU "
+                    f"note: '{name}' checkpoint sidecar has no recorded "
+                    f"{'/'.join(missing)}; the numerics check cannot "
+                    "verify them — if the run predates the tanh-GELU "
                     "default, pass --gelu erf to restore its "
                     "training-time activation"
                 )

@@ -212,3 +212,45 @@ def test_bench_scan_marginal_matches_persstep_on_cpu():
     assert per_step > 0 and np.isfinite(per_step)
     # Same device work; generous bound for host-loop overhead and CI noise.
     assert 0.2 < per_scan / per_step < 5.0
+
+
+def _load_jsonl_artifact(name):
+    import json
+
+    path = os.path.join(os.path.dirname(__file__), "..", "docs", "artifacts", name)
+    with open(path) as f:
+        return [json.loads(line) for line in f]
+
+
+def test_reference_scale_demo_artifact():
+    """The committed --train_data demonstration at the reference's true
+    data scale (1100 samples x ~10k-point meshes, the shape-of-record
+    in /root/reference/model.py:110-116 and main.py:28-29 —
+    tools/reference_scale_demo.py): the real pickle-loading CLI path
+    trained on chip and converged."""
+    records = _load_jsonl_artifact("reference_scale_demo.jsonl")
+    epochs = [r for r in records if "train_loss" in r]
+    summary = next(r for r in records if r.get("kind") == "summary")
+    assert summary["n_train"] == 1100
+    assert len(epochs) == summary["epochs"] >= 5
+    assert all(np.isfinite(r["train_loss"]) for r in epochs)
+    # Converged: best metric well below the first epoch's.
+    assert summary["best_metric"] < 0.5 * epochs[0]["test_metric"]
+    # Steady-state end-to-end throughput (post-compile epochs) is
+    # recorded and nontrivial.
+    steady = [r["points_per_sec"] for r in epochs[1:]]
+    assert steady and min(steady) > 1e5
+
+
+def test_heatsink3d_16k_long_context_artifact():
+    """Long-context training artifact (SURVEY.md §5 stretch goal /
+    VERDICT r4 #8): heatsink3d synthetic at L>=16k points per cloud,
+    --remat --dtype bfloat16, 40 epochs on one chip — the long-context
+    levers TRAIN to convergence, not just step."""
+    epochs = [
+        r for r in _load_jsonl_artifact("heatsink3d_16k_convergence.jsonl")
+        if "train_loss" in r
+    ]
+    assert len(epochs) >= 40
+    assert all(np.isfinite(r["train_loss"]) for r in epochs)
+    assert epochs[-1]["test_metric"] < 0.2 * epochs[0]["test_metric"]

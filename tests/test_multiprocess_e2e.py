@@ -22,16 +22,35 @@ import pytest
 
 WORKER = r"""
 import os, sys
-proc_id = int(sys.argv[1]); port = sys.argv[2]
+proc_id = int(sys.argv[1]); n_procs = int(sys.argv[2])
+n_devices = int(sys.argv[3]); port = sys.argv[4]
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)
+jax.config.update("jax_num_cpu_devices", n_devices)
 jax.distributed.initialize(
-    coordinator_address=f"localhost:{port}", num_processes=2, process_id=proc_id
+    coordinator_address=f"localhost:{port}", num_processes=n_procs, process_id=proc_id
+)
+# Establish the gloo CPU-collectives context NOW, while the processes
+# are still in lockstep from initialize(): its TCP handshake has a
+# hard 30s window, and on a loaded single-core host the compile-time
+# skew before the first *training* collective can exceed that. Must be
+# a REAL device collective over all devices (sync_global_devices is a
+# coordination-service barrier and never touches gloo); this trivial
+# all-reduce compiles in ~1s, so the context is built while skew is
+# still tiny and every later collective reuses the TCP mesh.
+import numpy as _np
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+_mesh = Mesh(_np.array(jax.devices()), ("d",))
+_x = jax.device_put(
+    jnp.ones((len(jax.devices()),), jnp.float32), NamedSharding(_mesh, P("d"))
+)
+_np.asarray(
+    jax.jit(jnp.sum, out_shardings=NamedSharding(_mesh, P()))(_x)
 )
 from gnot_tpu.main import main
-best = main(sys.argv[3:])
+best = main(sys.argv[5:])
 print(f"WORKER_BEST {best}")
 """
 
@@ -50,22 +69,32 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_pair(tmp_path, cli_args: list[str]) -> list[str]:
-    """Launch the worker in 2 coordinated OS processes; return their
-    stdouts (asserting both exited 0)."""
+def _run_procs(
+    tmp_path, cli_args: list[str], n_procs: int = 2, n_devices: int = 2
+) -> list[str]:
+    """Launch the worker in ``n_procs`` coordinated OS processes with
+    ``n_devices`` virtual CPU devices each; return their stdouts
+    (asserting all exited 0)."""
     script = tmp_path / "worker.py"
     script.write_text(WORKER)
     port = str(_free_port())
+    # Shared on-disk jit cache: repeat launches (preempt/resume runs)
+    # skip recompiles, which keeps cross-process compile-time skew
+    # under gloo's TCP connect window on a loaded single-core host.
+    cache = tmp_path / "jitcache"
     procs = [
         subprocess.Popen(
-            [sys.executable, str(script), str(i), port, *cli_args],
+            [sys.executable, str(script), str(i), str(n_procs), str(n_devices),
+             port, *cli_args],
             stdout=subprocess.PIPE,
             stderr=subprocess.STDOUT,
             text=True,
             cwd="/root/repo",
-            env={**os.environ, "PYTHONPATH": "/root/repo"},
+            env={**os.environ, "PYTHONPATH": "/root/repo",
+                 "JAX_COMPILATION_CACHE_DIR": str(cache),
+                 "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0"},
         )
-        for i in range(2)
+        for i in range(n_procs)
     ]
     outs = []
     try:
@@ -77,9 +106,19 @@ def _run_pair(tmp_path, cli_args: list[str]) -> list[str]:
             if p.poll() is None:
                 p.kill()
                 p.wait()
-    for i, (p, out) in enumerate(zip(procs, outs)):
-        assert p.returncode == 0, f"process {i} failed:\n{out[-3000:]}"
+    if any(p.returncode != 0 for p in procs):
+        # The root cause usually lives in ANOTHER process than the one
+        # that reports a coordination-barrier failure — show them all.
+        detail = "\n".join(
+            f"--- process {i} (rc={p.returncode}) ---\n{out[-2000:]}"
+            for i, (p, out) in enumerate(zip(procs, outs))
+        )
+        raise AssertionError(f"worker process(es) failed:\n{detail}")
     return outs
+
+
+def _run_pair(tmp_path, cli_args: list[str]) -> list[str]:
+    return _run_procs(tmp_path, cli_args, n_procs=2)
 
 
 def test_two_process_distributed_training(tmp_path):
@@ -214,3 +253,64 @@ def test_two_process_checkpoint_resume_and_predict(tmp_path):
     torch = pytest.importorskip("torch")
     sd = torch.load(pth, weights_only=True)
     assert sd and all(v.ndim in (1, 2) for v in sd.values())
+
+
+def test_four_process_composed_mesh_checkpoint_resume(tmp_path):
+    """The composed data x model x pipe mesh across 4 REAL OS processes
+    (4 procs x 4 devices = 16 global devices, mesh data=4 x model=2 x
+    pipe=2; the hybrid-mesh rule keeps model/pipe inside each host, so
+    the data axis crosses all four hosts) — the config likeliest to
+    break on a real pod: process-order global-batch assembly on the
+    data axis while the pipe axis shards the layer-stacked params.
+
+    Asserts (a) all four processes print identical global losses and
+    metrics, (b) a run preempted after epoch 0 (``--stop_after_epoch
+    1`` stops once ``epoch + 1 >= 1``, i.e. with exactly one epoch
+    completed) and resumed replays epoch 1 exactly as the continuous
+    run (Orbax save/restore of the PIPE-SHARDED TrainState across 4
+    processes + seeded shuffle replay)."""
+    composed = [
+        "--n_attn_layers", "2", "--n_attn_hidden_dim", "8",
+        "--n_mlp_num_layers", "1", "--n_mlp_hidden_dim", "8",
+        "--n_input_hidden_dim", "8", "--n_expert", "2", "--n_head", "2",
+        "--n_train", "8", "--n_test", "4", "--batch_size", "2",
+        "--synthetic", "ns2d", "--distributed",
+        "--mesh_data", "4", "--mesh_model", "2", "--mesh_pipe", "2",
+    ]
+    d_cont, d_int = str(tmp_path / "cont4"), str(tmp_path / "int4")
+
+    outs_c = _run_procs(
+        tmp_path,
+        composed + ["--epochs", "2", "--checkpoint_dir", d_cont,
+                    "--checkpoint_every", "1"],
+        n_procs=4, n_devices=4,
+    )
+    pat_loss = r"Epoch (\d+), Loss: ([\d.eE+-]+)"
+    pat_metric = r"Epoch \d+, Test Metric: ([\d.eE+-]+)"
+    # (a) SPMD invariant: identical console numbers on all 4 processes.
+    for pat in (pat_loss, pat_metric, r"WORKER_BEST ([\d.eE+-]+)"):
+        series = [re.findall(pat, o) for o in outs_c]
+        assert series[0], f"no matches for {pat}"
+        for i, s in enumerate(series[1:], 1):
+            assert s == series[0], f"process {i} diverges for {pat}"
+    # (b) preempt with one epoch completed, resume, compare the
+    # replayed epoch.
+    _run_procs(
+        tmp_path,
+        composed + ["--epochs", "2", "--checkpoint_dir", d_int,
+                    "--checkpoint_every", "1", "--stop_after_epoch", "1"],
+        n_procs=4, n_devices=4,
+    )
+    outs_r = _run_procs(
+        tmp_path,
+        composed + ["--epochs", "2", "--checkpoint_dir", d_int,
+                    "--checkpoint_every", "1", "--resume"],
+        n_procs=4, n_devices=4,
+    )
+    cont = dict(re.findall(pat_loss, outs_c[0]))
+    res = dict(re.findall(pat_loss, outs_r[0]))
+    assert set(res) == {"1"}, f"resume should replay epoch 1 only, got {sorted(res)}"
+    np.testing.assert_allclose(
+        float(res["1"]), float(cont["1"]), rtol=1e-5,
+        err_msg="resumed epoch 1 loss diverges from continuous 4-process run",
+    )

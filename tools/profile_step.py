@@ -75,9 +75,18 @@ def main():
 
     pbs = sorted(glob.glob(os.path.join(args.trace_dir, "**/*.xplane.pb"),
                            recursive=True), key=os.path.getmtime)
+    if not pbs:
+        sys.exit(f"no *.xplane.pb under {args.trace_dir} — the profiler "
+                 "did not write a trace (transient tunnel failure? rerun)")
     pd = jax.profiler.ProfileData.from_file(pbs[-1])
-    tpu = next(pl for pl in pd.planes if "TPU" in pl.name)
+    tpu = next((pl for pl in pd.planes if "TPU" in pl.name), None)
+    if tpu is None:
+        sys.exit("trace has no /device:TPU plane — this tool needs the "
+                 "TPU backend (planes: "
+                 + ", ".join(pl.name for pl in pd.planes) + ")")
     by_line = {ln.name: list(ln.events) for ln in tpu.lines}
+    if "XLA Ops" not in by_line:
+        sys.exit("device plane has no 'XLA Ops' line — empty trace; rerun")
 
     module_ps = sum(e.duration_ns for e in by_line.get("XLA Modules", []))
     # The scanned program is one big `while`; its timeline event spans

@@ -69,8 +69,12 @@ def generate(n_train: int, n_test: int, seed: int = 0) -> None:
 
 
 def train(args) -> None:
+    from gnot_tpu.data.datasets import load_pickle
     from gnot_tpu.main import main as cli_main
 
+    # The ACTUAL scale trained on (not the --n_train the generate step
+    # may or may not have used) — the artifact test pins this field.
+    n_train_actual = len(load_pickle(TRAIN_PKL))
     out = args.out
     metrics = "/tmp/ref_scale_metrics.jsonl"
     if os.path.exists(metrics):
@@ -106,7 +110,7 @@ def train(args) -> None:
             json.dumps(
                 {
                     "kind": "summary",
-                    "n_train": args.n_train,
+                    "n_train": n_train_actual,
                     "epochs": args.epochs,
                     "best_metric": best,
                     "wall_seconds": round(wall, 1),
