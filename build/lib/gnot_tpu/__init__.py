@@ -1,0 +1,40 @@
+"""GNOT-TPU: a TPU-native neural-operator framework.
+
+Capabilities of ``aloe101/GNOT-Replication`` (see SURVEY.md), rebuilt
+TPU-first on JAX/XLA/Flax: masked ragged-mesh batching, normalized linear
+attention as MXU einsums, geometry-gated soft-MoE FFNs as batched GEMMs,
+sharded training over a device mesh, Orbax checkpointing.
+"""
+
+from gnot_tpu.config import Config, DataConfig, MeshConfig, ModelConfig, OptimConfig, TrainConfig, make_config
+from gnot_tpu.data.batch import Loader, MeshBatch, MeshSample, collate
+from gnot_tpu.models.gnot import GNOT
+
+__version__ = "0.4.0"
+
+__all__ = [
+    "Config",
+    "DataConfig",
+    "MeshConfig",
+    "ModelConfig",
+    "OptimConfig",
+    "TrainConfig",
+    "make_config",
+    "Loader",
+    "MeshBatch",
+    "MeshSample",
+    "collate",
+    "GNOT",
+    "Trainer",
+    "__version__",
+]
+
+
+def __getattr__(name):
+    # Lazy: importing Trainer pulls jax/optax/orbax, which config/data
+    # users may not need at import time.
+    if name == "Trainer":
+        from gnot_tpu.train.trainer import Trainer
+
+        return Trainer
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

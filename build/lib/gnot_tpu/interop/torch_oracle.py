@@ -1,0 +1,237 @@
+"""PyTorch-reference interop: the parity oracle.
+
+BASELINE.json keeps the reference PyTorch implementation as the
+*numerical oracle*: the JAX path must reproduce it to <1e-4 on Darcy2d.
+This module (a) loads the reference ``model.py`` (torch-only, no DGL
+needed) from ``GNOT_REFERENCE_PATH`` without copying any of its code, and
+(b) maps a torch ``state_dict`` into this framework's Flax param pytree.
+
+torch -> flax naming (see the reference model.py:142-152 for the torch
+side and gnot_tpu/models for the flax side):
+
+    x.layers.{2i}                      -> x_embed/dense_{i}
+    gating.layers.{2i}                 -> gating/dense_{i}
+    out.layers.{2i}                    -> out_mlp/dense_{i}
+    input_func_mlps.{f}.layers.{2i}    -> input_func_mlps/dense_{i}  (stacked over f)
+    blocks.{b}.cross_attention.query   -> block_{b}/cross_attention/query
+    blocks.{b}.cross_attention.key.{f} -> block_{b}/cross_attention/key (stacked over f)
+    blocks.{b}.self_attention.key      -> block_{b}/self_attention/key
+    blocks.{b}.ffn{n}.{e}.layers.{2i}  -> block_{b}/ffn{n}/experts/dense_{i} (stacked over e)
+
+torch Linear stores weight as [out, in]; flax Dense kernel is [in, out],
+so every weight is transposed. ModuleList entries become the leading
+stack axis of the corresponding vmapped flax layer.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+import numpy as np
+
+from gnot_tpu.config import ModelConfig
+
+DEFAULT_REFERENCE_PATH = os.environ.get("GNOT_REFERENCE_PATH", "/root/reference")
+
+
+def load_reference_model_module(path: str | None = None):
+    """Import the reference ``model.py`` as a module (torch-only file)."""
+    path = path or DEFAULT_REFERENCE_PATH
+    model_py = os.path.join(path, "model.py")
+    if not os.path.exists(model_py):
+        raise FileNotFoundError(
+            f"reference model.py not found at {model_py}; set GNOT_REFERENCE_PATH"
+        )
+    spec = importlib.util.spec_from_file_location("gnot_reference_model", model_py)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def torch_rel_l2(pred, target, mask):
+    """Masked per-sample relative L2 on padded torch tensors — the
+    reference objective (loss.py:19-23) without the unpad/concat round
+    trip: per-sample masked sums over the padded node axis are
+    mathematically identical to DGL's per-graph pooling. The ONE
+    torch-side oracle loss; the torch backend (main.py), the bench
+    baseline (bench.py) and the quality gate all call this."""
+    num = ((pred - target) ** 2 * mask[..., None]).sum(1)
+    den = (target**2 * mask[..., None]).sum(1)
+    return ((num / den) ** 0.5).mean()
+
+
+def build_reference_model(cfg: ModelConfig, path: str | None = None):
+    """Instantiate the reference torch GNOT with matching hyperparams."""
+    mod = load_reference_model_module(path)
+    return mod.GNOT(
+        cfg.input_dim,
+        cfg.theta_dim,
+        cfg.input_func_dim,
+        cfg.out_dim,
+        cfg.n_attn_layers,
+        cfg.n_attn_hidden_dim,
+        cfg.n_mlp_num_layers,
+        cfg.n_mlp_hidden_dim,
+        cfg.n_input_hidden_dim,
+        cfg.n_expert,
+        cfg.n_head,
+        cfg.n_input_functions,
+    )
+
+
+def _linear(sd, prefix: str) -> dict[str, np.ndarray]:
+    w = np.asarray(sd[f"{prefix}.weight"].detach().cpu().numpy())
+    b = np.asarray(sd[f"{prefix}.bias"].detach().cpu().numpy())
+    return {"kernel": w.T.copy(), "bias": b}
+
+
+def _stacked_linear(sd, prefixes: list[str]) -> dict[str, np.ndarray]:
+    parts = [_linear(sd, p) for p in prefixes]
+    return {
+        "kernel": np.stack([p["kernel"] for p in parts]),
+        "bias": np.stack([p["bias"] for p in parts]),
+    }
+
+
+def _mlp(sd, prefix: str, num_layers: int) -> dict:
+    # torch MLP Sequential: Linears at even indices 0, 2, ..., 2*num_layers.
+    return {
+        f"dense_{i}": _linear(sd, f"{prefix}.layers.{2 * i}")
+        for i in range(num_layers + 1)
+    }
+
+
+def _stacked_mlp(sd, prefixes: list[str], num_layers: int) -> dict:
+    return {
+        f"dense_{i}": _stacked_linear(
+            sd, [f"{p}.layers.{2 * i}" for p in prefixes]
+        )
+        for i in range(num_layers + 1)
+    }
+
+
+def flax_to_state_dict(params, cfg: ModelConfig) -> dict:
+    """Inverse of ``state_dict_to_flax``: map this framework's params to
+    a reference-compatible torch ``state_dict`` (numpy tensors wrapped
+    as ``torch.Tensor``). Lets models trained here run under the
+    reference's torch code — interop in both directions."""
+    import torch
+
+    out: dict = {}
+
+    def put_linear(prefix: str, leaf: dict) -> None:
+        out[f"{prefix}.weight"] = torch.from_numpy(
+            np.asarray(leaf["kernel"]).T.copy()
+        )
+        out[f"{prefix}.bias"] = torch.from_numpy(np.asarray(leaf["bias"]).copy())
+
+    def put_mlp(prefix: str, tree: dict, num_layers: int) -> None:
+        for i in range(num_layers + 1):
+            put_linear(f"{prefix}.layers.{2 * i}", tree[f"dense_{i}"])
+
+    def put_stacked_mlp(prefixes: list[str], tree: dict, num_layers: int) -> None:
+        for s, prefix in enumerate(prefixes):
+            for i in range(num_layers + 1):
+                leaf = tree[f"dense_{i}"]
+                put_linear(
+                    f"{prefix}.layers.{2 * i}",
+                    {"kernel": np.asarray(leaf["kernel"])[s], "bias": np.asarray(leaf["bias"])[s]},
+                )
+
+    n = cfg.n_mlp_num_layers
+    put_mlp("x", params["x_embed"], n)
+    put_mlp("gating", params["gating"], n)
+    put_mlp("out", params["out_mlp"], n)
+    if cfg.n_input_functions > 0:
+        put_stacked_mlp(
+            [f"input_func_mlps.{f}" for f in range(cfg.n_input_functions)],
+            params["input_func_mlps"],
+            n,
+        )
+    for b in range(cfg.n_attn_layers):
+        pb, blk = f"blocks.{b}", params[f"block_{b}"]
+        cross = blk["cross_attention"]
+        put_linear(f"{pb}.cross_attention.query", cross["query"])
+        put_linear(f"{pb}.cross_attention.fc_out", cross["fc_out"])
+        if cfg.n_input_functions > 0:
+            for f in range(cfg.n_input_functions):
+                for kind in ("key", "value"):
+                    leaf = cross[kind]
+                    put_linear(
+                        f"{pb}.cross_attention.{kind}.{f}",
+                        {
+                            "kernel": np.asarray(leaf["kernel"])[f],
+                            "bias": np.asarray(leaf["bias"])[f],
+                        },
+                    )
+        else:
+            put_linear(f"{pb}.cross_attention.key", cross["key"])
+            put_linear(f"{pb}.cross_attention.value", cross["value"])
+        for k in ("query", "key", "value", "fc_out"):
+            put_linear(f"{pb}.self_attention.{k}", blk["self_attention"][k])
+        for ffn in ("ffn1", "ffn2"):
+            put_stacked_mlp(
+                [f"{pb}.{ffn}.{e}" for e in range(cfg.n_expert)],
+                blk[ffn]["experts"],
+                n,
+            )
+    return out
+
+
+def state_dict_to_flax(state_dict, cfg: ModelConfig) -> dict:
+    """Map a reference torch GNOT state_dict to this framework's params."""
+    sd = state_dict
+    n = cfg.n_mlp_num_layers
+    params: dict = {
+        "x_embed": _mlp(sd, "x", n),
+        "gating": _mlp(sd, "gating", n),
+        "out_mlp": _mlp(sd, "out", n),
+    }
+    if cfg.n_input_functions > 0:
+        params["input_func_mlps"] = _stacked_mlp(
+            sd,
+            [f"input_func_mlps.{f}" for f in range(cfg.n_input_functions)],
+            n,
+        )
+    for b in range(cfg.n_attn_layers):
+        pb = f"blocks.{b}"
+        cross: dict = {
+            "query": _linear(sd, f"{pb}.cross_attention.query"),
+            "fc_out": _linear(sd, f"{pb}.cross_attention.fc_out"),
+        }
+        if cfg.n_input_functions > 0:
+            cross["key"] = _stacked_linear(
+                sd,
+                [f"{pb}.cross_attention.key.{f}" for f in range(cfg.n_input_functions)],
+            )
+            cross["value"] = _stacked_linear(
+                sd,
+                [
+                    f"{pb}.cross_attention.value.{f}"
+                    for f in range(cfg.n_input_functions)
+                ],
+            )
+        else:
+            cross["key"] = _linear(sd, f"{pb}.cross_attention.key")
+            cross["value"] = _linear(sd, f"{pb}.cross_attention.value")
+        params[f"block_{b}"] = {
+            "cross_attention": cross,
+            "self_attention": {
+                k: _linear(sd, f"{pb}.self_attention.{k}")
+                for k in ("query", "key", "value", "fc_out")
+            },
+            "ffn1": {
+                "experts": _stacked_mlp(
+                    sd, [f"{pb}.ffn1.{e}" for e in range(cfg.n_expert)], n
+                )
+            },
+            "ffn2": {
+                "experts": _stacked_mlp(
+                    sd, [f"{pb}.ffn2.{e}" for e in range(cfg.n_expert)], n
+                )
+            },
+        }
+    return params

@@ -1,0 +1,246 @@
+"""Core GNOT layers: MLP and heterogeneous normalized linear attention.
+
+Flax linen modules; all heavy math lives in ``gnot_tpu.ops.attention`` as
+pure einsum functions. Parameter initialization matches
+``torch.nn.Linear`` (kaiming-uniform weight with a=sqrt(5) + fan-in
+uniform bias) so that *training from scratch* has the same dynamics as
+the reference, not just weight-imported inference.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from gnot_tpu.ops.attention import (
+    feature_softmax,
+    merge_heads,
+    normalized_linear_attention,
+    split_heads,
+)
+from gnot_tpu.ops.pallas_ffn import fits_vmem, fused_gated_ffn
+
+Array = jax.Array
+
+# torch.nn.Linear weight init: kaiming_uniform(a=sqrt(5)) == U(+-1/sqrt(fan_in))
+# which is variance_scaling(1/3, fan_in, uniform).
+torch_kernel_init = nn.initializers.variance_scaling(
+    scale=1.0 / 3.0, mode="fan_in", distribution="uniform"
+)
+
+
+def torch_bias_init(fan_in: int):
+    """torch.nn.Linear bias init: U(+-1/sqrt(fan_in))."""
+
+    def init(key, shape, dtype=jnp.float32):
+        bound = 1.0 / (fan_in**0.5)
+        return jax.random.uniform(key, shape, dtype, -bound, bound)
+
+    return init
+
+
+def torch_dense(features: int, fan_in: int, *, name: str | None = None, dtype=None):
+    """A Dense layer with torch.nn.Linear-equivalent initialization."""
+    return nn.Dense(
+        features,
+        kernel_init=torch_kernel_init,
+        bias_init=torch_bias_init(fan_in),
+        name=name,
+        dtype=dtype,
+    )
+
+
+class Mlp(nn.Module):
+    """GELU MLP matching the reference ``MLP`` (model.py:5-18).
+
+    ``num_layers`` counts *hidden* blocks: the stack is
+    ``Linear(in->hid), GELU, [Linear(hid->hid), GELU] x (num_layers-1),
+    Linear(hid->out)`` — ``num_layers + 1`` Linears total, erf-GELU
+    (torch ``nn.GELU()`` default), no final activation, no norm.
+    """
+
+    num_layers: int
+    hidden_dim: int
+    output_dim: int
+    dtype: Any = None
+    # "erf": torch nn.GELU default (parity). "tanh": the standard
+    # approximation — ~2x cheaper on the TPU VPU (see config.gelu).
+    gelu: str = "erf"
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        gelu = functools.partial(jax.nn.gelu, approximate=self.gelu == "tanh")
+        fan_in = x.shape[-1]
+        for i in range(self.num_layers):
+            x = torch_dense(
+                self.hidden_dim, fan_in, name=f"dense_{i}", dtype=self.dtype
+            )(x)
+            x = gelu(x)
+            fan_in = self.hidden_dim
+        return torch_dense(
+            self.output_dim, fan_in, name=f"dense_{self.num_layers}", dtype=self.dtype
+        )(x)
+
+
+def _stacked_dense(features: int, fan_in: int, *, name: str, dtype=None):
+    """A Dense vmapped over a leading stack axis with per-slice params.
+
+    Equivalent of a ``torch.nn.ModuleList`` of Linears, but the stacked
+    parameter tensor ``[S, in, out]`` turns S separate GEMMs into one
+    batched GEMM — the MXU-friendly layout.
+    """
+    vmapped = nn.vmap(
+        nn.Dense,
+        in_axes=0,
+        out_axes=0,
+        variable_axes={"params": 0},
+        split_rngs={"params": True},
+    )
+    return vmapped(
+        features,
+        kernel_init=torch_kernel_init,
+        bias_init=torch_bias_init(fan_in),
+        name=name,
+        dtype=dtype,
+    )
+
+
+class LinearAttention(nn.Module):
+    """Heterogeneous normalized linear attention (model.py:33-107).
+
+    Cross mode (``n_input_functions > 0``): per-input-function K/V
+    projections (stacked, one batched GEMM), per-function attention
+    outputs averaged. Self mode: K/V from the query sequence itself.
+
+    Faithful quirks preserved from the reference:
+      * q and k are softmaxed over the **feature** axis (model.py:59,72,93);
+      * the residual adds the *softmaxed* q, not the raw input
+        (model.py:86,104);
+      * a single ``fc_out`` closes both branches (model.py:106).
+    """
+
+    n_embed: int
+    n_head: int
+    n_input_functions: int = 0
+    dtype: Any = None
+    # The reference merges heads by reshaping the PERMUTED [B,H,L,D]
+    # tensor straight to [B,L,E] (model.py:81,83,103-104) — an
+    # interleave that mixes heads AND sequence positions across output
+    # rows, not a transpose-merge. parity=True replicates that exactly;
+    # parity=False uses the correct [B,L,H*D] merge (required for
+    # pad-invariance in masked mode, since the interleaved merge leaks
+    # padded-row garbage into real rows).
+    parity: bool = False
+
+    def _merge(self, x: Array) -> Array:
+        if self.parity:
+            b, h, l, d = x.shape
+            return x.reshape(b, l, h * d)
+        return merge_heads(x)
+
+    @nn.compact
+    def __call__(
+        self,
+        query: Array,
+        input_functions: Array | None = None,
+        *,
+        query_mask: Array | None = None,
+        func_mask: Array | None = None,
+    ) -> Array:
+        e, h = self.n_embed, self.n_head
+        q_proj = torch_dense(e, query.shape[-1], name="query", dtype=self.dtype)(query)
+
+        if self.n_input_functions > 0:
+            if input_functions is None:
+                raise ValueError(
+                    "cross-attention layer called without input functions"
+                )
+            # input_functions: [F, B, Lf, E]; stacked K/V -> one batched GEMM.
+            fan_in = input_functions.shape[-1]
+            k_proj = _stacked_dense(e, fan_in, name="key", dtype=self.dtype)(
+                input_functions
+            )
+            v_proj = _stacked_dense(e, fan_in, name="value", dtype=self.dtype)(
+                input_functions
+            )
+            q = feature_softmax(split_heads(q_proj, h))
+            k = feature_softmax(jax.vmap(lambda t: split_heads(t, h))(k_proj))
+            v = jax.vmap(lambda t: split_heads(t, h))(v_proj)
+            mask_axis = None if func_mask is None else 0
+            out = jax.vmap(_nla_positional, in_axes=(None, 0, 0, mask_axis))(
+                q, k, v, func_mask
+            )  # [F, B, H, Lq, D]
+            res = self._merge(q) + self._merge(jnp.mean(out, axis=0))
+        else:
+            k_proj = torch_dense(e, query.shape[-1], name="key", dtype=self.dtype)(
+                query
+            )
+            v_proj = torch_dense(e, query.shape[-1], name="value", dtype=self.dtype)(
+                query
+            )
+            q = feature_softmax(split_heads(q_proj, h))
+            k = feature_softmax(split_heads(k_proj, h))
+            v = split_heads(v_proj, h)
+            out = normalized_linear_attention(q, k, v, kv_mask=query_mask)
+            res = self._merge(q) + self._merge(out)
+
+        return torch_dense(e, e, name="fc_out", dtype=self.dtype)(res)
+
+
+# vmap of normalized_linear_attention needs mask passed positionally; wrap.
+def _nla_positional(q, k, v, mask):
+    return normalized_linear_attention(q, k, v, kv_mask=mask)
+
+
+class GatedExpertFfn(nn.Module):
+    """Dense soft mixture-of-experts FFN (model.py:123-124,128-131).
+
+    Every expert runs on every token (no routing / capacity factor — this
+    is a *soft* mixture); outputs are combined with the geometry-gating
+    ``scores``. The E expert MLPs are stacked so each Linear becomes one
+    batched ``[E, ...]`` GEMM on the MXU instead of an E-way Python loop.
+
+    ``ffn_impl='pallas'`` runs the whole expert stack tile-resident in
+    VMEM (ops/pallas_ffn.py) — no ``[E, B, L, hidden]`` HBM slabs
+    between layers — when the weight set fits the VMEM budget;
+    otherwise it falls back to the XLA path.
+    """
+
+    n_expert: int
+    num_layers: int
+    hidden_dim: int
+    output_dim: int
+    dtype: Any = None
+    ffn_impl: str = "xla"
+    gelu: str = "erf"
+
+    @nn.compact
+    def __call__(self, x: Array, scores: Array) -> Array:
+        experts = nn.vmap(
+            Mlp,
+            in_axes=None,
+            out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            axis_size=self.n_expert,
+        )(
+            self.num_layers, self.hidden_dim, self.output_dim, self.dtype,
+            self.gelu, name="experts",
+        )
+
+        if self.ffn_impl == "pallas" and not self.is_initializing():
+            p = self.variables["params"]["experts"]
+            kernels = [
+                p[f"dense_{i}"]["kernel"] for i in range(self.num_layers + 1)
+            ]
+            biases = [p[f"dense_{i}"]["bias"] for i in range(self.num_layers + 1)]
+            if fits_vmem(kernels, biases):
+                return fused_gated_ffn(x, scores, kernels, biases, gelu=self.gelu)
+
+        out = experts(x)  # [E, B, L, D]
+        # scores: [B, L, E]; gate-weighted sum over experts (model.py:130).
+        return jnp.einsum("ebld,ble->bld", out, scores.astype(out.dtype))

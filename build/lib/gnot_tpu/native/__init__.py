@@ -1,0 +1,117 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference has no native code of its own (SURVEY.md §2: all native
+execution lives in the torch/DGL wheels), so this layer is a
+capability superset: the host-side ragged->dense packer that feeds the
+TPU. Built on first import with g++ (cached as a .so next to the
+source); every entry point has a pure-numpy fallback so the framework
+works with no toolchain.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(__file__)
+_SRC = os.path.join(_HERE, "ragged_pack.cpp")
+_SO = os.path.join(_HERE, "_ragged_pack.so")
+
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def _load():
+    """Build (if stale) and dlopen the packer; returns None on failure."""
+    global _lib, _load_failed
+    if _lib is not None or _load_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        try:
+            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(
+                _SRC
+            ):
+                # Per-process tmp name: concurrent first-builds must not
+                # interleave writes; os.replace stays atomic.
+                tmp = f"{_SO}.{os.getpid()}.tmp"
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
+                    check=True,
+                    capture_output=True,
+                )
+                os.replace(tmp, _SO)
+            lib = ctypes.CDLL(_SO)
+            lib.gnot_pack_rows.argtypes = [
+                ctypes.POINTER(ctypes.c_void_p),
+                ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_int64,
+                ctypes.c_void_p,
+                ctypes.c_void_p,
+            ]
+            lib.gnot_pack_rows.restype = None
+            _lib = lib
+        except (OSError, subprocess.CalledProcessError):
+            _load_failed = True
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def pack_rows_numpy(
+    arrs: list[np.ndarray], max_len: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Fallback: pad [len_i, dim] float32 blocks to [n, max_len, dim] +
+    [n, max_len] mask (zero pad at the row tail, reference utils.py:3-4)."""
+    n, dim = len(arrs), arrs[0].shape[1]
+    out = np.zeros((n, max_len, dim), np.float32)
+    mask = np.zeros((n, max_len), np.float32)
+    for i, a in enumerate(arrs):
+        out[i, : a.shape[0]] = a
+        mask[i, : a.shape[0]] = 1.0
+    return out, mask
+
+
+def pack_rows(arrs: list[np.ndarray], max_len: int) -> tuple[np.ndarray, np.ndarray]:
+    """Pack ragged float32 row-blocks into a padded batch + mask, using
+    the C++ packer when available."""
+    dim = arrs[0].shape[1] if arrs[0].ndim == 2 else -1
+    for a in arrs:
+        if a.ndim != 2 or a.shape[1] != dim:
+            raise ValueError(
+                f"pack_rows needs uniform [len_i, {dim}] blocks, got {a.shape}"
+            )
+    too_long = max(a.shape[0] for a in arrs)
+    if too_long > max_len:
+        raise ValueError(f"row block of {too_long} rows exceeds max_len={max_len}")
+    lib = _load()
+    if lib is None:
+        return pack_rows_numpy(arrs, max_len)
+    n, dim = len(arrs), arrs[0].shape[1]
+    contig = [np.ascontiguousarray(a, np.float32) for a in arrs]
+    out = np.empty((n, max_len, dim), np.float32)
+    mask = np.empty((n, max_len), np.float32)
+    srcs = (ctypes.c_void_p * n)(
+        *(a.ctypes.data_as(ctypes.c_void_p).value for a in contig)
+    )
+    lens = (ctypes.c_int64 * n)(*(a.shape[0] for a in contig))
+    lib.gnot_pack_rows(
+        ctypes.cast(srcs, ctypes.POINTER(ctypes.c_void_p)),
+        ctypes.cast(lens, ctypes.POINTER(ctypes.c_int64)),
+        n,
+        dim,
+        max_len,
+        out.ctypes.data_as(ctypes.c_void_p),
+        mask.ctypes.data_as(ctypes.c_void_p),
+    )
+    return out, mask

@@ -1,0 +1,42 @@
+"""Explicit collective schedules for shard_map code paths.
+
+GSPMD code (the default XLA einsum path) never calls these — XLA picks
+its own all-reduce schedule for the collectives it inserts. They exist
+for the explicit shard_map paths (fused pallas attention, the pipeline),
+where the schedule is ours to write.
+
+``ring_allreduce`` is the classic ring schedule: S-1 ppermute hops, each
+device forwarding the partial it last received while accumulating. For
+GNOT's linear attention the sequence-sharded reduction payload is the
+fixed-size ``[F, B, E, E]`` Gram accumulator (independent of sequence
+length), so a single fused ``psum`` is already optimal and remains the
+default; the ring form exists as an alternative schedule whose hops XLA
+can overlap with independent compute between attention stages — and as
+the honest demonstration that "ring attention" for a *linear* attention
+degenerates to a ring all-reduce of partial sums (there is no O(steps)
+K/V block rotation to do because no L x L score matrix exists;
+SURVEY.md §5 long-context note).
+"""
+
+from __future__ import annotations
+
+import jax
+
+Array = jax.Array
+
+
+def ring_allreduce(x: Array, axis_name: str, axis_size: int) -> Array:
+    """Sum ``x`` over ``axis_name`` with S-1 neighbor hops instead of a
+    one-shot psum. Differentiable (scan over ppermute; ppermute
+    transposes to the inverse permute)."""
+    if axis_size <= 1:
+        return x
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+
+    def step(carry, _):
+        acc, buf = carry
+        buf = jax.lax.ppermute(buf, axis_name, perm)
+        return (acc + buf, buf), None
+
+    (acc, _), _ = jax.lax.scan(step, (x, x), None, length=axis_size - 1)
+    return acc

@@ -1,0 +1,434 @@
+"""Pallas TPU kernels: fused normalized linear attention.
+
+The XLA path (``gnot_tpu.ops.attention``) splits heads into a
+``[B, H, L, D]`` layout (D = 32 at reference defaults) and materializes
+the feature softmaxes, masked keys, ``k_sum``, ``k^T v`` and the
+normalizer between fused regions. On TPU that layout is hostile: D=32
+in the lane axis wastes 3/4 of every 128-lane tile (VMEM and VPU), and
+the transposes for split/merge are extra HBM passes.
+
+These kernels keep the **merged-head layout** ``[L, E]`` (E = H*D, 256
+at defaults) end-to-end and express every per-head operation as a
+lane-group operation:
+
+* per-head feature softmax == softmax within each D-lane group,
+  statically unrolled over head lane-slices with a per-group max (so
+  every group's exps are anchored at 1 — no cross-head underflow);
+* per-head ``k^T v`` == the block-diagonal part of the full ``[E, E]``
+  contraction. We accumulate the full Gram matrix (perfectly
+  MXU-shaped) and mask off the cross-head blocks at apply time;
+* the ``1/<q, k_sum>`` normalizer per head broadcasts to its lane group
+  through the same block-diagonal matmul.
+
+The op is split into two composable stages, each a pallas kernel with a
+``custom_vjp`` (backward recomputes in einsum form — the standard TPU
+rematerialization trade of FLOPs for HBM):
+
+1. ``nla_reduce`` — grid ``(B, F, Lk/TILE)``: accumulates the masked
+   ``k^T v`` Gram matrix ``[E, E]`` and ``k_sum [1, E]`` per (batch,
+   input-function) into revisited output blocks.
+2. ``nla_apply`` — grid ``(B, L/TILE, F)``: softmaxes the query tile
+   (the tile's HBM fetch is shared across the F innermost steps; the
+   cheap softmax itself is recomputed per F), applies the Gram matrix
+   and normalizer, and emits both the attention output and softmax(q) —
+   GNOT's residual adds the *softmaxed* query (reference
+   ``/root/reference/model.py:86,104``), so downstream needs it.
+
+``fused_nla`` composes them on one device. ``fused_nla_sp`` is the
+long-context / sequence-parallel form: because linear attention's
+sequence reduction is a sum, SP needs exactly ONE ``psum`` of the
+``[E, E]`` Gram accumulators over the sequence mesh axis — a fixed-size
+collective independent of sequence length, strictly cheaper than ring
+attention's O(steps) rotation of K/V blocks (SURVEY.md §5 long-context
+note). Autodiff flows through ``shard_map`` + ``psum`` and the
+per-stage VJPs compose correctly.
+
+Semantics match ``feature_softmax`` + ``normalized_linear_attention``
+composed over heads (reference ``/root/reference/model.py:53-107``);
+outputs come back head-merged exactly as ``merge_heads`` would produce
+(the non-parity merge — parity mode's interleaved merge stays on the
+XLA path).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.sharding import PartitionSpec as P
+
+Array = jax.Array
+
+TILE = 256  # preferred sequence tile (matmul M dim); _seq_pad may drop
+# to 128 so the 1.5x buckets (384, 768, 1536, ...) don't re-pad by 33%.
+
+
+def _interpret_default() -> bool:
+    """Compiled on TPU; interpreter on CPU (tests). Other backends must
+    not silently fall into interpret mode — an orders-of-magnitude perf
+    trap."""
+    backend = jax.default_backend()
+    if backend == "tpu":
+        return False
+    if backend == "cpu":
+        return True
+    raise ValueError(
+        f"attention_impl='pallas' supports tpu (compiled) and cpu "
+        f"(interpreted) backends, not {backend!r}; use attention_impl='xla'"
+    )
+
+
+def _block_diag_mask(e: int, d: int, dtype=jnp.float32) -> Array:
+    """[E, E] with 1 inside each head's DxD diagonal block."""
+    r = jax.lax.broadcasted_iota(jnp.int32, (e, e), 0) // d
+    c = jax.lax.broadcasted_iota(jnp.int32, (e, e), 1) // d
+    return (r == c).astype(dtype)
+
+
+def _group_softmax(x: Array, n_head: int) -> Array:
+    """Per-head (lane-group) softmax of ``[T, E]`` rows.
+
+    The max is computed per group, not per row: a shared row max cancels
+    in exact arithmetic, but a head whose logits sit ~87+ below another
+    head's spike would underflow every exp in its group to 0 and divide
+    0/0. With the per-group max each group contains an exact
+    ``exp(0) == 1``, so the group sum is always >= 1. Statically
+    unrolled over head lane-slices (a ``[T,E]->[T,H,D]`` reshape does
+    not lower in Mosaic; D-lane slices do), with the sum and divide kept
+    per slice too — no cross-head matmul needed.
+    """
+    e = x.shape[-1]
+    d = e // n_head
+    parts = []
+    for i in range(n_head):
+        s = x[:, i * d : (i + 1) * d]
+        ex = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+        parts.append(ex / jnp.sum(ex, axis=-1, keepdims=True))
+    return jnp.concatenate(parts, axis=-1)
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def _seq_pad(n: int) -> tuple[int, int]:
+    """(padded_length, tile): tile the sequence dim, sublane-aligned.
+
+    Prefers TILE; falls back to TILE/2 when that avoids re-padding
+    (Loader buckets include 1.5x-of-power-of-two lengths like 384)."""
+    if n >= TILE:
+        lp = _round_up(n, TILE // 2)
+        tile = TILE if lp % TILE == 0 else TILE // 2
+        return lp, tile
+    t = _round_up(n, 8)
+    return t, t
+
+
+# --------------------------------------------------------------------------
+# Stage 1: reduce — masked group-softmax(k)^T v Gram + k_sum accumulation.
+# --------------------------------------------------------------------------
+
+
+def _reduce_kernel(k_ref, v_ref, m_ref, kv_ref, ksum_ref, *, n_head):
+    lk_i = pl.program_id(2)
+
+    @pl.when(lk_i == 0)
+    def _():
+        kv_ref[0, 0] = jnp.zeros_like(kv_ref[0, 0])
+        ksum_ref[0, 0] = jnp.zeros_like(ksum_ref[0, 0])
+
+    k = k_ref[0, 0].astype(jnp.float32)  # [T, E]
+    v = v_ref[0, 0].astype(jnp.float32)  # [T, E]
+    m = m_ref[0, 0].astype(jnp.float32)  # [T, 1]
+    ks = _group_softmax(k, n_head) * m
+    kv_ref[0, 0] += jax.lax.dot_general(  # k^T v Gram tile: [E, E]
+        ks, v, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ksum_ref[0, 0] += jnp.sum(ks, axis=0, keepdims=True)
+
+
+def _reduce_call(k, v, mask, n_head: int, interpret: bool):
+    f, b, lk, e = k.shape
+    lkp, tlk = _seq_pad(lk)
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, lkp - lk), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, lkp - lk), (0, 0)))
+    # Padded key rows get mask 0, so they vanish from the reductions.
+    mp = jnp.pad(mask, ((0, 0), (0, 0), (0, lkp - lk)))[..., None]  # [F,B,Lkp,1]
+
+    return pl.pallas_call(
+        functools.partial(_reduce_kernel, n_head=n_head),
+        grid=(b, f, lkp // tlk),
+        in_specs=[
+            pl.BlockSpec((1, 1, tlk, e), lambda bi, fi, li: (fi, bi, li, 0)),
+            pl.BlockSpec((1, 1, tlk, e), lambda bi, fi, li: (fi, bi, li, 0)),
+            pl.BlockSpec((1, 1, tlk, 1), lambda bi, fi, li: (fi, bi, li, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, e, e), lambda bi, fi, li: (fi, bi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, e), lambda bi, fi, li: (fi, bi, 0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((f, b, e, e), jnp.float32),
+            jax.ShapeDtypeStruct((f, b, 1, e), jnp.float32),
+        ),
+        interpret=interpret,
+    )(kp, vp, mp)
+
+
+def _reduce_ref(k, v, mask, n_head: int):
+    """Einsum form of the reduce stage (backward source + test oracle)."""
+
+    def gsm(x):
+        shaped = x.reshape(*x.shape[:-1], n_head, x.shape[-1] // n_head)
+        sm = jax.nn.softmax(shaped.astype(jnp.float32), axis=-1)
+        return sm.reshape(x.shape)
+
+    ks = gsm(k) * mask[..., None]  # [F, B, Lk, E]
+    kv = jnp.einsum("fbld,fble->fbde", ks, v.astype(jnp.float32))
+    ksum = jnp.sum(ks, axis=2, keepdims=True)  # [F, B, 1, E]
+    return kv, ksum
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def nla_reduce(k: Array, v: Array, mask: Array, n_head: int, interpret: bool | None = None):
+    """Masked Gram accumulation: ``(kv [F,B,E,E], k_sum [F,B,1,E])`` in f32.
+
+    Sequence-parallel note: ``kv``/``k_sum`` are plain sums over Lk, so
+    partial results from sequence shards combine with one ``psum``.
+    """
+    interpret = _interpret_default() if interpret is None else interpret
+    return _reduce_call(k, v, mask, n_head, interpret)
+
+
+def _nla_reduce_fwd(k, v, mask, n_head, interpret):
+    interpret = _interpret_default() if interpret is None else interpret
+    return _reduce_call(k, v, mask, n_head, interpret), (k, v, mask)
+
+
+def _nla_reduce_bwd(n_head, interpret, residuals, cotangents):
+    del interpret
+    k, v, mask = residuals
+    _, vjp = jax.vjp(lambda k_, v_: _reduce_ref(k_, v_, mask, n_head), k, v)
+    dk, dv = vjp(cotangents)
+    return dk, dv, jnp.zeros_like(mask)
+
+
+nla_reduce.defvjp(_nla_reduce_fwd, _nla_reduce_bwd)
+
+
+# --------------------------------------------------------------------------
+# Stage 2: apply — softmax(q), normalizer, Gram application.
+# --------------------------------------------------------------------------
+
+
+def _apply_kernel(q_ref, kv_ref, ksum_ref, out_ref, qs_ref, *, n_head):
+    f_i = pl.program_id(2)
+    e = q_ref.shape[-1]
+    bd = _block_diag_mask(e, e // n_head)
+
+    qs = _group_softmax(q_ref[0].astype(jnp.float32), n_head)  # [T, E]
+
+    @pl.when(f_i == 0)
+    def _():
+        qs_ref[0] = qs.astype(qs_ref.dtype)
+
+    kv = kv_ref[0, 0] * bd  # keep only each head's diagonal block
+    ksum = ksum_ref[0, 0]  # [1, E]
+    # Per-head <q, k_sum>, broadcast back to the head's lanes: [T, E].
+    denom = jax.lax.dot_general(
+        qs * ksum, bd, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    # All-masked function slab: ksum == 0 → denom == 0 with a zero
+    # numerator; select 1 so the contribution is 0, not nan (the softmaxed
+    # k rows are strictly positive, so any surviving key makes denom > 0).
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    out = jnp.dot(qs, kv, preferred_element_type=jnp.float32) / denom
+    out_ref[0, 0] = out.astype(out_ref.dtype)
+
+
+def _apply_call(q, kv, ksum, n_head: int, interpret: bool):
+    b, l, e = q.shape
+    f = kv.shape[0]
+    lp, tl = _seq_pad(l)
+    qp = jnp.pad(q, ((0, 0), (0, lp - l), (0, 0)))
+
+    out, qs = pl.pallas_call(
+        functools.partial(_apply_kernel, n_head=n_head),
+        grid=(b, lp // tl, f),
+        in_specs=[
+            pl.BlockSpec((1, tl, e), lambda bi, li, fi: (bi, li, 0)),
+            pl.BlockSpec((1, 1, e, e), lambda bi, li, fi: (fi, bi, 0, 0)),
+            pl.BlockSpec((1, 1, 1, e), lambda bi, li, fi: (fi, bi, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, tl, e), lambda bi, li, fi: (fi, bi, li, 0)),
+            pl.BlockSpec((1, tl, e), lambda bi, li, fi: (bi, li, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((f, b, lp, e), q.dtype),
+            jax.ShapeDtypeStruct((b, lp, e), q.dtype),
+        ),
+        interpret=interpret,
+    )(qp, kv, ksum)
+    return out[:, :, :l], qs[:, :l]
+
+
+def _apply_ref(q, kv, ksum, n_head: int):
+    """Einsum form of the apply stage (backward source + test oracle)."""
+    e = q.shape[-1]
+    shaped = q.reshape(*q.shape[:-1], n_head, e // n_head)
+    qs = jax.nn.softmax(shaped.astype(jnp.float32), axis=-1).reshape(q.shape)
+    bd = _block_diag_mask(e, e // n_head)
+    kvm = kv * bd
+    # Per-head <q, k_sum>, broadcast to the head's lanes via bd.
+    denom = jnp.einsum("fble,ed->fbld", qs[None] * ksum, bd)
+    denom = jnp.where(denom == 0.0, 1.0, denom)  # all-masked slab → 0, not nan
+    out = jnp.einsum("bld,fbde->fble", qs, kvm) / denom
+    return out.astype(q.dtype), qs.astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def nla_apply(q: Array, kv: Array, ksum: Array, n_head: int, interpret: bool | None = None):
+    """Apply the (psum-combined) Gram accumulators to the query stream.
+
+    Returns ``(out [F,B,L,E], q_softmaxed [B,L,E])``, head-merged.
+    """
+    interpret = _interpret_default() if interpret is None else interpret
+    return _apply_call(q, kv, ksum, n_head, interpret)
+
+
+def _nla_apply_fwd(q, kv, ksum, n_head, interpret):
+    interpret = _interpret_default() if interpret is None else interpret
+    return _apply_call(q, kv, ksum, n_head, interpret), (q, kv, ksum)
+
+
+def _nla_apply_bwd(n_head, interpret, residuals, cotangents):
+    del interpret
+    q, kv, ksum = residuals
+    _, vjp = jax.vjp(
+        lambda q_, kv_, ks_: _apply_ref(q_, kv_, ks_, n_head), q, kv, ksum
+    )
+    return vjp(cotangents)
+
+
+nla_apply.defvjp(_nla_apply_fwd, _nla_apply_bwd)
+
+
+# --------------------------------------------------------------------------
+# Composed forms.
+# --------------------------------------------------------------------------
+
+
+def fused_nla(
+    q: Array,
+    k: Array,
+    v: Array,
+    mask: Array,
+    n_head: int,
+    interpret: bool | None = None,
+):
+    """Fused normalized linear attention in the merged-head layout.
+
+    Args:
+      q: ``[B, L, E]`` raw projected queries (pre-softmax, heads merged).
+      k: ``[F, B, Lk, E]`` raw keys, one slab per input function
+        (``F=1`` for self-attention).
+      v: ``[F, B, Lk, E]`` values.
+      mask: ``[F, B, Lk]`` 0/1 key mask (pass ones for unmasked).
+      n_head: number of heads (E must be divisible by it).
+      interpret: force pallas interpreter mode; ``None`` auto-selects
+        (compiled on TPU, interpreted on CPU for tests).
+
+    Returns:
+      ``(out [F, B, L, E], q_softmaxed [B, L, E])``, both head-merged.
+    """
+    kv, ksum = nla_reduce(k, v, mask, n_head, interpret)
+    return nla_apply(q, kv, ksum, n_head, interpret)
+
+
+def fused_nla_sp(
+    q: Array,
+    k: Array,
+    v: Array,
+    mask: Array,
+    n_head: int,
+    mesh,
+    *,
+    data_axis: str | None = None,
+    seq_axis: str | None = "seq",
+    model_axis: str | None = None,
+    interpret: bool | None = None,
+    sp_collective: str = "psum",
+):
+    """Distributed fused attention over a DP x SP x TP device mesh.
+
+    Per-axis layout (any subset of the axes may be None/size-1):
+
+    * ``data_axis`` — batch dim B sharded; no communication.
+    * ``seq_axis`` — L and Lk sharded. Each device reduces its local
+      Gram accumulators; one ``psum`` (fixed ``[F, B, E, E]`` payload,
+      independent of sequence length) combines them — strictly cheaper
+      than ring attention's O(steps) K/V rotation for this op.
+    * ``model_axis`` — the embed dim E sharded by WHOLE head groups
+      (requires ``n_head %% model_size == 0``). Heads never mix in
+      normalized linear attention (the Gram matrix is head-block
+      diagonal), so each shard runs the kernel on its local heads with
+      no communication at all.
+
+    ``sp_collective`` selects the schedule that combines the per-shard
+    Gram partials over ``seq_axis``: ``"psum"`` (one fused all-reduce,
+    the default and recommendation) or ``"ring"`` (S-1 ppermute hops —
+    see ops/collectives.ring_allreduce for when that schedule makes
+    sense). Differentiable end-to-end either way (psum transposes to
+    psum, the ring replays in reverse, through the per-stage custom
+    VJPs).
+    """
+    from jax import shard_map
+
+    from gnot_tpu.ops.collectives import ring_allreduce
+
+    if sp_collective not in ("psum", "ring"):
+        raise ValueError(f"unknown sp_collective {sp_collective!r}")
+    model_size = mesh.shape[model_axis] if model_axis else 1
+    if n_head % model_size:
+        raise ValueError(
+            f"n_head={n_head} must be divisible by the model axis size "
+            f"{model_size} (TP shards whole head groups)"
+        )
+    local_heads = n_head // model_size
+
+    def local_fn(q_l, k_l, v_l, m_l):
+        kv_l, ksum_l = nla_reduce(k_l, v_l, m_l, local_heads, interpret)
+        if seq_axis:
+            if sp_collective == "ring":
+                size = mesh.shape[seq_axis]
+                kv_l = ring_allreduce(kv_l, seq_axis, size)
+                ksum_l = ring_allreduce(ksum_l, seq_axis, size)
+            else:
+                kv_l = jax.lax.psum(kv_l, seq_axis)
+                ksum_l = jax.lax.psum(ksum_l, seq_axis)
+        return nla_apply(q_l, kv_l, ksum_l, local_heads, interpret)
+
+    return shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=(
+            P(data_axis, seq_axis, model_axis),
+            P(None, data_axis, seq_axis, model_axis),
+            P(None, data_axis, seq_axis, model_axis),
+            P(None, data_axis, seq_axis),
+        ),
+        out_specs=(
+            P(None, data_axis, seq_axis, model_axis),
+            P(data_axis, seq_axis, model_axis),
+        ),
+        check_vma=False,  # pallas_call outputs don't declare varying-axes
+    )(q, k, v, mask)
+
+
+def _reference_impl(q, k, v, mask, n_head: int):
+    """Full einsum oracle in the merged-head layout (tests)."""
+    kv, ksum = _reduce_ref(k, v, mask, n_head)
+    return _apply_ref(q, kv, ksum, n_head)

@@ -1,0 +1,243 @@
+"""Pallas TPU kernel: fused gated soft-MoE expert FFN.
+
+GNOT's FFN is a dense soft mixture (reference
+``/root/reference/model.py:123-131``): E expert MLPs all run on every
+token and a geometry gate combines them. The XLA path stacks the expert
+parameters and runs batched GEMMs — good MXU mapping, but every layer
+boundary materializes an ``[E, B, L, hidden]`` activation slab in HBM,
+and at reference defaults those slabs are the single largest HBM stream
+in the whole model (5 Linears x 2 FFNs x 4 blocks, E=3, hidden=256).
+
+This kernel runs the ENTIRE expert MLP stack for one sequence tile in
+VMEM: the full weight set (E x (num_layers+1) x [in, out] + biases —
+~3.9 MB at defaults, fetched once and reused across the grid) stays
+resident, each expert's hidden activations live and die in registers/
+VMEM, and the gate-weighted sum folds into the accumulator. HBM traffic
+drops to: x tile in, gate scores in, one output tile out.
+
+The FFN is strictly rowwise, so sequence tiling needs no masking —
+padded rows produce garbage that the wrapper slices off.
+
+Backward recomputes the forward in einsum/jnp form and differentiates
+that (rematerialization), keeping gradients identical to the XLA path.
+
+Used when the weight set fits the VMEM budget (``fits_vmem``); callers
+fall back to the XLA path otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+Array = jax.Array
+
+TILE = 256
+VMEM_BYTES = 16 * 1024 * 1024  # per-core VMEM on current TPUs
+VMEM_RESERVE = 2 * 1024 * 1024  # scheduler / spill slack
+
+
+def _interpret_default() -> bool:
+    backend = jax.default_backend()
+    if backend == "tpu":
+        return False
+    if backend == "cpu":
+        return True
+    raise ValueError(
+        f"ffn_impl='pallas' supports tpu (compiled) and cpu (interpreted) "
+        f"backends, not {backend!r}; use ffn_impl='xla'"
+    )
+
+
+def fits_vmem(kernels: list[Array], biases: list[Array] | None = None) -> bool:
+    """Whether the kernel's whole working set fits the VMEM budget.
+
+    Budgets the resident weights AND biases plus the per-tile activation
+    working set (double-buffered x/scores/out tiles, the live hidden
+    buffer and its matmul input, the f32 accumulator), not just the
+    kernels — a large hidden_dim can fail to compile or spill even when
+    the weights alone fit.
+    """
+    weights = sum(4 * k.size for k in kernels)
+    if biases is not None:
+        weights += sum(4 * b.size for b in biases)
+    else:
+        weights += sum(4 * k.shape[-1] * k.shape[0] for k in kernels)
+    d_in = kernels[0].shape[1]
+    d_out = kernels[-1].shape[-1]
+    n_expert = kernels[0].shape[0]
+    widest = max(k.shape[-1] for k in kernels)
+    # Live [TILE, *] f32 buffers: x + scores + out (x2 for pipeline
+    # double-buffering), hidden in + hidden out, accumulator.
+    act = 4 * TILE * (
+        2 * (d_in + n_expert + d_out) + 2 * widest + d_out
+    )
+    return weights + act <= VMEM_BYTES - VMEM_RESERVE
+
+
+def _erf_f32(x: Array) -> Array:
+    """float32 erf as a rational polynomial (Eigen's
+    ``generic_fast_erf_float``, ~1 ulp over the clamped range — the same
+    approximation XLA lowers ``erf`` to for f32). Mosaic TPU has no
+    ``erf``/``erfc`` primitive, so the exact-GELU inside the kernel
+    needs its own erf."""
+    x = jnp.clip(x, -3.832506856900711, 3.832506856900711)
+    z = x * x
+    alpha = jnp.float32(-2.72614225801306e-10)
+    alpha = alpha * z + jnp.float32(2.77068142495902e-08)
+    alpha = alpha * z + jnp.float32(-2.10102402082508e-06)
+    alpha = alpha * z + jnp.float32(-5.69250639462346e-05)
+    alpha = alpha * z + jnp.float32(-7.34990630326855e-04)
+    alpha = alpha * z + jnp.float32(-2.95459980854025e-03)
+    alpha = alpha * z + jnp.float32(-1.60960333262415e-02)
+    beta = jnp.float32(-1.45660718464996e-05)
+    beta = beta * z + jnp.float32(-2.13374055278905e-04)
+    beta = beta * z + jnp.float32(-1.68282697438203e-03)
+    beta = beta * z + jnp.float32(-7.37332916720468e-03)
+    beta = beta * z + jnp.float32(-1.42647390514189e-02)
+    return x * alpha / beta
+
+
+def _gelu_exact(x: Array) -> Array:
+    """Exact (erf-based) GELU — torch ``nn.GELU()`` default semantics
+    (reference model.py MLP), usable inside Mosaic kernels."""
+    inv_sqrt2 = jnp.float32(0.7071067811865476)
+    return 0.5 * x * (1.0 + _erf_f32(x * inv_sqrt2))
+
+
+def _gelu_tanh(x: Array) -> Array:
+    """tanh-approximated GELU (``jax.nn.gelu(approximate=True)``) — the
+    masked-mode default (config.gelu): ~2x cheaper than exact erf on the
+    TPU VPU. Mosaic has a native ``tanh``."""
+    c = jnp.float32(0.7978845608028654)  # sqrt(2/pi)
+    return 0.5 * x * (1.0 + jnp.tanh(c * (x + jnp.float32(0.044715) * x * x * x)))
+
+
+def _gelu(x: Array, gelu: str) -> Array:
+    return _gelu_tanh(x) if gelu == "tanh" else _gelu_exact(x)
+
+
+def _ffn_kernel(x_ref, s_ref, *refs, n_expert: int, n_linears: int, gelu: str):
+    k_refs = refs[:n_linears]
+    b_refs = refs[n_linears : 2 * n_linears]
+    out_ref = refs[2 * n_linears]
+
+    x = x_ref[0].astype(jnp.float32)  # [T, Din]
+    scores = s_ref[0].astype(jnp.float32)  # [T, E]
+    acc = jnp.zeros((x.shape[0], k_refs[-1].shape[-1]), jnp.float32)
+    for e in range(n_expert):
+        h = x
+        for i in range(n_linears):
+            h = (
+                jnp.dot(
+                    h,
+                    k_refs[i][e].astype(jnp.float32),
+                    preferred_element_type=jnp.float32,
+                )
+                + b_refs[i][e].astype(jnp.float32)  # [1, out] row broadcast
+            )
+            if i < n_linears - 1:
+                h = _gelu(h, gelu)
+        acc = acc + scores[:, e][:, None] * h
+    out_ref[0] = acc.astype(out_ref.dtype)
+
+
+def _round_up(n: int, m: int) -> int:
+    return (n + m - 1) // m * m
+
+
+def _ffn_call(x, scores, kernels, biases, interpret: bool, gelu: str):
+    b, l, _ = x.shape
+    n_expert = kernels[0].shape[0]
+    n_linears = len(kernels)
+    d_out = kernels[-1].shape[-1]
+    tl = TILE if l >= TILE else _round_up(l, 8)
+    lp = _round_up(l, tl)
+    xp = jnp.pad(x, ((0, 0), (0, lp - l), (0, 0)))
+    sp = jnp.pad(scores, ((0, 0), (0, lp - l), (0, 0)))
+    b3 = [bb[:, None, :] for bb in biases]  # [E, 1, out] for 2D row adds
+
+    weight_specs = [
+        pl.BlockSpec(k.shape, lambda bi, li: (0, 0, 0)) for k in kernels
+    ] + [pl.BlockSpec(bb.shape, lambda bi, li: (0, 0, 0)) for bb in b3]
+
+    out = pl.pallas_call(
+        functools.partial(
+            _ffn_kernel, n_expert=n_expert, n_linears=n_linears, gelu=gelu
+        ),
+        grid=(b, lp // tl),
+        in_specs=[
+            pl.BlockSpec((1, tl, x.shape[-1]), lambda bi, li: (bi, li, 0)),
+            pl.BlockSpec((1, tl, scores.shape[-1]), lambda bi, li: (bi, li, 0)),
+            *weight_specs,
+        ],
+        out_specs=pl.BlockSpec((1, tl, d_out), lambda bi, li: (bi, li, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, lp, d_out), x.dtype),
+        interpret=interpret,
+    )(xp, sp, *kernels, *b3)
+    return out[:, :l]
+
+
+def _reference_impl(x, scores, kernels, biases, gelu: str = "erf"):
+    """Einsum/jnp form with the kernel's f32 semantics (backward source
+    + test oracle). Matches the XLA GatedExpertFfn math
+    (models/layers.py) — per-expert MLP, gate-weighted sum — with the
+    kernel's polynomial erf-GELU (``_gelu_exact``), so forward kernel
+    and backward recompute are the same function (the polynomial is
+    within ~4e-7 of ``jax.nn.gelu(approximate=False)``)."""
+    h = jnp.broadcast_to(
+        x[None].astype(jnp.float32), (kernels[0].shape[0], *x.shape)
+    )  # [E, B, L, Din]
+    n = len(kernels)
+    for i, (k, bb) in enumerate(zip(kernels, biases)):
+        h = (
+            jnp.einsum("ebld,edo->eblo", h, k.astype(jnp.float32))
+            + bb.astype(jnp.float32)[:, None, None, :]
+        )
+        if i < n - 1:
+            h = _gelu(h, gelu)
+    out = jnp.einsum("eblo,ble->blo", h, scores.astype(jnp.float32))
+    return out.astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def fused_gated_ffn(x, scores, kernels, biases, interpret: bool | None = None, gelu: str = "erf"):
+    """Fused gated expert FFN.
+
+    Args:
+      x: ``[B, L, Din]`` tokens.
+      scores: ``[B, L, E]`` gate weights (softmaxed geometry gating).
+      kernels: per-Linear stacked weights, each ``[E, in, out]``.
+      biases: per-Linear stacked biases, each ``[E, out]``.
+      interpret: force interpreter mode (None = auto).
+
+    Returns:
+      ``[B, L, Dout]`` gate-combined expert outputs.
+    """
+    interpret = _interpret_default() if interpret is None else interpret
+    return _ffn_call(x, scores, list(kernels), list(biases), interpret, gelu)
+
+
+def _fused_fwd(x, scores, kernels, biases, interpret, gelu):
+    interpret = _interpret_default() if interpret is None else interpret
+    out = _ffn_call(x, scores, list(kernels), list(biases), interpret, gelu)
+    return out, (x, scores, kernels, biases)
+
+
+def _fused_bwd(interpret, gelu, residuals, g):
+    del interpret
+    x, scores, kernels, biases = residuals
+    _, vjp = jax.vjp(
+        lambda x_, s_, k_, b_: _reference_impl(x_, s_, k_, b_, gelu),
+        x,
+        scores,
+        kernels,
+        biases,
+    )
+    return vjp(g)
+
+
+fused_gated_ffn.defvjp(_fused_fwd, _fused_bwd)

@@ -1,0 +1,336 @@
+"""Device mesh + sharding layout: the distributed backend.
+
+The reference has no parallelism or communication backend at all
+(SURVEY.md §2 rows 9-10: single ``cuda:{id}`` device, no
+torch.distributed). The TPU-native equivalent is declarative: pick a
+mesh, annotate shardings, and let XLA GSPMD insert the collectives
+(psum/all-gather/reduce-scatter) over ICI — nothing hand-built.
+
+Axes of the mesh:
+
+* ``data`` — batch sharding (DP). Gradient reduction becomes an
+  implicit psum emitted by XLA.
+* ``seq``  — sequence/context parallelism (SP) over mesh points. GNOT's
+  linear attention shards trivially over sequence: ``k_sum`` and
+  ``k^T v`` are segment-sums over L, so each shard contributes a partial
+  sum and XLA inserts one psum per attention (SURVEY.md §5 long-context
+  note). This is what makes Heatsink3d-scale point clouds fit.
+* ``model`` — tensor parallelism (TP): attention projections are
+  head-sharded (the embed axis factors as [head, head_dim] with head
+  leading), expert-FFN hidden layers are column/row-sharded.
+* ``expert`` — expert parallelism (EP) over the stacked soft-MoE
+  expert axis. GNOT's mixture is dense (every expert runs on every
+  token, no routing — reference model.py:128-130), so there is no
+  all-to-all dispatch/combine as in routed MoE; each shard runs its
+  experts on the full token stream and the gate-weighted combine
+  (a contraction over E) becomes one psum.
+* ``pipe`` — pipeline parallelism (PP) over the attention-block stack.
+  Not a GSPMD axis: the pipeline is an explicit shard_map microbatch
+  schedule (parallel/pipeline.py); ``make_sharded_train_step``
+  dispatches there when the mesh carries ``pipe > 1``.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gnot_tpu.config import MeshConfig
+from gnot_tpu.data.batch import MeshBatch
+
+AXES = ("data", "seq", "model", "expert", "pipe")
+
+
+def make_mesh(cfg: MeshConfig, devices=None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    seq, model, expert, pipe = cfg.seq, cfg.model, cfg.expert, cfg.pipe
+    rest = seq * model * expert * pipe
+    data = cfg.data if cfg.data > 0 else n // rest
+    if data * rest != n:
+        raise ValueError(
+            f"mesh {data}x{seq}x{model}x{expert}x{pipe} "
+            f"(data x seq x model x expert x pipe) does not cover {n} devices"
+        )
+    if pipe > 1 and (seq > 1 or expert > 1):
+        raise ValueError(
+            "pipe > 1 composes with the data and model axes only (the "
+            "pipeline is a partially-manual shard_map: data/pipe are "
+            "mapped, model stays a GSPMD auto axis); set seq=expert=1"
+        )
+    arr = np.asarray(devices).reshape(data, seq, model, expert, pipe)
+    return Mesh(arr, AXES)
+
+
+def batch_pspecs() -> MeshBatch:
+    """PartitionSpecs for a MeshBatch: batch over ``data``, mesh-point
+    and function-point axes over ``seq``."""
+    return MeshBatch(
+        coords=P("data", "seq", None),
+        theta=P("data", None),
+        y=P("data", "seq", None),
+        node_mask=P("data", "seq"),
+        funcs=P(None, "data", "seq", None),
+        func_mask=P(None, "data", "seq"),
+    )
+
+
+def stacked_batch_pspecs() -> MeshBatch:
+    """PartitionSpecs for a K-step stacked MeshBatch (leading step axis
+    unsharded — the scan iterates it)."""
+    return jax.tree.map(
+        lambda spec: P(*((None,) + tuple(spec))),
+        batch_pspecs(),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def batch_shardings(mesh: Mesh, batch: MeshBatch, specs: MeshBatch | None = None) -> MeshBatch:
+    specs = batch_pspecs() if specs is None else specs
+    return jax.tree.map(
+        lambda spec, leaf: NamedSharding(mesh, spec) if leaf is not None else None,
+        specs,
+        batch,
+        is_leaf=lambda x: x is None or isinstance(x, P),
+    )
+
+
+def shard_batch(mesh: Mesh, batch: MeshBatch, *, stacked: bool = False) -> MeshBatch:
+    """Host->device transfer with the batch layout applied
+    (``stacked=True`` for a K-step stacked batch)."""
+    specs = stacked_batch_pspecs() if stacked else None
+    return jax.tree.map(
+        lambda leaf, sh: jax.device_put(leaf, sh),
+        batch,
+        batch_shardings(mesh, batch, specs),
+    )
+
+
+def _param_pspec(path: str, leaf) -> P:
+    """Name-based TP rules for the GNOT param tree.
+
+    The embed axis E of every attention projection factors as
+    [n_head, head_dim] with head leading (split_heads), so sharding E
+    over ``model`` is head-parallelism. fc_out is row-parallel (its
+    input axis carries E), producing the usual column->row TP pair with
+    one psum at the block output. Expert-FFN hidden layers are
+    column-sharded on the way in, row-sharded on the way out.
+
+    ``blocks/`` paths are the STACKED layout (scan_layers /
+    checkpoint-restored pipeline trees): a leading layer axis sits in
+    front of the ordinary block param shape — same rules, spec
+    prefixed with an unsharded layer dim.
+    """
+    if "blocks/" in path:
+        inner = _param_pspec_at(path, np.ndim(leaf) - 1)
+        return P(*((None,) + tuple(inner)))
+    return _param_pspec_at(path, np.ndim(leaf))
+
+
+def _param_pspec_at(path: str, ndim: int) -> P:
+    is_kernel = path.endswith("kernel")
+    if re.search(r"(query|key|value)/kernel$", path):
+        return P(*([None] * (ndim - 1) + ["model"]))  # column (head) parallel
+    if re.search(r"(query|key|value)/bias$", path):
+        return P(*([None] * (ndim - 1) + ["model"]))
+    if re.search(r"fc_out/kernel$", path):
+        return P("model", None)  # row parallel -> psum
+    if "experts/" in path:
+        # Stacked expert MLPs [E, in, out]: the stack axis is EP, the
+        # hidden axis TP. The gated combine contracts over E, so EP's
+        # only collective is one psum at each FFN output.
+        if is_kernel and "dense_0" in path:
+            return P("expert", None, "model")
+        if is_kernel:
+            return P("expert", "model", None)
+        if "dense_0" in path and ndim == 2:
+            return P("expert", "model")
+        return P(*(["expert"] + [None] * (ndim - 1)))
+    if "input_func_mlps/" in path:
+        # Stacked per-input-function MLPs [F, in, out]: the stack axis
+        # is the (semantic) function axis — never sharded; hidden is TP.
+        if is_kernel and "dense_0" in path:
+            return P(None, None, "model")
+        if is_kernel:
+            return P(None, "model", None)
+        if "dense_0" in path and ndim == 2:
+            return P(None, "model")
+        return P(*([None] * ndim))
+    return P(*([None] * ndim))  # everything else replicated
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k)))) for k in path
+    )
+
+
+def param_shardings(mesh: Mesh, params) -> Any:
+    return jax.tree_util.tree_map_with_path(
+        lambda path, leaf: NamedSharding(mesh, _param_pspec(_path_str(path), leaf)),
+        params,
+    )
+
+
+def state_shardings(mesh: Mesh, state) -> Any:
+    """Shardings for a full TrainState: optimizer moments follow their
+    parameters (their tree paths end with the same param path), scalars
+    replicate."""
+
+    def rule(path, leaf):
+        p = _path_str(path)
+        if np.ndim(leaf) == 0:
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, _param_pspec(p, leaf))
+
+    return jax.tree_util.tree_map_with_path(rule, state)
+
+
+def shard_state(mesh: Mesh, state):
+    return jax.tree.map(
+        lambda leaf, sh: jax.device_put(leaf, sh), state, state_shardings(mesh, state)
+    )
+
+
+def _validate_gspmd(model, mesh: Mesh) -> None:
+    """Config-validity guards shared by every GSPMD step builder —
+    clear ValueErrors at build time instead of opaque XLA partitioning
+    failures mid-compile."""
+    if mesh.shape.get("expert", 1) > 1 and (
+        model.config.n_expert % mesh.shape["expert"]
+    ):
+        raise ValueError(
+            f"n_expert={model.config.n_expert} must be divisible by the "
+            f"mesh expert axis ({mesh.shape['expert']})"
+        )
+    if getattr(model.config, "ffn_impl", "xla") == "pallas":
+        raise ValueError(
+            "ffn_impl='pallas' is single-device/DP only (no shard_map "
+            "form yet); use ffn_impl='xla' on a mesh"
+        )
+
+
+def make_sharded_train_step(
+    model, optim_cfg, loss_name: str, mesh: Mesh, state, microbatches: int = 0,
+    loss_fn=None,
+):
+    """jit the train step with explicit in/out shardings over the mesh.
+
+    All communication (DP gradient psum, SP partial-sum psums inside the
+    linear attention, TP collectives around the sharded GEMMs) is
+    emitted by XLA from these annotations. A mesh with ``pipe > 1``
+    dispatches to the explicit shard_map pipeline schedule instead
+    (parallel/pipeline.py; ``microbatches`` applies there only).
+    """
+    from gnot_tpu.train.trainer import train_step_body
+
+    if mesh.shape.get("pipe", 1) > 1:
+        if loss_fn is not None:
+            raise ValueError(
+                "loss_fn overrides do not reach the pipeline path (it "
+                "builds its own pipelined forward); use pipe == 1"
+            )
+        from gnot_tpu.parallel import pipeline
+
+        return pipeline.make_pipelined_train_step(
+            model, optim_cfg, loss_name, mesh, state, microbatches
+        )
+    _validate_gspmd(model, mesh)
+    body = train_step_body(model, optim_cfg, loss_name, loss_fn=loss_fn)
+
+    def step(state, batch: MeshBatch, lr):
+        return body(state, (batch, lr))
+
+    st_sh = state_shardings(mesh, state)
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        step,
+        in_shardings=(st_sh, None, replicated),
+        out_shardings=(st_sh, replicated),
+        donate_argnums=(0,),
+    )
+
+
+def _reject_pipe_multi(mesh: Mesh) -> None:
+    if mesh.shape.get("pipe", 1) > 1:
+        raise ValueError(
+            "steps_per_dispatch > 1 does not compose with the pipeline "
+            "mesh path; use single-step dispatch with pipe > 1"
+        )
+
+
+def make_sharded_multi_train_step(
+    model, optim_cfg, loss_name: str, mesh: Mesh, state, loss_fn=None
+):
+    """K-step scanned train step over the mesh (see
+    trainer.make_multi_train_step): one dispatch, one program, all
+    GSPMD collectives inside the scan body."""
+    from gnot_tpu.train.trainer import train_step_body
+
+    _reject_pipe_multi(mesh)
+    _validate_gspmd(model, mesh)
+    body = train_step_body(model, optim_cfg, loss_name, loss_fn=loss_fn)
+
+    def multi_step(state, batches, lrs):
+        return jax.lax.scan(body, state, (batches, lrs))
+
+    st_sh = state_shardings(mesh, state)
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        multi_step,
+        in_shardings=(st_sh, None, replicated),
+        out_shardings=(st_sh, replicated),
+        donate_argnums=(0,),
+    )
+
+
+def make_sharded_eval_step(
+    model, loss_name: str, mesh: Mesh, state, microbatches: int = 0, loss_fn=None,
+    per_sample: bool = False,
+):
+    """jit the eval (loss-only) step over the mesh; the scalar metric
+    comes back replicated. ``per_sample=True`` returns the replicated
+    ``[B]`` per-graph metric vector instead (the ragged-tail eval path;
+    a passed ``loss_fn`` must then itself be per-sample)."""
+    from gnot_tpu.train.trainer import eval_step_body
+
+    if mesh.shape.get("pipe", 1) > 1:
+        if loss_fn is not None:
+            raise ValueError(
+                "loss_fn overrides do not reach the pipeline path (it "
+                "builds its own pipelined forward); use pipe == 1"
+            )
+        from gnot_tpu.parallel import pipeline
+
+        return pipeline.make_pipelined_eval_step(
+            model, loss_name, mesh, state, microbatches, per_sample=per_sample
+        )
+
+    _validate_gspmd(model, mesh)
+    p_sh = state_shardings(mesh, state).params
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        eval_step_body(model, loss_name, loss_fn=loss_fn, per_sample=per_sample),
+        in_shardings=(p_sh, None),
+        out_shardings=replicated,
+    )
+
+
+def make_sharded_multi_eval_step(model, loss_name: str, mesh: Mesh, state, loss_fn=None):
+    """K eval losses over K stacked batches in one sharded dispatch."""
+    from gnot_tpu.train.trainer import eval_step_body
+
+    _reject_pipe_multi(mesh)
+    _validate_gspmd(model, mesh)
+    body = eval_step_body(model, loss_name, loss_fn=loss_fn)
+    p_sh = state_shardings(mesh, state).params
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        lambda params, batches: jax.lax.map(lambda b: body(params, b), batches),
+        in_shardings=(p_sh, None),
+        out_shardings=replicated,
+    )

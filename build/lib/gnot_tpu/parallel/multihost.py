@@ -1,0 +1,222 @@
+"""Multi-host (multi-process / DCN) scale-out.
+
+The reference is strictly single-process, single-device — no
+NCCL/MPI/torch.distributed anywhere (SURVEY.md §2 rows 9-10, §5). The
+TPU-native scale-out story is JAX's multi-controller runtime: one
+process per host, ``jax.distributed.initialize`` for the coordination
+service, and ONE global mesh spanning every chip; jitted code is
+identical to single-host — XLA routes collectives over ICI inside a
+slice and DCN across slices.
+
+Layout policy (the scaling-book recipe): put **data parallelism on the
+DCN axis** — the only cross-host collective is then the gradient psum,
+once per step, which DCN bandwidth handles — and keep SP/TP, whose
+collectives are per-layer, inside the ICI domain. ``make_hybrid_mesh``
+encodes exactly that: the leading ``data`` axis is (hosts x local-data),
+``seq``/``model`` never cross a host boundary.
+
+Data feeding is per-host: each process loads only its shard of the
+samples (``shard_samples``) and assembles globally-sharded device arrays
+from process-local batches (``global_batch``) via
+``jax.make_array_from_process_local_data``.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding
+
+from gnot_tpu.config import MeshConfig
+from gnot_tpu.data.batch import MeshBatch
+from gnot_tpu.parallel.mesh import AXES, batch_pspecs, make_mesh
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Bring up the multi-controller runtime.
+
+    With no arguments, attempts ``jax.distributed.initialize()``'s
+    environment auto-detection (TPU pods, SLURM, Open MPI); if the
+    process is not part of a managed multi-process job the attempt
+    fails and this degrades to a single-process no-op, so drivers can
+    call it unconditionally. If the environment LOOKS like a managed
+    multi-process job (SLURM/Open MPI/TPU-pod env vars present) the
+    failure re-raises instead: silently degrading there would launch p
+    duplicate single-process trainings racing on the same checkpoint
+    and metrics paths."""
+    if _already_initialized():
+        return  # a driver (or test harness) brought the runtime up itself
+    if (
+        coordinator_address is None
+        and num_processes is None
+        and process_id is None
+    ):
+        try:
+            jax.distributed.initialize()
+        except (RuntimeError, ValueError) as exc:
+            managed = _managed_job_hint()
+            if managed:
+                raise RuntimeError(
+                    f"jax.distributed auto-detection failed but the "
+                    f"environment advertises a multi-process job "
+                    f"({managed}); refusing to degrade to p independent "
+                    f"single-process runs"
+                ) from exc
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "jax.distributed.initialize() auto-detection failed (%s); "
+                "continuing single-process",
+                exc,
+            )
+            return
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def _already_initialized() -> bool:
+    """Whether the jax.distributed runtime is already up (a driver may
+    legitimately initialize it before calling into this framework)."""
+    try:
+        return bool(jax.distributed.is_initialized())
+    except AttributeError:  # older jax without the public predicate
+        from jax._src import distributed as _dist
+
+        return getattr(_dist.global_state, "client", None) is not None
+
+
+def _managed_job_hint() -> str | None:
+    """Name the env evidence of a multi-process job, or None."""
+    import os
+
+    ntasks = os.environ.get("SLURM_NTASKS")
+    if ntasks and int(ntasks) > 1:
+        return f"SLURM_NTASKS={ntasks}"
+    world = os.environ.get("OMPI_COMM_WORLD_SIZE")
+    if world and int(world) > 1:
+        return f"OMPI_COMM_WORLD_SIZE={world}"
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES")
+    if hosts and "," in hosts:
+        return f"TPU_WORKER_HOSTNAMES={hosts}"
+    return None
+
+
+def make_hybrid_mesh(cfg: MeshConfig) -> Mesh:
+    """Global ``data x seq x model`` mesh over all hosts.
+
+    ``cfg.data`` is the TOTAL data-parallel degree (same meaning as
+    ``make_mesh`` / ``--mesh_data``), factored as hosts x per-host; the
+    host factor rides DCN, seq/model stay inside each host's ICI
+    domain. Single-process runs degenerate to ``make_mesh``."""
+    n_proc = jax.process_count()
+    if n_proc == 1:
+        return make_mesh(cfg)
+    from jax.experimental import mesh_utils
+
+    local = jax.local_device_count()
+    rest = cfg.seq * cfg.model * cfg.expert * cfg.pipe
+    if local % rest:
+        raise ValueError(
+            f"seq*model*expert*pipe={rest} must divide the {local} "
+            "local devices (SP/TP/EP/PP must not cross hosts)"
+        )
+    if cfg.data > 0:
+        if cfg.data % n_proc:
+            raise ValueError(
+                f"total data degree {cfg.data} must be divisible by the "
+                f"{n_proc} processes"
+            )
+        ici_data = cfg.data // n_proc
+    else:
+        ici_data = local // rest
+    if ici_data * rest != local:
+        raise ValueError(
+            f"per-host mesh {ici_data}x{cfg.seq}x{cfg.model}x{cfg.expert}"
+            f"x{cfg.pipe} does not cover {local} local devices"
+        )
+    slices = {getattr(d, "slice_index", None) for d in jax.devices()}
+    if slices != {None} and len(slices) > 1:
+        # Real multi-slice topology: the hybrid builder knows the
+        # ICI/DCN layout. DCN granularity is SLICES (a slice may span
+        # several processes), so the data axis factors as
+        # n_slices x per-slice. Its errors are informative — let them
+        # raise.
+        n_slices = len(slices)
+        total_data = ici_data * n_proc
+        if total_data % n_slices:
+            raise ValueError(
+                f"total data degree {total_data} must be divisible by "
+                f"the {n_slices} slices"
+            )
+        devices = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(
+                total_data // n_slices, cfg.seq, cfg.model, cfg.expert, cfg.pipe,
+            ),
+            dcn_mesh_shape=(n_slices, 1, 1, 1, 1),
+        )
+    else:
+        # Devices that don't advertise DCN slices (CPU fleets,
+        # single-slice topologies) reject the hybrid builder. Build the
+        # same layout by hand: host-major data axis, each host's local
+        # block shaped (local_data, seq, model) so seq/model never
+        # leave a host.
+        import numpy as np
+
+        by_proc: dict[int, list] = {}
+        for d in jax.devices():
+            by_proc.setdefault(d.process_index, []).append(d)
+        blocks = [
+            np.asarray(sorted(v, key=lambda d: d.id)).reshape(
+                ici_data, cfg.seq, cfg.model, cfg.expert, cfg.pipe
+            )
+            for _, v in sorted(by_proc.items())
+        ]
+        devices = np.concatenate(blocks, axis=0)
+    return Mesh(devices, AXES)
+
+
+def shard_samples(
+    samples: Sequence,
+    *,
+    process_index: int | None = None,
+    process_count: int | None = None,
+) -> list:
+    """This host's strided shard of the dataset (every host must call
+    with the same ``samples`` order — seed the shuffle identically)."""
+    i = jax.process_index() if process_index is None else process_index
+    n = jax.process_count() if process_count is None else process_count
+    return list(samples)[i::n]
+
+
+def global_batch(
+    mesh: Mesh, local_batch: MeshBatch, *, stacked: bool = False
+) -> MeshBatch:
+    """Assemble a globally-sharded MeshBatch from this process's local
+    batch (the batch axis concatenates across hosts in process order).
+    ``stacked=True`` for K-step stacked batches (leading step axis)."""
+    from gnot_tpu.parallel.mesh import stacked_batch_pspecs
+
+    specs = stacked_batch_pspecs() if stacked else batch_pspecs()
+
+    def put(spec, leaf):
+        if leaf is None:
+            return None
+        return jax.make_array_from_process_local_data(
+            NamedSharding(mesh, spec), leaf
+        )
+
+    return jax.tree.map(
+        put,
+        specs,
+        local_batch,
+        is_leaf=lambda x: x is None or not isinstance(x, MeshBatch),
+    )

@@ -1,0 +1,499 @@
+"""Pipeline parallelism: a shard_map microbatch pipeline over the
+attention-block stack.
+
+The reference has no parallelism of any kind (SURVEY.md §2 rows 9-10);
+this is part of the TPU-native scale-out surface, alongside the GSPMD
+axes in ``parallel/mesh.py``. Unlike DP/SP/TP/EP — which are sharding
+*annotations* that XLA GSPMD turns into collectives — a pipeline is a
+*schedule*, so it is written explicitly with ``jax.shard_map``:
+
+* the per-block parameter trees are stacked along a leading layer axis
+  and that axis is sharded over the mesh ``pipe`` axis — each device
+  holds ``n_attn_layers / pipe`` consecutive blocks (one stage);
+* the (embedded) batch is split into M microbatches; the classic
+  ``M + S - 1``-tick schedule runs: at tick t, stage s processes
+  microbatch ``t - s`` and hands its output to stage ``s+1`` with a
+  single ``ppermute`` hop over ICI. Only the running query activation
+  travels; scores / input functions / masks are read locally by
+  microbatch index. The pipeline bubble is the usual
+  ``(S-1) / (M+S-1)`` fraction of ticks;
+* the embedding head (gating + query/function embeds) and the output
+  MLP run outside the pipeline as plain GSPMD-sharded compute (they
+  are a few percent of FLOPs).
+
+Everything is differentiable (``ppermute`` transposes to the inverse
+permute inside ``lax.scan``), so the same schedule serves forward and
+backward; the backward pass replays the ring in reverse.
+
+The pipeline composes with the ``data`` axis (each data shard runs its
+own pipeline over the same stage devices) and with the ``model`` axis:
+the shard_map maps ``data``/``pipe`` manually while ``model`` stays an
+XLA GSPMD *auto* axis, so tensor parallelism inside a stage is the
+ordinary sharding-annotation kind (state_shardings puts heads / FFN
+hidden over ``model``; GSPMD inserts the psums). Requires
+``seq == expert == 1``, ``ffn_impl == 'xla'``, and
+``n_attn_layers % pipe == 0``.
+
+Parameter layout: pipeline states store the block stack under
+``params["blocks"]`` (leading layer axis, pipe-sharded) instead of the
+standard ``block_i`` subtrees; ``stack_params`` / ``unstack_params``
+convert. All other entries (gating, x_embed, input_func_mlps, out_mlp)
+are identical to the standard layout, and the module math is the exact
+GNOT forward (models/gnot.py) — the tests assert the pipelined step
+matches the single-device step to float tolerance.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gnot_tpu.config import ModelConfig, OptimConfig
+from gnot_tpu.data.batch import MeshBatch
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# Parameter layout
+
+
+def stack_params(params: dict, n_layers: int) -> dict:
+    """Standard GNOT param tree -> pipeline layout: the ``block_i``
+    subtrees become one ``blocks`` tree with a leading layer axis."""
+    out = {k: v for k, v in params.items() if not k.startswith("block_")}
+    blocks = [params[f"block_{i}"] for i in range(n_layers)]
+    out["blocks"] = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    return out
+
+
+def unstack_params(params: dict, n_layers: int) -> dict:
+    """Pipeline layout -> standard GNOT param tree (for predict /
+    checkpoint interop / torch export)."""
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    for i in range(n_layers):
+        out[f"block_{i}"] = jax.tree.map(lambda x, i=i: x[i], params["blocks"])
+    return out
+
+
+def convert_state_layout(state, n_layers: int, to: str):
+    """Convert a full TrainState between the standard ``block_i`` layout
+    and the stacked ``blocks`` layout — INCLUDING the optimizer moments,
+    whose trees mirror the params — so a checkpoint written by a
+    ``--scan_layers`` / ``--mesh_pipe`` run can be resumed by a standard
+    run and vice versa. Operates on host/device values (pipe-sharded
+    states should be ``jax.device_get`` first). No-op if already in the
+    target layout."""
+    if to not in ("stacked", "standard"):
+        raise ValueError(f"unknown layout {to!r}")
+
+    def convert(node):
+        if isinstance(node, dict):
+            if to == "stacked" and "block_0" in node:
+                return stack_params(node, n_layers)
+            if to == "standard" and "blocks" in node:
+                return unstack_params(node, n_layers)
+            return {k: convert(v) for k, v in node.items()}
+        if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
+            return type(node)(*(convert(v) for v in node))
+        if isinstance(node, (tuple, list)):
+            return type(node)(convert(v) for v in node)
+        return node
+
+    import dataclasses as _dc
+
+    return _dc.replace(
+        state, params=convert(state.params), opt_state=convert(state.opt_state)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Model pieces: standalone applications of the SAME module factories
+# GNOT.__call__ composes (models/gnot.py) against the corresponding
+# param subtrees — hyperparameters and math cannot drift between the
+# standard and pipelined forwards.
+
+
+def _embed(cfg: ModelConfig, params: dict, coords, theta, input_functions):
+    """Gating scores + query embedding + input-function embeddings —
+    the pre-pipeline part of GNOT.__call__."""
+    from gnot_tpu.models import gnot
+
+    scores = gnot.gating_scores(
+        gnot.gating_module(cfg).apply({"params": params["gating"]}, coords)
+    )
+    query = gnot.x_embed_module(cfg).apply(
+        {"params": params["x_embed"]}, gnot.query_features(coords, theta)
+    )
+    if cfg.n_input_functions > 0 and input_functions is not None:
+        funcs = gnot.func_embed_module(cfg).apply(
+            {"params": params["input_func_mlps"]}, input_functions
+        )
+    else:
+        funcs = None
+    return scores, query, funcs
+
+
+def _head(cfg: ModelConfig, params: dict, query):
+    from gnot_tpu.models import gnot
+
+    return gnot.finalize_output(
+        gnot.out_module(cfg).apply({"params": params["out_mlp"]}, query)
+    )
+
+
+# ---------------------------------------------------------------------------
+# The pipeline schedule
+
+
+def _split_micro(x, m: int, batch_axis: int):
+    """[..., B, ...] -> [M, ..., B/M, ...]: carve the batch axis into M
+    microbatches and move the microbatch index to the front."""
+    if x is None:
+        return None
+    shape = list(x.shape)
+    b = shape[batch_axis]
+    if b % m:
+        raise ValueError(
+            f"local batch {b} must be divisible by microbatches={m}"
+        )
+    new = shape[:batch_axis] + [m, b // m] + shape[batch_axis + 1 :]
+    return jnp.moveaxis(x.reshape(new), batch_axis, 0)
+
+
+def _scan_blocks(cfg, block, stacked, scores, query, funcs, node_mask, func_mask):
+    """lax.scan of one block module over stacked per-layer params — THE
+    one block-application loop (the pipeline's per-stage compute and the
+    scan_layers forward both call this, so remat policy and block
+    wiring cannot drift between them)."""
+
+    def body(q, layer_p):
+        apply = lambda qq: block.apply(
+            {"params": layer_p}, scores, qq, funcs,
+            node_mask=node_mask, func_mask=func_mask,
+        )
+        if cfg.remat:
+            apply = jax.checkpoint(apply)
+        return apply(q), None
+
+    q, _ = jax.lax.scan(body, query, stacked)
+    return q
+
+
+def _pipe_blocks(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    n_micro: int,
+    stacked,
+    scores,
+    query,
+    funcs,
+    node_mask,
+    func_mask,
+):
+    """Run the block stack as an S-stage, M-microbatch pipeline.
+
+    Inputs are globally shaped; the shard_map carves the batch over
+    ``data`` and the layer axis of ``stacked`` over ``pipe``.
+    """
+    from gnot_tpu.models import gnot
+
+    s_pipe = mesh.shape["pipe"]
+    block = gnot.block_module(cfg, funcs is not None)
+
+    def local_fn(stacked_local, scores, query, funcs, node_mask, func_mask):
+        m = n_micro
+        t_total = m + s_pipe - 1
+        s_idx = jax.lax.axis_index("pipe")
+
+        scores_m = _split_micro(scores, m, 0)
+        query_m = _split_micro(query, m, 0)
+        funcs_m = _split_micro(funcs, m, 1)
+        nm_m = _split_micro(node_mask, m, 0)
+        fm_m = _split_micro(func_mask, m, 1)
+
+        def run_stage(sc, q, f, nm, fm):
+            return _scan_blocks(cfg, block, stacked_local, sc, q, f, nm, fm)
+
+        def tick(carry, t):
+            q_state, outputs = carry
+            # Microbatch resident at stage s this tick (clipped during
+            # warmup/drain; those lanes compute garbage that is never
+            # collected).
+            idx = jnp.clip(t - s_idx, 0, m - 1)
+            sc = scores_m[idx]
+            f = None if funcs_m is None else funcs_m[idx]
+            nm = None if nm_m is None else nm_m[idx]
+            fm = None if fm_m is None else fm_m[idx]
+            # Stage 0 ingests a fresh microbatch; later stages take the
+            # previous stage's handoff.
+            q_in = jnp.where(s_idx == 0, query_m[jnp.clip(t, 0, m - 1)], q_state)
+            q_out = run_stage(sc, q_in, f, nm, fm)
+
+            out_idx = t - (s_pipe - 1)
+            valid = (s_idx == s_pipe - 1) & (out_idx >= 0)
+            upd = jax.lax.dynamic_update_index_in_dim(
+                outputs, q_out, jnp.clip(out_idx, 0, m - 1), 0
+            )
+            outputs = jnp.where(valid, upd, outputs)
+
+            # One ICI hop: stage s -> s+1 (the wraparound into stage 0
+            # is discarded — stage 0 always re-ingests).
+            q_next = jax.lax.ppermute(
+                q_out, "pipe", [(i, (i + 1) % s_pipe) for i in range(s_pipe)]
+            )
+            return (q_next, outputs), None
+
+        q0 = query_m[0]
+        outputs0 = jnp.zeros_like(query_m)
+        (_, outputs), _ = jax.lax.scan(
+            tick, (q0, outputs0), jnp.arange(t_total)
+        )
+        # Collected outputs live on the last stage only; make them
+        # pipe-replicated (one broadcast — everything else in the
+        # schedule moved exactly one microbatch activation per tick).
+        outputs = jax.lax.psum(
+            jnp.where(s_idx == s_pipe - 1, outputs, jnp.zeros_like(outputs)),
+            "pipe",
+        )
+        return outputs.reshape(query.shape)
+
+    in_specs = [
+        jax.tree.map(lambda _: P("pipe"), stacked),
+        P("data", None, None),  # scores [B, L, E]
+        P("data", None, None),  # query  [B, L, D]
+        None if funcs is None else P(None, "data", None, None),
+        None if node_mask is None else P("data", None),
+        None if func_mask is None else P(None, "data", None),
+    ]
+    # Partially-manual shard_map: data/pipe are MAPPED (the schedule is
+    # explicit), every other mesh axis stays an XLA GSPMD "auto" axis —
+    # in particular ``model``, so tensor parallelism inside a stage is
+    # the ordinary sharding-annotation kind (state_shardings puts heads
+    # / FFN hidden over model and GSPMD inserts the psums).
+    mapped = jax.shard_map(
+        local_fn,
+        mesh=mesh,
+        in_specs=tuple(in_specs),
+        out_specs=P("data", None, None),
+        axis_names={"data", "pipe"},
+        check_vma=False,
+    )
+    return mapped(stacked, scores, query, funcs, node_mask, func_mask)
+
+
+def stacked_forward(cfg: ModelConfig, params: dict, batch: MeshBatch):
+    """Full GNOT forward with the block stack as ONE ``lax.scan`` over
+    stacked per-layer params (the pipeline parameter layout, no mesh
+    schedule): XLA traces and compiles a single block regardless of
+    ``n_attn_layers`` — the compile-time lever for deep configs
+    (``ModelConfig.scan_layers``). Same math as GNOT.__call__ (the
+    block module comes from the same factory); works standalone or
+    under a GSPMD-sharded jit (mesh._param_pspec knows the stacked
+    ``blocks/`` layout)."""
+    from gnot_tpu.models import gnot
+
+    node_mask, func_mask = batch.node_mask, batch.func_mask
+    if cfg.attention_mode == "parity":
+        node_mask = func_mask = None
+    with gnot.precision_scope(cfg):
+        scores, query, funcs = _embed(
+            cfg, params, batch.coords, batch.theta, batch.funcs
+        )
+        block = gnot.block_module(cfg, funcs is not None)
+        query = _scan_blocks(
+            cfg, block, params["blocks"], scores, query, funcs, node_mask, func_mask
+        )
+        return _head(cfg, params, query)
+
+
+def init_stacked_state(model, optim_cfg: OptimConfig, sample_batch, seed: int):
+    """Stacked-layout TrainState for ``scan_layers`` (no mesh; GSPMD
+    callers shard it afterwards with mesh.shard_state, whose param
+    rules understand the ``blocks`` stack)."""
+    from gnot_tpu.train.trainer import TrainState, init_state, make_optimizer
+
+    base = init_state(model, optim_cfg, sample_batch, seed)
+    params = stack_params(base.params, model.config.n_attn_layers)
+    tx = make_optimizer(optim_cfg, optim_cfg.lr)
+    return TrainState(
+        params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32)
+    )
+
+
+def pipelined_forward(
+    cfg: ModelConfig, mesh: Mesh, n_micro: int, params: dict, batch: MeshBatch
+):
+    """Full GNOT forward with the block stack pipelined (params in
+    pipeline layout)."""
+    from gnot_tpu.models import gnot
+
+    node_mask, func_mask = batch.node_mask, batch.func_mask
+    if cfg.attention_mode == "parity":
+        node_mask = func_mask = None
+    with gnot.precision_scope(cfg):
+        scores, query, funcs = _embed(
+            cfg, params, batch.coords, batch.theta, batch.funcs
+        )
+        query = _pipe_blocks(
+            cfg, mesh, n_micro, params["blocks"], scores, query, funcs,
+            node_mask, func_mask,
+        )
+        return _head(cfg, params, query)
+
+
+# ---------------------------------------------------------------------------
+# Train / eval steps and state layout
+
+
+def _validate(cfg: ModelConfig, mesh: Mesh):
+    s = mesh.shape["pipe"]
+    if cfg.attention_impl != "xla" or cfg.ffn_impl != "xla":
+        raise ValueError(
+            "pipeline parallelism supports the xla attention/ffn impls only"
+        )
+    if cfg.n_attn_layers % s:
+        raise ValueError(
+            f"n_attn_layers={cfg.n_attn_layers} must be divisible by the "
+            f"mesh pipe axis ({s})"
+        )
+    if any(mesh.shape[a] > 1 for a in ("seq", "expert")):
+        raise ValueError(
+            "pipe > 1 composes with data and model only; seq == expert == 1"
+        )
+
+
+def validate_local_batch(
+    mesh: Mesh, per_host_batch_size: int, microbatches: int, n_process: int = 1
+):
+    """Fail at startup (not mid-epoch) if a per-host batch can't split
+    into this host's data shards x microbatches. The mesh ``data`` axis
+    is GLOBAL (hosts x per-host on hybrid meshes), so the per-host data
+    degree is ``data / n_process``."""
+    micro = resolve_microbatches(mesh, microbatches)
+    local_data = max(1, mesh.shape["data"] // max(1, n_process))
+    per_shard = per_host_batch_size // local_data
+    if per_host_batch_size % local_data or per_shard % micro:
+        raise ValueError(
+            f"batch_size={per_host_batch_size} (per host) must split into "
+            f"the per-host data axis ({local_data}) x microbatches ({micro})"
+        )
+
+
+def resolve_microbatches(mesh: Mesh, microbatches: int) -> int:
+    """0 (the documented auto value) -> one microbatch per stage
+    (bubble = (S-1)/(2S-1)); negatives are rejected rather than silently
+    coerced."""
+    if microbatches < 0:
+        raise ValueError(f"microbatches must be >= 0, got {microbatches}")
+    return microbatches if microbatches > 0 else mesh.shape["pipe"]
+
+
+def state_shardings(mesh: Mesh, state) -> Any:
+    """Pipeline-layout state: the ``blocks`` stack (and its optimizer
+    moments, whose paths mirror the params) shards its layer axis over
+    ``pipe`` and its inner block axes by the standard TP rules (heads /
+    FFN hidden over ``model`` — mesh._param_pspec_at, the ONE copy of
+    those rules); everything outside the stack takes the plain GSPMD
+    rules (mesh._param_pspec), so embeds/head TP compose too."""
+    from gnot_tpu.parallel.mesh import _param_pspec, _param_pspec_at, _path_str
+
+    def rule(path, leaf):
+        if np.ndim(leaf) == 0:
+            return NamedSharding(mesh, P())
+        p = _path_str(path)
+        keys = p.split("/")
+        if "blocks" in keys:
+            sub = p[p.index("blocks/") + len("blocks/"):] if "blocks/" in p else ""
+            inner = _param_pspec_at(sub, np.ndim(leaf) - 1)
+            return NamedSharding(mesh, P(*(("pipe",) + tuple(inner))))
+        return NamedSharding(mesh, P(*_param_pspec(p, leaf)))
+
+    return jax.tree_util.tree_map_with_path(rule, state)
+
+
+def init_pipeline_state(model, optim_cfg: OptimConfig, sample_batch, seed: int, mesh: Mesh):
+    """Build a pipeline-layout TrainState, sharded over the mesh.
+
+    The optimizer state is initialized fresh on the stacked tree (it is
+    all zeros + a counter at step 0, so this is identical to stacking a
+    standard init)."""
+    # Validate up front so e.g. n_attn_layers % pipe != 0 surfaces as the
+    # intended ValueError here, not as an uneven-sharding device_put error.
+    _validate(model.config, mesh)
+    state = init_stacked_state(model, optim_cfg, sample_batch, seed)
+    return jax.tree.map(
+        lambda leaf, sh: jax.device_put(leaf, sh), state, state_shardings(mesh, state)
+    )
+
+
+def make_pipelined_train_step(
+    model, optim_cfg: OptimConfig, loss_name: str, mesh: Mesh, state, microbatches: int = 0
+):
+    """jit'd train step whose forward pipelines the block stack. The
+    ``state`` must be in pipeline layout (init_pipeline_state)."""
+    from gnot_tpu.ops.segment import LOSSES
+    from gnot_tpu.train.trainer import train_step_body
+
+    if "blocks" not in state.params:
+        raise ValueError(
+            "pipeline train step needs a pipeline-layout state "
+            "(init_pipeline_state), not the standard block_i layout"
+        )
+    n_micro = resolve_microbatches(mesh, microbatches)
+    _validate(model.config, mesh)
+    cfg = model.config
+
+    # The shared step math with the shard_map pipeline substituted as
+    # the forward.
+    body = train_step_body(
+        model,
+        optim_cfg,
+        loss_name,
+        loss_fn=lambda params, batch: LOSSES[loss_name](
+            pipelined_forward(cfg, mesh, n_micro, params, batch),
+            batch.y,
+            batch.node_mask,
+        ),
+    )
+
+    def step(state, batch: MeshBatch, lr):
+        return body(state, (batch, lr))
+
+    st_sh = state_shardings(mesh, state)
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        step,
+        in_shardings=(st_sh, None, replicated),
+        out_shardings=(st_sh, replicated),
+        donate_argnums=(0,),
+    )
+
+
+def make_pipelined_eval_step(
+    model, loss_name: str, mesh: Mesh, state, microbatches: int = 0,
+    per_sample: bool = False,
+):
+    from gnot_tpu.ops.segment import LOSSES, PER_SAMPLE_LOSSES
+
+    if "blocks" not in state.params:
+        raise ValueError(
+            "pipeline eval step needs a pipeline-layout state "
+            "(init_pipeline_state), not the standard block_i layout"
+        )
+    n_micro = resolve_microbatches(mesh, microbatches)
+    _validate(model.config, mesh)
+    cfg = model.config
+    p_sh = state_shardings(mesh, state).params
+    replicated = NamedSharding(mesh, P())
+    table = PER_SAMPLE_LOSSES if per_sample else LOSSES
+
+    def eval_fn(params, batch: MeshBatch):
+        preds = pipelined_forward(cfg, mesh, n_micro, params, batch)
+        return table[loss_name](preds, batch.y, batch.node_mask)
+
+    return jax.jit(eval_fn, in_shardings=(p_sh, None), out_shardings=replicated)

@@ -156,7 +156,7 @@ class Checkpointer:
                     f"different numerics ({detail}) — pass the matching flags "
                     "(e.g. --gelu) to reproduce its training-time behavior"
                 )
-            if missing:
+            elif missing:
                 # Sidecar lacks some provenance keys (pre-round-5
                 # checkpoints lack all of them; future key additions
                 # leave older sidecars partially covered): the numerics

@@ -1,0 +1,871 @@
+"""Training loop: jitted AdamW steps, per-epoch eval, best-checkpoint.
+
+Reproduces the reference regime (``/root/reference/main.py:50-153``):
+AdamW at torch defaults, OneCycle schedule (with the per-epoch stepping
+bug in parity mode, see schedule.py), rel-L2 train objective and eval
+metric, per-epoch console lines in the reference's exact format, and
+best-eval checkpoint selection.
+
+TPU-native differences: the whole update is one ``jit``-compiled,
+donate-argnum'd function (no per-step ``.item()`` sync — losses are
+fetched as device arrays and resolved at epoch end); batches stay
+padded/masked on device; the learning rate enters the compiled step as a
+scalar argument so schedule changes never trigger recompiles.
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+import time
+from typing import Any, Callable
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from gnot_tpu.config import Config, ModelConfig, OptimConfig
+from gnot_tpu.data.batch import Loader, MeshBatch
+from gnot_tpu.models.gnot import GNOT
+from gnot_tpu.ops.segment import LOSSES, PER_SAMPLE_LOSSES
+from gnot_tpu.train.schedule import make_lr_fn
+from gnot_tpu.utils import profiling
+
+
+@flax.struct.dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array  # int32 update counter
+
+
+def make_optimizer(cfg: OptimConfig, learning_rate) -> optax.GradientTransformation:
+    """AdamW with torch defaults made explicit (SURVEY.md §7 hard parts:
+    optax and torch defaults differ — wd=0.01, eps=1e-8 are torch's).
+
+    ``grad_accum > 1`` wraps the transform in ``optax.MultiSteps``: k
+    micro-batch gradients are averaged before each real update, so the
+    effective batch is k x batch_size at constant device memory."""
+    tx = optax.adamw(
+        learning_rate=learning_rate,
+        b1=cfg.b1,
+        b2=cfg.b2,
+        eps=cfg.eps,
+        weight_decay=cfg.weight_decay,
+    )
+    if cfg.grad_clip_norm > 0:
+        tx = optax.chain(optax.clip_by_global_norm(cfg.grad_clip_norm), tx)
+    if cfg.grad_accum > 1:
+        tx = optax.MultiSteps(tx, every_k_schedule=cfg.grad_accum)
+    return tx
+
+
+def apply_batch(model: GNOT, params, batch: MeshBatch) -> jax.Array:
+    """The one forward-on-a-MeshBatch invocation (shared by loss, init
+    and inference paths)."""
+    return model.apply(
+        {"params": params},
+        batch.coords,
+        batch.theta,
+        batch.funcs,
+        node_mask=batch.node_mask,
+        func_mask=batch.func_mask,
+    )
+
+
+def batch_loss(model: GNOT, params, batch: MeshBatch, loss_name: str) -> jax.Array:
+    """Forward + per-graph pooled loss. The loss is always masked — the
+    reference unpads before pooling (main.py:89), so padding never enters
+    the loss even in parity mode."""
+    preds = apply_batch(model, params, batch)
+    return LOSSES[loss_name](preds, batch.y, batch.node_mask)
+
+
+def train_step_body(
+    model: GNOT,
+    optim_cfg: OptimConfig,
+    loss_name: str,
+    *,
+    loss_fn: Callable | None = None,
+):
+    """THE training-step math — the one copy every step builder wraps
+    (single-device, GSPMD-sharded, K-step scanned, and pipelined), so
+    'numerically identical across dispatch modes' holds by construction.
+    Shaped as a scan body: ``body(state, (batch, lr))``. The LR is a
+    traced scalar: optax.adamw is pure, so building the transform inside
+    the compiled step is free and recompile-safe. ``loss_fn(params,
+    batch)`` overrides the forward (the pipeline path substitutes its
+    shard_map forward); default is the standard ``batch_loss``."""
+    if loss_fn is None:
+        loss_fn = lambda p, batch: batch_loss(model, p, batch, loss_name)
+
+    def body(state: TrainState, xs):
+        batch, lr = xs
+        loss, grads = jax.value_and_grad(lambda p: loss_fn(p, batch))(state.params)
+        tx = make_optimizer(optim_cfg, lr)
+        updates, opt_state = tx.update(grads, state.opt_state, state.params)
+        params = optax.apply_updates(state.params, updates)
+        return (
+            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
+            loss,
+        )
+
+    return body
+
+
+def make_train_step(
+    model: GNOT, optim_cfg: OptimConfig, loss_name: str, *, loss_fn=None
+) -> Callable:
+    body = train_step_body(model, optim_cfg, loss_name, loss_fn=loss_fn)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_step(state: TrainState, batch: MeshBatch, lr: jax.Array):
+        return body(state, (batch, lr))
+
+    return train_step
+
+
+def make_multi_train_step(
+    model: GNOT, optim_cfg: OptimConfig, loss_name: str, *, loss_fn=None
+) -> Callable:
+    """K training steps over K different batches as ONE compiled
+    program: ``lax.scan`` over a MeshBatch whose leaves carry a leading
+    step axis, with a ``[K]`` array of per-step learning rates. One
+    host->device dispatch per K steps — the lever when dispatch latency
+    (remote tunnels, tiny models) rivals step compute. Numerically
+    identical to K ``make_train_step`` calls."""
+    body = train_step_body(model, optim_cfg, loss_name, loss_fn=loss_fn)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def multi_step(state: TrainState, batches: MeshBatch, lrs: jax.Array):
+        return jax.lax.scan(body, state, (batches, lrs))
+
+    return multi_step
+
+
+def stack_batches(batches: list[MeshBatch]) -> MeshBatch:
+    """Stack same-shape host batches along a new leading step axis."""
+    return jax.tree.map(lambda *xs: np.stack(xs), *batches)
+
+
+def eval_step_body(
+    model: GNOT, loss_name: str, *, loss_fn=None, per_sample: bool = False
+) -> Callable:
+    """THE eval math — the one copy the single-device and sharded,
+    single- and multi-batch eval builders all wrap. ``loss_fn(params,
+    batch)`` overrides the forward (scan_layers substitutes the stacked
+    forward). ``per_sample=True`` returns the ``[B]`` per-graph metric
+    vector instead of the batch scalar (the distributed ragged-tail
+    eval slices the real rows out on the host)."""
+    if loss_fn is not None:
+        return loss_fn
+    table = PER_SAMPLE_LOSSES if per_sample else LOSSES
+
+    def body(params, batch: MeshBatch):
+        preds = apply_batch(model, params, batch)
+        return table[loss_name](preds, batch.y, batch.node_mask)
+
+    return body
+
+
+def make_eval_step(model: GNOT, loss_name: str, *, loss_fn=None) -> Callable:
+    return jax.jit(eval_step_body(model, loss_name, loss_fn=loss_fn))
+
+
+def make_multi_eval_step(model: GNOT, loss_name: str, *, loss_fn=None) -> Callable:
+    """K eval losses over K stacked batches in one dispatch (the eval
+    counterpart of make_multi_train_step)."""
+    body = eval_step_body(model, loss_name, loss_fn=loss_fn)
+
+    @jax.jit
+    def multi_eval(params, batches: MeshBatch):
+        return jax.lax.map(lambda b: body(params, b), batches)
+
+    return multi_eval
+
+
+def stacked_loss_fn(model_cfg, loss_name: str, *, per_sample: bool = False) -> Callable:
+    """loss_fn for the scan_layers (stacked-block) forward."""
+    from gnot_tpu.parallel.pipeline import stacked_forward
+
+    table = PER_SAMPLE_LOSSES if per_sample else LOSSES
+
+    def loss_fn(params, batch: MeshBatch):
+        preds = stacked_forward(model_cfg, params, batch)
+        return table[loss_name](preds, batch.y, batch.node_mask)
+
+    return loss_fn
+
+
+def group_batches(batches, k: int):
+    """Group same-shape batches into runs of k for one-dispatch
+    execution: yields ``("group", [b1..bk])`` for full groups and
+    ``("single", b)`` for shape-change flushes and remainders. THE one
+    grouping discipline — the train and eval loops both iterate this,
+    so their dispatch sequences stay in lockstep across hosts (a
+    divergence would be a cross-host hang, not an error). ``k < 2``
+    degenerates to all-singles (the plain one-step dispatch path)."""
+    if k < 2:
+        for b in batches:
+            yield "single", b
+        return
+    pending, key = [], None
+    for b in batches:
+        bk = tuple(np.shape(l) for l in jax.tree.leaves(b))
+        if pending and bk != key:
+            # Bucket-shape change: the open group can't stack further.
+            for p in pending:
+                yield "single", p
+            pending = []
+        pending.append(b)
+        key = bk
+        if len(pending) == k:
+            yield "group", pending
+            pending = []
+    for p in pending:  # remainder
+        yield "single", p
+
+
+def init_state(model: GNOT, optim_cfg: OptimConfig, sample_batch: MeshBatch, seed: int) -> TrainState:
+    params = model.init(
+        jax.random.key(seed),
+        sample_batch.coords,
+        sample_batch.theta,
+        sample_batch.funcs,
+        node_mask=sample_batch.node_mask,
+        func_mask=sample_batch.func_mask,
+    )["params"]
+    tx = make_optimizer(optim_cfg, optim_cfg.lr)
+    return TrainState(
+        params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32)
+    )
+
+
+def param_count(params) -> int:
+    return sum(int(np.prod(p.shape)) for p in jax.tree.leaves(params))
+
+
+class Trainer:
+    """Orchestrates one train/eval run (reference main.py:55-153)."""
+
+    def __init__(
+        self,
+        config: Config,
+        model_cfg: ModelConfig,
+        train_samples,
+        test_samples,
+        *,
+        metrics_sink=None,
+        checkpointer=None,
+    ):
+        self.config = config
+        self.mesh = None
+        self._eval_tail = 0  # real samples in a repeat-padded tail eval batch
+        drop_remainder = config.data.drop_remainder
+        pad_nodes = config.data.pad_nodes
+        pad_funcs = config.data.pad_funcs
+        if config.train.distributed:
+            from gnot_tpu.data.batch import fixed_pad_lengths
+            from gnot_tpu.parallel import multihost
+
+            self.mesh = multihost.make_hybrid_mesh(config.mesh)
+            if not pad_nodes:
+                # Distributed batches need one fixed shape: per-batch
+                # padding would diverge across hosts (different local
+                # samples -> different bucketed maxima -> SPMD shape
+                # mismatch). Multi-process drivers set these from the
+                # PRE-shard dataset (main.py); computing from local
+                # samples here covers the single-process case.
+                pad_nodes, pad_funcs = fixed_pad_lengths(
+                    list(train_samples) + list(test_samples),
+                    bucket=config.data.bucket,
+                )
+            # Fail at startup, not mid-epoch: every batch must split
+            # over the mesh axes.
+            local_data = self.mesh.shape["data"] // max(1, jax.process_count())
+            if config.data.batch_size % max(1, local_data):
+                raise ValueError(
+                    f"batch_size={config.data.batch_size} must be divisible "
+                    f"by the per-host data axis ({local_data})"
+                )
+            if self.mesh.shape["seq"] > 1 and not config.data.bucket:
+                raise ValueError(
+                    "sequence parallelism (mesh seq>1) requires bucketed "
+                    "padding (lengths divisible by the seq axis); drop "
+                    "--no_bucket"
+                )
+            if self.mesh.shape.get("pipe", 1) > 1:
+                from gnot_tpu.parallel import pipeline
+
+                pipeline.validate_local_batch(
+                    self.mesh,
+                    config.data.batch_size,
+                    config.mesh.microbatches,
+                    max(1, jax.process_count()),
+                )
+            if len(train_samples) % config.data.batch_size:
+                drop_remainder = True  # partial batches can't shard
+            tail = len(test_samples) % config.data.batch_size
+            if tail:
+                # The reference evaluates the ragged tail batch
+                # (main.py:113-132). A short batch can't shard over the
+                # mesh, so pad it with repeats of the last sample and
+                # drop them from the metric (predict's discipline,
+                # see evaluate()). Multi-process runs require
+                # n_test % n_process == 0 (main.py), so every host's
+                # local tail has the same length — same batch count,
+                # no cross-host divergence.
+                self._eval_tail = tail
+                test_samples = list(test_samples) + [test_samples[-1]] * (
+                    config.data.batch_size - tail
+                )
+        self.model = GNOT(model_cfg)
+        self.train_loader = Loader(
+            train_samples,
+            config.data.batch_size,
+            shuffle=config.data.shuffle_train,
+            seed=config.data.seed,
+            bucket=config.data.bucket,
+            drop_remainder=drop_remainder,
+            pad_nodes=pad_nodes,
+            pad_funcs=pad_funcs,
+        )
+        self.test_loader = Loader(
+            test_samples,
+            config.data.batch_size,
+            shuffle=False,
+            bucket=config.data.bucket,
+            pad_nodes=pad_nodes,
+            pad_funcs=pad_funcs,
+        )
+        # debug_checks: main() enables process-global jax_debug_nans at
+        # startup (before any tracing — the only point it reliably
+        # instruments, and a global flag is the CLI's to own, not a
+        # library constructor's); the trainer's own guard is the
+        # host-side per-step finiteness check in fit().
+        # scan_layers: the stacked forward substitutes via loss_fn in
+        # every (non-pipeline) dispatch mode; the pipeline path scans
+        # its own stages already.
+        self._loss_fn = (
+            stacked_loss_fn(model_cfg, config.train.loss)
+            if model_cfg.scan_layers
+            and not (self.mesh is not None and self.mesh.shape.get("pipe", 1) > 1)
+            else None
+        )
+        if self.mesh is None:
+            self.train_step = make_train_step(
+                self.model, config.optim, config.train.loss, loss_fn=self._loss_fn
+            )
+            self.eval_step = make_eval_step(
+                self.model, config.train.loss, loss_fn=self._loss_fn
+            )
+        else:
+            # Built lazily in initialize(): the sharded jits need the
+            # state's sharding layout.
+            self.train_step = self.eval_step = None
+        if (
+            config.optim.grad_accum > 1
+            and len(self.train_loader) % config.optim.grad_accum
+        ):
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "steps_per_epoch=%d is not divisible by grad_accum=%d: "
+                "accumulation windows straddle epoch boundaries and the "
+                "final partial window is discarded",
+                len(self.train_loader),
+                config.optim.grad_accum,
+            )
+        self.lr_fn = make_lr_fn(
+            config.optim,
+            steps_per_epoch=len(self.train_loader),
+            epochs=config.train.epochs,
+        )
+        if config.train.steps_per_dispatch < 1:
+            raise ValueError(
+                f"steps_per_dispatch must be >= 1, got "
+                f"{config.train.steps_per_dispatch}"
+            )
+        self.metrics_sink = metrics_sink
+        self.checkpointer = checkpointer
+        self.multi_train_step = None
+        self.multi_eval_step = None
+        self._tail_eval_step = None
+        self.state: TrainState | None = None
+        self._forward = None  # jitted inference fn, built on first predict()
+        self.best_metric = float("inf")
+        self.start_epoch = 0
+        # Host-side mirror of state.step: reading the device counter every
+        # batch would force a blocking transfer per step.
+        self.host_step = 0
+
+    def initialize(self) -> TrainState:
+        # Shape probe: collate one batch directly — going through the
+        # loader would spin up its prefetch thread and collate batches
+        # that get thrown away.
+        probe = self.test_loader if len(self.test_loader) else self.train_loader
+        sample = probe._collate_at(np.arange(min(probe.batch_size, len(probe.samples))))
+        if self.mesh is not None and self.mesh.shape.get("pipe", 1) > 1:
+            from gnot_tpu.parallel import pipeline
+
+            # Pipeline layout: block params stacked on a pipe-sharded
+            # layer axis. Checkpoints save/restore this layout directly.
+            self.state = pipeline.init_pipeline_state(
+                self.model, self.config.optim, sample, self.config.train.seed,
+                self.mesh,
+            )
+            already_sharded = True
+        elif self.model.config.scan_layers:
+            from gnot_tpu.parallel import pipeline
+
+            # Stacked layout (scan_layers): GSPMD sharding (if any)
+            # applies below — mesh._param_pspec knows the blocks stack.
+            self.state = pipeline.init_stacked_state(
+                self.model, self.config.optim, sample, self.config.train.seed
+            )
+            already_sharded = False
+        else:
+            self.state = init_state(
+                self.model, self.config.optim, sample, self.config.train.seed
+            )
+            already_sharded = False
+        if self.mesh is not None and not already_sharded:
+            from gnot_tpu.parallel import mesh as mesh_lib
+
+            # Shard BEFORE any restore: Orbax then restores straight
+            # into the mesh layout (each process reads only its shards).
+            # Restoring into a local template and re-sharding would need
+            # a committed-array cross-host device_put, which non-TPU
+            # backends reject.
+            self.state = mesh_lib.shard_state(self.mesh, self.state)
+        if self.checkpointer is not None and self.config.train.resume:
+            restored = self.checkpointer.restore_latest(self.state)
+            if restored is not None:
+                self.state, self.start_epoch, self.best_metric = restored
+                self.host_step = int(self.state.step)  # one-time sync
+        if self.mesh is not None:
+            from gnot_tpu.parallel import mesh as mesh_lib
+
+            self.train_step = mesh_lib.make_sharded_train_step(
+                self.model, self.config.optim, self.config.train.loss,
+                self.mesh, self.state, self.config.mesh.microbatches,
+                loss_fn=self._loss_fn,
+            )
+            self.eval_step = mesh_lib.make_sharded_eval_step(
+                self.model, self.config.train.loss, self.mesh, self.state,
+                self.config.mesh.microbatches, loss_fn=self._loss_fn,
+            )
+            if self._eval_tail:
+                # Per-sample metric vector for the repeat-padded tail
+                # batch; evaluate() slices the real rows on the host.
+                tail_loss_fn = (
+                    stacked_loss_fn(
+                        self.model.config, self.config.train.loss, per_sample=True
+                    )
+                    if self._loss_fn is not None
+                    else None
+                )
+                self._tail_eval_step = mesh_lib.make_sharded_eval_step(
+                    self.model, self.config.train.loss, self.mesh, self.state,
+                    self.config.mesh.microbatches, loss_fn=tail_loss_fn,
+                    per_sample=True,
+                )
+        if self.config.train.steps_per_dispatch > 1:
+            if self.mesh is None:
+                self.multi_train_step = make_multi_train_step(
+                    self.model, self.config.optim, self.config.train.loss,
+                    loss_fn=self._loss_fn,
+                )
+                self.multi_eval_step = make_multi_eval_step(
+                    self.model, self.config.train.loss, loss_fn=self._loss_fn
+                )
+            else:
+                from gnot_tpu.parallel import mesh as mesh_lib
+
+                self.multi_train_step = mesh_lib.make_sharded_multi_train_step(
+                    self.model, self.config.optim, self.config.train.loss,
+                    self.mesh, self.state, loss_fn=self._loss_fn,
+                )
+                self.multi_eval_step = mesh_lib.make_sharded_multi_eval_step(
+                    self.model, self.config.train.loss, self.mesh, self.state,
+                    loss_fn=self._loss_fn,
+                )
+        return self.state
+
+    def standard_params(self):
+        """Current params in the standard ``block_i`` layout (unstacks
+        the pipeline layout when the mesh carries ``pipe > 1``) — the
+        layout predict / torch export / the reference weight mapping
+        expect. Single-process only: multi-process callers must gather
+        first (``gathered_standard_params``), because unstacking indexes
+        eagerly into arrays that may not be fully addressable here."""
+        return self._unstack_if_pipelined(self.state.params)
+
+    def gathered_standard_params(self):
+        """Multi-process variant: allgather the global param values onto
+        every host (collective — ALL processes must call together), then
+        unstack. Gather happens on the stacked tree; eager indexing into
+        a non-fully-addressable sharded array would raise."""
+        from jax.experimental import multihost_utils
+
+        # tiled=True: gather each array's GLOBAL value (the default
+        # stacks a per-process leading axis and rejects global inputs).
+        params = multihost_utils.process_allgather(self.state.params, tiled=True)
+        return self._unstack_if_pipelined(params)
+
+    def _unstack_if_pipelined(self, params):
+        if "blocks" in params:
+            from gnot_tpu.parallel import pipeline
+
+            params = pipeline.unstack_params(
+                params, self.model.config.n_attn_layers
+            )
+        return params
+
+    def _device_batch(self, batch: MeshBatch, *, stacked: bool = False) -> MeshBatch:
+        """Place a host batch for the step: sharded over the mesh when
+        distributed (cross-host assembly on multi-process runs).
+        ``stacked=True`` for K-step stacked batches."""
+        if self.mesh is None:
+            return batch
+        from gnot_tpu.parallel import mesh as mesh_lib, multihost
+
+        if jax.process_count() > 1:
+            return multihost.global_batch(self.mesh, batch, stacked=stacked)
+        return mesh_lib.shard_batch(self.mesh, batch, stacked=stacked)
+
+    def evaluate(self) -> float:
+        if len(self.test_loader) == 0:
+            # No test set: nothing to select a best checkpoint on
+            # (np.mean([]) would propagate NaN into best-metric logic).
+            return float("inf")
+        # The SAME grouping iterator as the train loop (group_batches;
+        # all-singles when steps_per_dispatch is 1 or the multi builder
+        # is absent). In multi-process mode each batch is assembled
+        # globally (_device_batch -> global_batch), so every process
+        # computes the same full-test metric — no cross-host
+        # aggregation needed.
+        k = (
+            self.config.train.steps_per_dispatch
+            if self.multi_eval_step is not None
+            else 1
+        )
+        # Ragged distributed test set: the final batch was padded with
+        # repeats of the last sample (__init__); peel it off the grouped
+        # iteration and score it per-sample so the repeats drop out. The
+        # loader doesn't shuffle, so the tail is the last batch; divert
+        # it while streaming (keeps the prefetch overlap — no list()).
+        it = iter(self.test_loader)
+        n_full = len(self.test_loader) - (1 if self._eval_tail else 0)
+        metrics: list[np.ndarray] = []
+        for kind, item in group_batches(itertools.islice(it, n_full), k):
+            if kind == "group":
+                metrics.append(
+                    np.asarray(
+                        self.multi_eval_step(
+                            self.state.params,
+                            self._device_batch(stack_batches(item), stacked=True),
+                        )
+                    )
+                )
+            else:
+                metrics.append(
+                    np.asarray(
+                        self.eval_step(self.state.params, self._device_batch(item))
+                    )
+                )
+        if self._eval_tail:
+            per = np.asarray(
+                self._tail_eval_step(
+                    self.state.params, self._device_batch(next(it))
+                )
+            )
+            # The global batch concatenates per-host batches in process
+            # order; each host contributed _eval_tail real samples then
+            # repeats. Mean over the real rows == the batch-mean the
+            # single-device ragged tail batch would produce.
+            bs = self.config.data.batch_size
+            real = np.concatenate(
+                [
+                    np.arange(p * bs, p * bs + self._eval_tail)
+                    for p in range(jax.process_count())
+                ]
+            )
+            metrics.append(np.mean(per[real]))
+        return float(np.mean(np.concatenate([np.atleast_1d(m) for m in metrics])))
+
+    def predict(self, samples) -> list[np.ndarray]:
+        """Inference: per-sample UNPADDED model outputs ``[n_i, out_dim]``.
+
+        A capability the reference lacks entirely (it writes
+        ``best_model.pth`` and never reads it back, main.py:149-151;
+        there is no inference entry point). Batches are padded/masked
+        like eval; padding rows are sliced off before returning, so
+        callers see exactly the ragged mesh they passed in. On a mesh,
+        the tail batch is filled with repeats of the last sample so
+        every batch shards evenly; the repeats are dropped on return.
+
+        Multi-process runs: the forward runs SHARDED on the mesh —
+        params stay in their mesh layout (no host-side
+        ``process_allgather``, which would not scale past toy sizes);
+        only the output is replicated (an on-device collective over
+        ICI). ALL processes must call predict together with the same
+        samples: each host feeds its contiguous slice of every global
+        batch and every process returns the full predictions.
+        """
+        multiproc = jax.process_count() > 1
+        if self.state is None:
+            self.initialize()
+        if self._forward is None:
+            model = self.model
+            if "blocks" in self.state.params:
+                # Stacked layout (scan_layers / pipeline): run the
+                # stacked forward on the params as-is — no unstack, and
+                # no re-paying the per-depth compile that scan_layers
+                # exists to avoid. Pipe-sharded block stacks gather
+                # on-device under GSPMD (an ICI all-gather of ~MBs,
+                # not a host collective).
+                from gnot_tpu.parallel.pipeline import stacked_forward
+
+                mc = model.config
+                fwd = lambda params, batch: stacked_forward(mc, params, batch)
+            else:
+                fwd = lambda params, batch: apply_batch(model, params, batch)
+            if self.mesh is not None:
+                # Replicate the output so every host can read the full
+                # prediction rows (multiproc) / no cross-shard fetches
+                # are needed (single-process mesh).
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                self._forward = jax.jit(
+                    fwd, out_shardings=NamedSharding(self.mesh, PartitionSpec())
+                )
+            else:
+                self._forward = jax.jit(fwd)
+        forward = self._forward
+        params = self.state.params
+
+        samples = list(samples)
+        n_real = len(samples)
+        bs = self.config.data.batch_size
+        # Fixed pad lengths were captured from the training data; an
+        # unseen longer mesh cannot be packed into them — fail with the
+        # limit instead of a cryptic broadcast error from the packer.
+        pn, pf = self.train_loader.pad_nodes, self.train_loader.pad_funcs
+        for i, s in enumerate(samples):
+            if pn and s.coords.shape[0] > pn:
+                raise ValueError(
+                    f"predict sample {i} has {s.coords.shape[0]} mesh points "
+                    f"but this trainer's fixed pad length is {pn} (set from "
+                    "the training data); rebuild with larger pad_nodes"
+                )
+            if pf:
+                for j, f in enumerate(s.funcs):
+                    if f.shape[0] > pf:
+                        raise ValueError(
+                            f"predict sample {i} input function {j} has "
+                            f"{f.shape[0]} points but the fixed pad length "
+                            f"is {pf}; rebuild with larger pad_funcs"
+                        )
+        nproc = jax.process_count()
+        if multiproc and self.mesh is None:
+            raise ValueError(
+                "multi-process predict() requires the distributed "
+                "trainer (a mesh) — run with --distributed"
+            )
+        # One dispatch covers `group` sample rows: the global batch
+        # concatenates every host's bs-row slice in process order, so
+        # global row r of dispatch i is samples[i*group + r].
+        group = bs * nproc if self.mesh is not None else bs
+        if self.mesh is not None and n_real % group:
+            samples = samples + [samples[-1]] * (group - n_real % group)
+        if multiproc:
+            p_idx = jax.process_index()
+            loader_samples = []
+            for i in range(0, len(samples), group):
+                loader_samples.extend(samples[i + p_idx * bs : i + (p_idx + 1) * bs])
+        else:
+            loader_samples = samples
+        loader = Loader(
+            loader_samples,
+            bs,
+            bucket=self.config.data.bucket,
+            pad_nodes=self.train_loader.pad_nodes,
+            pad_funcs=self.train_loader.pad_funcs,
+        )
+        outs: list[np.ndarray] = []
+        for bi, batch in enumerate(loader):
+            # Multi-process: _device_batch assembles the global batch
+            # from the per-host slices; the forward runs sharded and
+            # returns the replicated [group, L, out] prediction.
+            db = self._device_batch(batch)
+            out = np.asarray(forward(params, db))
+            for j in range(out.shape[0]):
+                idx = bi * group + j
+                outs.append(out[j, : samples[idx].coords.shape[0]])
+        return outs[:n_real]
+
+    def evaluate_from_checkpoint(self) -> float:
+        """Restore the best checkpoint and run eval only — the load path
+        the reference never had (it writes best_model.pth and never
+        reads it back, main.py:149-151)."""
+        if self.checkpointer is None:
+            raise ValueError("eval-only mode needs --checkpoint_dir")
+        if self.state is None:
+            self.initialize()
+        restored = self.checkpointer.restore_best(self.state)
+        if restored is None:
+            raise FileNotFoundError(
+                f"no best checkpoint under {self.checkpointer.directory}"
+            )
+        self.state, epoch, best = restored
+        res = self.evaluate()
+        print(f"Eval (best checkpoint from epoch {epoch}): {res}")
+        return res
+
+    def fit(self) -> float:
+        if self.state is None:
+            self.initialize()
+        cfg = self.config
+        # Trace the second executed epoch (warm jit caches), or the only
+        # one if the run has a single epoch.
+        trace_at = min(self.start_epoch + 1, cfg.train.epochs - 1)
+        for epoch in range(self.start_epoch, cfg.train.epochs):
+            # Shuffle order is a function of (seed, epoch): resumed runs
+            # replay the continuous run's batch order exactly.
+            self.train_loader.set_epoch(epoch)
+            t0 = time.perf_counter()
+            losses, points = [], 0
+            k_dis = cfg.train.steps_per_dispatch
+
+            def run_single(batch):
+                lr = self.lr_fn(self.host_step, epoch)
+                self.state, loss = self.train_step(
+                    self.state,
+                    self._device_batch(batch),
+                    jnp.asarray(lr, jnp.float32),
+                )
+                self.host_step += 1
+                losses.append(loss)
+                if cfg.train.debug_checks and not np.isfinite(
+                    float(np.asarray(loss))
+                ):
+                    # Deterministic guard (jax_debug_nans does not
+                    # reliably fire on warm jit paths); the
+                    # sync-per-step cost is the debug-build trade.
+                    raise FloatingPointError(
+                        f"non-finite train loss at epoch {epoch}, "
+                        f"step {self.host_step}"
+                    )
+                if (
+                    self.metrics_sink is not None
+                    and cfg.train.log_every
+                    and self.host_step % cfg.train.log_every == 0
+                ):
+                    # float(loss) syncs; per-step logging is opt-in
+                    # and meant for coarse cadences.
+                    self.metrics_sink.log(
+                        step=self.host_step,
+                        epoch=epoch,
+                        loss=float(np.asarray(loss)),
+                        lr=lr,
+                    )
+
+            def run_group(group):
+                # One dispatch for len(group) steps: stacked batches +
+                # per-step LRs scanned on device (make_multi_train_step).
+                lrs = [
+                    self.lr_fn(self.host_step + i, epoch)
+                    for i in range(len(group))
+                ]
+                self.state, loss_k = self.multi_train_step(
+                    self.state,
+                    self._device_batch(stack_batches(group), stacked=True),
+                    jnp.asarray(lrs, dtype=jnp.float32),
+                )
+                start = self.host_step
+                self.host_step += len(group)
+                losses.append(loss_k)
+                if cfg.train.debug_checks and not np.all(
+                    np.isfinite(np.asarray(loss_k))
+                ):
+                    raise FloatingPointError(
+                        f"non-finite train loss at epoch {epoch}, "
+                        f"steps {start + 1}..{self.host_step}"
+                    )
+                if self.metrics_sink is not None and cfg.train.log_every:
+                    host_lk = None
+                    for i in range(len(group)):
+                        s = start + i + 1
+                        if s % cfg.train.log_every == 0:
+                            if host_lk is None:
+                                host_lk = np.asarray(loss_k)  # one sync
+                            self.metrics_sink.log(
+                                step=s,
+                                epoch=epoch,
+                                loss=float(host_lk[i]),
+                                lr=lrs[i],
+                            )
+
+            with profiling.trace_epoch(
+                cfg.train.profile_dir, epoch, trace_at=trace_at
+            ):
+                with profiling.annotate("train_epoch"):
+                    # The SAME grouping iterator evaluate() uses
+                    # (all-singles at k=1).
+                    for kind, item in group_batches(self.train_loader, k_dis):
+                        if kind == "group":
+                            points += sum(b.n_real_points for b in item)
+                            run_group(item)
+                        else:
+                            points += item.n_real_points
+                            run_single(item)
+                train_loss = float(
+                    np.mean(
+                        np.concatenate(
+                            [np.atleast_1d(np.asarray(l)) for l in losses]
+                        )
+                    )
+                ) if losses else float("nan")
+                dt = time.perf_counter() - t0
+                # Reference's exact console line (main.py:105).
+                print(f"Epoch {epoch}, Loss: {train_loss}")
+
+                with profiling.annotate("eval_epoch"):
+                    res = self.evaluate()
+            print(f"Epoch {epoch}, Test Metric: {res}")
+            print("-----------------------------------")
+
+            if self.metrics_sink is not None:
+                self.metrics_sink.log(
+                    epoch=epoch,
+                    train_loss=train_loss,
+                    test_metric=res,  # sink serializes non-finite as null
+                    lr=self.lr_fn(self.host_step, epoch),
+                    points_per_sec=points / dt,
+                    epoch_seconds=dt,
+                )
+            if res < self.best_metric:
+                self.best_metric = res
+                if self.checkpointer is not None:
+                    self.checkpointer.save_best(self.state, epoch, self.best_metric)
+            if self.checkpointer is not None and (
+                cfg.train.checkpoint_every
+                and (epoch + 1) % cfg.train.checkpoint_every == 0
+            ):
+                self.checkpointer.save_latest(self.state, epoch + 1, self.best_metric)
+            if (
+                cfg.train.stop_after_epoch
+                and epoch + 1 >= cfg.train.stop_after_epoch
+            ):
+                # Simulated preemption (fault injection): exit the loop
+                # cleanly; the final wait() below commits in-flight saves.
+                print(f"Stopping after epoch {epoch} (--stop_after_epoch)")
+                break
+
+        if self.checkpointer is not None:
+            self.checkpointer.wait()  # flush in-flight async saves
+        print(f"\nBest Test Metric: {self.best_metric}")
+        return self.best_metric

@@ -1,0 +1,59 @@
+"""Persistent XLA compile cache.
+
+JAX ships a content-addressed compilation cache but leaves it OFF by
+default; first compiles here are expensive (30-90s per program over a
+remote-device tunnel), so the CLI enables it by default at a per-user
+path. Per-user matters: a world-shared /tmp dir would fail for the
+second user on a shared machine and mean executing artifacts another
+user could write. The test suite (tests/conftest.py) uses the same
+location, so CLI runs and tests share warm entries.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+def default_cache_dir() -> str:
+    home = os.path.expanduser("~")
+    if os.path.isabs(home):
+        return os.path.join(
+            os.environ.get("XDG_CACHE_HOME") or os.path.join(home, ".cache"),
+            "gnot_jax_cache",
+        )
+    # Stripped container env without HOME: uid-scoped tmp fallback.
+    return os.path.join(tempfile.gettempdir(), f"gnot_jax_cache_{os.getuid()}")
+
+
+def enable_compile_cache(path: str | None = None) -> str:
+    """Turn the persistent cache on (call before tracing). Returns the
+    cache path in effect ("" when disabled).
+
+    Resolution order for a default (``path=None``) call:
+    ``GNOT_COMPILE_CACHE`` env (``off``/empty disables, a path
+    overrides; ``GNOT_TEST_CACHE`` accepted as an alias) → an
+    already-configured ``jax_compilation_cache_dir`` (e.g. a hermetic
+    test path — in-process ``main()`` calls must not silently redirect
+    it) → the per-user default. The env override is what makes
+    ``GNOT_COMPILE_CACHE=off`` give genuinely clean-compile runs even
+    through code paths that enable the cache themselves."""
+    import jax
+
+    if path is None:
+        env = os.environ.get("GNOT_COMPILE_CACHE")
+        if env is None:
+            env = os.environ.get("GNOT_TEST_CACHE")
+        if env is not None and env.strip() in ("off", ""):
+            return ""
+        if env:
+            path = env
+        else:
+            existing = getattr(jax.config, "jax_compilation_cache_dir", None)
+            if existing:
+                return existing
+            path = default_cache_dir()
+    jax.config.update("jax_compilation_cache_dir", path)
+    # Cache everything that took meaningful compile time.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    return path

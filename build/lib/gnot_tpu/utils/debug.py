@@ -1,0 +1,39 @@
+"""Debug-build numeric guards (SURVEY.md §5 race-detection note: the
+reference is single-threaded with nothing to race; the TPU-native
+equivalent of sanitizers is ``checkify`` for NaN/inf/OOB inside jit).
+
+``checked(fn)`` wraps a jittable function so NaN/inf inside it raises
+with a location, instead of silently propagating through the compiled
+program; pass ``errors=checkify.all_checks`` to add div-by-zero and
+out-of-bounds index checks (expensive at trace time on large
+programs). Debug builds only — the checks block fusion and cost real
+throughput.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax.experimental import checkify
+
+
+def checked(fn: Callable, *, jit: bool = True, errors=None) -> Callable:
+    """Returns ``fn`` instrumented with numeric checks; the wrapper
+    raises ``checkify.JaxRuntimeError`` on the first violation.
+
+    ``errors`` defaults to float checks (NaN/inf) — the practical guard
+    for a training step. ``checkify.all_checks`` adds index/div checks
+    but multiplies compile time on large models."""
+    err_fn = checkify.checkify(
+        fn, errors=checkify.float_checks if errors is None else errors
+    )
+    if jit:
+        err_fn = jax.jit(err_fn)
+
+    def wrapper(*args, **kwargs):
+        err, out = err_fn(*args, **kwargs)
+        checkify.check_error(err)
+        return out
+
+    return wrapper

@@ -1,0 +1,52 @@
+"""Structured metrics sinks.
+
+The reference logs via three ``print`` lines per epoch
+(``/root/reference/main.py:105,147-148``). The trainer keeps those exact
+console lines for diffability; this module adds structured JSONL metrics
+(loss, LR, throughput, step time) on top — SURVEY.md §5 observability.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import time
+from typing import Any, TextIO
+
+import numpy as np
+
+
+class MetricsSink:
+    """Append-only JSONL metrics writer."""
+
+    def __init__(self, path: str):
+        self.path = path
+        if d := os.path.dirname(path):
+            os.makedirs(d, exist_ok=True)
+        self._fh: TextIO = open(path, "a", buffering=1)
+
+    def log(self, **record: Any) -> None:
+        record.setdefault("ts", time.time())
+        # json.dumps would emit bare NaN/Infinity tokens (invalid JSON)
+        # for non-finite floats — e.g. a diverged loss or the inf metric
+        # of an empty test set — and rejects numpy scalars outright, so
+        # coerce numpy scalars to Python first, then null non-finites.
+        def coerce(v):
+            if isinstance(v, np.floating):
+                return float(v)
+            if isinstance(v, np.integer):
+                return int(v)
+            if isinstance(v, np.bool_):
+                return bool(v)
+            return v
+
+        record = {k: coerce(v) for k, v in record.items()}
+        record = {
+            k: (None if isinstance(v, float) and not math.isfinite(v) else v)
+            for k, v in record.items()
+        }
+        self._fh.write(json.dumps(record) + "\n")
+
+    def close(self) -> None:
+        self._fh.close()

@@ -1,0 +1,33 @@
+"""Tracing / profiling hooks (SURVEY.md §5: the reference has none —
+its only timing signal is per-epoch prints, ``/root/reference/main.py:105``).
+
+TPU-native equivalent: ``jax.profiler`` traces viewable in
+Perfetto/XProf/TensorBoard. ``trace_epoch`` wraps one epoch in a trace
+when a profile directory is configured; ``annotate`` marks named spans
+inside a traced region so train/eval phases are distinguishable on the
+timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+
+@contextlib.contextmanager
+def trace_epoch(profile_dir: str, epoch: int, *, trace_at: int = 1):
+    """Trace epoch ``trace_at`` into ``profile_dir``. Callers pick
+    ``trace_at`` past the first executed epoch when they can, to keep
+    compile noise out of the trace (see Trainer.fit). No-op when
+    ``profile_dir`` is empty."""
+    if not profile_dir or epoch != trace_at:
+        yield
+        return
+    with jax.profiler.trace(profile_dir):
+        yield
+
+
+def annotate(name: str):
+    """Named span on the profiler timeline (context manager)."""
+    return jax.profiler.TraceAnnotation(name)

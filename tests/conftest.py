@@ -3,6 +3,14 @@ initializes, so sharding/parallelism tests run without TPU hardware
 (SURVEY.md §4: the standard way to test multi-chip TPU code)."""
 
 import os
+import sys
+
+# Importable from any cwd without an install: the package root is the
+# directory above tests/ (an editable `pip install -e .` makes this a
+# no-op — pyproject.toml is the installed path).
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _ROOT not in sys.path:
+    sys.path.insert(0, _ROOT)
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

@@ -62,6 +62,10 @@ def generate(n_train: int, n_test: int, seed: int = 0) -> None:
     for path, n in ((TRAIN_PKL, n_train), (TEST_PKL, n_test)):
         t0 = time.time()
         save_pickle(make(n), path)
+        # Sidecar count: lets train() stamp the true scale in its
+        # summary without re-unpickling the ~150 MB file.
+        with open(path + ".count", "w") as f:
+            f.write(str(n))
         print(
             f"{path}: {n} samples, {os.path.getsize(path)/1e6:.0f} MB "
             f"({time.time()-t0:.0f}s)"
@@ -69,12 +73,18 @@ def generate(n_train: int, n_test: int, seed: int = 0) -> None:
 
 
 def train(args) -> None:
-    from gnot_tpu.data.datasets import load_pickle
     from gnot_tpu.main import main as cli_main
 
     # The ACTUAL scale trained on (not the --n_train the generate step
     # may or may not have used) — the artifact test pins this field.
-    n_train_actual = len(load_pickle(TRAIN_PKL))
+    # Prefer the generate() sidecar; fall back to counting the pickle.
+    try:
+        with open(TRAIN_PKL + ".count") as f:
+            n_train_actual = int(f.read())
+    except (OSError, ValueError):
+        from gnot_tpu.data.datasets import load_pickle
+
+        n_train_actual = len(load_pickle(TRAIN_PKL))
     out = args.out
     metrics = "/tmp/ref_scale_metrics.jsonl"
     if os.path.exists(metrics):
