@@ -68,9 +68,11 @@ PEAK_FLOPS = {
 }
 
 
-def build_data(step_dtype: str, n_points: int, batch_size: int, config: str, attention_impl: str = "xla", ffn_impl: str = "xla", remat: bool = False):
+def build_data(step_dtype: str, n_points: int, batch_size: int, config: str, attention_impl: str = "xla", ffn_impl: str = "xla", remat: bool = False, model_overrides: dict | None = None):
     """One padded batch + the reference-default ModelConfig
-    (main.py:16-22) for the given workload — no jax state."""
+    (main.py:16-22) for the given workload — no jax state.
+    ``model_overrides`` replaces ModelConfig fields (e.g. a deeper
+    ``n_attn_layers`` for layout A/Bs)."""
     from gnot_tpu.config import ModelConfig
     from gnot_tpu.data import datasets
     from gnot_tpu.data.batch import Loader
@@ -92,31 +94,49 @@ def build_data(step_dtype: str, n_points: int, batch_size: int, config: str, att
         ffn_impl=ffn_impl,
         remat=remat,
         **datasets.infer_model_dims(samples),
+        **(model_overrides or {}),
     )
     return next(iter(Loader(samples, batch_size))), mc
 
 
-def build(step_dtype: str, attention_impl: str = "xla", n_points: int = 1024, batch_size: int = 4, ffn_impl: str = "xla", config: str = "ns2d", remat: bool = False):
+def build(step_dtype: str, attention_impl: str = "xla", n_points: int = 1024, batch_size: int = 4, ffn_impl: str = "xla", config: str = "ns2d", remat: bool = False, flat_params: bool = False, model_overrides: dict | None = None):
     from gnot_tpu.config import OptimConfig
     from gnot_tpu.models.gnot import GNOT
-    from gnot_tpu.train.trainer import init_state, make_train_step
+    from gnot_tpu.train.trainer import (
+        flat_loss_fn,
+        init_flat_state,
+        init_state,
+        make_train_step,
+    )
 
     batch, mc = build_data(
-        step_dtype, n_points, batch_size, config, attention_impl, ffn_impl, remat
+        step_dtype, n_points, batch_size, config, attention_impl, ffn_impl,
+        remat, model_overrides,
     )
     model = GNOT(mc)
-    optim = OptimConfig()
-    state = init_state(model, optim, batch, seed=0)
-    step = make_train_step(model, optim, "rel_l2")
+    optim = OptimConfig(flat_params=flat_params)
+    if flat_params:
+        state, unravel = init_flat_state(model, optim, batch, seed=0)
+        step = make_train_step(
+            model, optim, "rel_l2",
+            loss_fn=flat_loss_fn(model, unravel, "rel_l2"),
+        )
+    else:
+        state = init_state(model, optim, batch, seed=0)
+        step = make_train_step(model, optim, "rel_l2")
     return step, state, batch, mc
 
 
 def _hard_sync(state, loss) -> None:
     """Force completion with real device->host transfers. On remote
     tunnels, ``block_until_ready`` has been observed returning before
-    the program finishes; a data fetch cannot lie."""
+    the program finishes; a data fetch cannot lie. The param fetch is
+    ONE element sliced on-device — fetching the whole leaf would ship
+    it through the tunnel (the flat [P] layout's first leaf is the
+    entire ~37 MB param buffer, which once cost ~7 s per timed window
+    and buried the marginal under transfer noise)."""
     np.asarray(loss)
-    np.asarray(jax.tree.leaves(state.params)[0]).ravel()[0]
+    np.asarray(jax.tree.leaves(state.params)[0].ravel()[0])
 
 
 def _scan_program(step):
@@ -303,6 +323,11 @@ def main():
     )
     p.add_argument("--remat", action="store_true", help="rematerialized backward")
     p.add_argument(
+        "--flat_params", action="store_true",
+        help="flat [P]-vector parameter/optimizer layout (fused AdamW "
+             "update — docs/performance.md)"
+    )
+    p.add_argument(
         "--mem_stats", action="store_true",
         help="also print the device's peak-memory stats as JSON on stderr "
              "(keeps the stdout one-line contract)"
@@ -318,7 +343,7 @@ def main():
 
     step, state, batch, _ = build(
         args.dtype, args.attention_impl, args.n_points, args.batch_size,
-        args.ffn_impl, args.config, args.remat,
+        args.ffn_impl, args.config, args.remat, args.flat_params,
     )
     if timing == "scan_marginal":
         sec_per_step = time_scan_marginal(

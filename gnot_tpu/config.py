@@ -129,6 +129,17 @@ class OptimConfig:
     # trailing window, and windows straddling epoch boundaries make
     # per-epoch eval observe mid-window params.
     grad_accum: int = 1
+    # Flat [P]-vector parameter/optimizer layout: params (and the AdamW
+    # moments) live as ONE ravelled f32 buffer; the forward unravels it
+    # into the param tree (slices/reshapes XLA folds away). The per-op
+    # profile (docs/performance.md) attributes ~2 us of launch overhead
+    # to EACH of the ~184 per-leaf optimizer ops plus per-leaf
+    # while-carry copy plumbing; the flat layout fuses the whole update
+    # into a few whole-buffer ops. Same math (ravel/unravel is exact).
+    # Composes with the data/seq mesh axes (params stay one replicated
+    # buffer); incompatible with model/expert/pipe sharding and
+    # scan_layers, which need the tree layout.
+    flat_params: bool = False
 
     def __post_init__(self) -> None:
         if self.grad_accum < 1:

@@ -100,6 +100,13 @@ def build_parser() -> argparse.ArgumentParser:
              "memory, ~1 extra forward of FLOPs — for long point clouds)"
     )
     p.add_argument(
+        "--flat_params", action="store_true",
+        help="flat [P]-vector parameter/optimizer layout: the AdamW "
+             "update fuses into a few whole-buffer ops instead of ~2 "
+             "per param leaf (same math; composes with the data/seq "
+             "mesh axes only — see docs/performance.md)"
+    )
+    p.add_argument(
         "--scan_layers", action="store_true",
         help="run the block stack as one lax.scan over stacked per-layer "
              "params: XLA compiles one block regardless of depth (the "
@@ -195,6 +202,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "data.bucket": not args.no_bucket and args.attention_mode != "parity",
             "optim.lr": args.lr,
             "optim.grad_accum": args.grad_accum,
+            "optim.flat_params": args.flat_params,
             "optim.parity_schedule_bug": args.schedule == "parity",
             "train.epochs": args.epochs,
             "train.loss": args.loss,
@@ -438,6 +446,11 @@ def main(argv=None) -> float:
                 "gelu": mc.gelu,
                 "attention_mode": mc.attention_mode,
                 "dtype": mc.dtype,
+                # State LAYOUT provenance (not numerics): a flat-layout
+                # checkpoint restores only into a flat-layout trainer
+                # (orbax restores by structure), so the mismatch warning
+                # names the flag to flip instead of an opaque tree error.
+                "flat_params": args.flat_params,
             },
         )
     trainer = Trainer(

@@ -145,7 +145,28 @@ class Checkpointer:
             if k in meta and meta[k] != v
         }
         missing = [k for k in self.extra_meta if k not in meta]
+        # State-LAYOUT provenance is its own message: a flat/tree
+        # mismatch is not a numerics drift, and the orbax restore below
+        # will fail on it with a tree-structure error — name the flag
+        # first.
+        layout_mismatch = mismatch.pop("flat_params", None)
+        if "flat_params" in missing:
+            # Sidecars predating layout provenance are tree-layout
+            # checkpoints; only a flat-layout run needs the warning.
+            missing.remove("flat_params")
+            if self.extra_meta.get("flat_params"):
+                layout_mismatch = (False, True)
         if jax.process_index() == 0:
+            if layout_mismatch is not None:
+                ck, cur = layout_mismatch
+                print(
+                    f"warning: '{name}' checkpoint was saved in the "
+                    f"{'flat [P]-vector' if ck else 'standard tree'} state "
+                    f"layout but this run uses the "
+                    f"{'flat' if cur else 'tree'} layout — restore will "
+                    "fail with a tree-structure mismatch; "
+                    f"{'pass' if ck else 'drop'} --flat_params to match"
+                )
             if mismatch:
                 detail = ", ".join(
                     f"{k}: checkpoint={a!r} current={b!r}"

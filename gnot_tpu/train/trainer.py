@@ -228,8 +228,8 @@ def group_batches(batches, k: int):
         yield "single", p
 
 
-def init_state(model: GNOT, optim_cfg: OptimConfig, sample_batch: MeshBatch, seed: int) -> TrainState:
-    params = model.init(
+def init_params(model: GNOT, sample_batch: MeshBatch, seed: int):
+    return model.init(
         jax.random.key(seed),
         sample_batch.coords,
         sample_batch.theta,
@@ -237,10 +237,52 @@ def init_state(model: GNOT, optim_cfg: OptimConfig, sample_batch: MeshBatch, see
         node_mask=sample_batch.node_mask,
         func_mask=sample_batch.func_mask,
     )["params"]
+
+
+def init_state(model: GNOT, optim_cfg: OptimConfig, sample_batch: MeshBatch, seed: int) -> TrainState:
+    params = init_params(model, sample_batch, seed)
     tx = make_optimizer(optim_cfg, optim_cfg.lr)
     return TrainState(
         params=params, opt_state=tx.init(params), step=jnp.zeros((), jnp.int32)
     )
+
+
+def init_flat_state(
+    model: GNOT, optim_cfg: OptimConfig, sample_batch: MeshBatch, seed: int
+):
+    """Flat [P]-vector state layout (``optim.flat_params``): params and
+    AdamW moments are ONE ravelled buffer each, so the optimizer update
+    compiles to a few whole-buffer ops instead of ~2 per param leaf
+    (the measured ~2 us/op launch overhead — docs/performance.md "Where
+    the other 55% goes"). Returns ``(state, unravel)``; ``unravel`` maps
+    the flat vector back to the param tree (exact — pure
+    slices/reshapes, so gradients through it are a concat of the leaf
+    gradients and the math is unchanged)."""
+    from jax.flatten_util import ravel_pytree
+
+    params = init_params(model, sample_batch, seed)
+    flat, unravel = ravel_pytree(params)
+    tx = make_optimizer(optim_cfg, optim_cfg.lr)
+    return (
+        TrainState(
+            params=flat, opt_state=tx.init(flat), step=jnp.zeros((), jnp.int32)
+        ),
+        unravel,
+    )
+
+
+def flat_loss_fn(
+    model: GNOT, unravel, loss_name: str, *, per_sample: bool = False
+) -> Callable:
+    """loss_fn for the flat [P]-vector layout: unravel, then the
+    standard forward + pooled loss."""
+    table = PER_SAMPLE_LOSSES if per_sample else LOSSES
+
+    def loss_fn(p, batch: MeshBatch):
+        preds = apply_batch(model, unravel(p), batch)
+        return table[loss_name](preds, batch.y, batch.node_mask)
+
+    return loss_fn
 
 
 def param_count(params) -> int:
@@ -354,17 +396,28 @@ class Trainer:
             and not (self.mesh is not None and self.mesh.shape.get("pipe", 1) > 1)
             else None
         )
-        if self.mesh is None:
-            self.train_step = make_train_step(
-                self.model, config.optim, config.train.loss, loss_fn=self._loss_fn
-            )
-            self.eval_step = make_eval_step(
-                self.model, config.train.loss, loss_fn=self._loss_fn
-            )
-        else:
-            # Built lazily in initialize(): the sharded jits need the
-            # state's sharding layout.
-            self.train_step = self.eval_step = None
+        self._flat = config.optim.flat_params
+        self._unravel = None  # set by initialize() in flat mode
+        if self._flat:
+            if model_cfg.scan_layers:
+                raise ValueError(
+                    "flat_params and scan_layers both re-lay-out the "
+                    "params (flat buffer vs stacked blocks) and do not "
+                    "compose; pick one"
+                )
+            if self.mesh is not None and any(
+                self.mesh.shape.get(a, 1) > 1 for a in ("model", "expert", "pipe")
+            ):
+                raise ValueError(
+                    "flat_params keeps the params as one replicated "
+                    "buffer and composes with the data/seq mesh axes "
+                    "only; set mesh model=expert=pipe=1"
+                )
+        # All step builders live in initialize(): the sharded jits need
+        # the state's sharding layout, the flat jits need the unravel fn
+        # (a function of the initialized param tree's shapes), and one
+        # build site keeps the loss_fn wiring in one place.
+        self.train_step = self.eval_step = None
         if (
             config.optim.grad_accum > 1
             and len(self.train_loader) % config.optim.grad_accum
@@ -426,6 +479,14 @@ class Trainer:
                 self.model, self.config.optim, sample, self.config.train.seed
             )
             already_sharded = False
+        elif self._flat:
+            self.state, self._unravel = init_flat_state(
+                self.model, self.config.optim, sample, self.config.train.seed
+            )
+            self._loss_fn = flat_loss_fn(
+                self.model, self._unravel, self.config.train.loss
+            )
+            already_sharded = False
         else:
             self.state = init_state(
                 self.model, self.config.optim, sample, self.config.train.seed
@@ -445,6 +506,14 @@ class Trainer:
             if restored is not None:
                 self.state, self.start_epoch, self.best_metric = restored
                 self.host_step = int(self.state.step)  # one-time sync
+        if self.mesh is None:
+            self.train_step = make_train_step(
+                self.model, self.config.optim, self.config.train.loss,
+                loss_fn=self._loss_fn,
+            )
+            self.eval_step = make_eval_step(
+                self.model, self.config.train.loss, loss_fn=self._loss_fn
+            )
         if self.mesh is not None:
             from gnot_tpu.parallel import mesh as mesh_lib
 
@@ -460,13 +529,17 @@ class Trainer:
             if self._eval_tail:
                 # Per-sample metric vector for the repeat-padded tail
                 # batch; evaluate() slices the real rows on the host.
-                tail_loss_fn = (
-                    stacked_loss_fn(
+                if self._flat:
+                    tail_loss_fn = flat_loss_fn(
+                        self.model, self._unravel, self.config.train.loss,
+                        per_sample=True,
+                    )
+                elif self._loss_fn is not None:
+                    tail_loss_fn = stacked_loss_fn(
                         self.model.config, self.config.train.loss, per_sample=True
                     )
-                    if self._loss_fn is not None
-                    else None
-                )
+                else:
+                    tail_loss_fn = None
                 self._tail_eval_step = mesh_lib.make_sharded_eval_step(
                     self.model, self.config.train.loss, self.mesh, self.state,
                     self.config.mesh.microbatches, loss_fn=tail_loss_fn,
@@ -501,6 +574,8 @@ class Trainer:
         expect. Single-process only: multi-process callers must gather
         first (``gathered_standard_params``), because unstacking indexes
         eagerly into arrays that may not be fully addressable here."""
+        if self._flat:
+            return self._unravel(self.state.params)
         return self._unstack_if_pipelined(self.state.params)
 
     def gathered_standard_params(self):
@@ -513,6 +588,8 @@ class Trainer:
         # tiled=True: gather each array's GLOBAL value (the default
         # stacks a per-process leading axis and rejects global inputs).
         params = multihost_utils.process_allgather(self.state.params, tiled=True)
+        if self._flat:
+            return self._unravel(params)
         return self._unstack_if_pipelined(params)
 
     def _unstack_if_pipelined(self, params):
@@ -620,7 +697,12 @@ class Trainer:
             self.initialize()
         if self._forward is None:
             model = self.model
-            if "blocks" in self.state.params:
+            if self._flat:
+                unravel = self._unravel
+                fwd = lambda params, batch: apply_batch(
+                    model, unravel(params), batch
+                )
+            elif "blocks" in self.state.params:
                 # Stacked layout (scan_layers / pipeline): run the
                 # stacked forward on the params as-is — no unstack, and
                 # no re-paying the per-depth compile that scan_layers

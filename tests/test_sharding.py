@@ -285,3 +285,41 @@ def test_sharded_multi_step_matches_single_device():
         np.testing.assert_allclose(
             np.asarray(a), np.asarray(jax.device_get(b)), rtol=2e-4, atol=2e-5
         )
+
+
+@pytest.mark.parametrize(
+    "mesh_cfg",
+    [MeshConfig(data=8), MeshConfig(data=4, seq=2)],
+    ids=["pure DP", "DP x SP"],
+)
+def test_flat_params_sharded_step_matches_single_device(mesh_cfg):
+    """The flat [P]-vector layout composes with the data/seq mesh axes:
+    params are one replicated buffer (P(None) pspec), batch shards as
+    usual, and the step matches the single-device flat step."""
+    from gnot_tpu.train.trainer import flat_loss_fn, init_flat_state
+
+    model = GNOT(SMALL)
+    optim = OptimConfig(flat_params=True)
+    batch = make_batch()
+    state, unravel = init_flat_state(model, optim, batch, seed=0)
+    loss_fn = flat_loss_fn(model, unravel, "rel_l2")
+
+    single = make_train_step(model, optim, "rel_l2", loss_fn=loss_fn)
+    state1, loss1 = single(
+        jax.tree.map(jnp.copy, state), batch, jnp.asarray(1e-3, jnp.float32)
+    )
+
+    mesh = mesh_lib.make_mesh(mesh_cfg)
+    sharded_state = mesh_lib.shard_state(mesh, state)
+    step = mesh_lib.make_sharded_train_step(
+        model, optim, "rel_l2", mesh, sharded_state, loss_fn=loss_fn
+    )
+    sharded_batch = mesh_lib.shard_batch(mesh, batch)
+    state2, loss2 = step(sharded_state, sharded_batch, jnp.asarray(1e-3, jnp.float32))
+
+    np.testing.assert_allclose(float(loss1), float(loss2), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(state1.params),
+        np.asarray(jax.device_get(state2.params)),
+        rtol=2e-4, atol=2e-5,
+    )

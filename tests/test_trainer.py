@@ -377,3 +377,153 @@ def test_scan_layers_with_steps_per_dispatch(capsys):
     b_both, out_both = run({"scan_layers": None, "steps_per_dispatch": 2})
     np.testing.assert_allclose(b_plain, b_both, rtol=1e-5)
     assert_epoch_lines_close(out_plain, out_both, rtol=1e-5)
+
+
+def test_flat_params_step_matches_standard():
+    """The flat [P]-vector layout is the SAME math: N training steps
+    from the same init produce (near-)identical losses and params —
+    ravel/unravel is exact and AdamW is elementwise, so only XLA
+    fusion differences remain."""
+    import jax
+    import jax.numpy as jnp
+
+    from gnot_tpu.models.gnot import GNOT
+    from gnot_tpu.data.batch import Loader
+    from gnot_tpu.train.trainer import (
+        flat_loss_fn,
+        init_flat_state,
+        init_state,
+        make_train_step,
+    )
+
+    cfg, mc, train, _ = small_setup(epochs=1)
+    model = GNOT(mc)
+    batch = next(iter(Loader(train, cfg.data.batch_size)))
+    s_std = init_state(model, cfg.optim, batch, seed=0)
+    s_flat, unravel = init_flat_state(model, cfg.optim, batch, seed=0)
+    step_std = make_train_step(model, cfg.optim, cfg.train.loss)
+    step_flat = make_train_step(
+        model, cfg.optim, cfg.train.loss,
+        loss_fn=flat_loss_fn(model, unravel, cfg.train.loss),
+    )
+    lr = jnp.asarray(1e-3, jnp.float32)
+    for _ in range(3):
+        s_std, loss_std = step_std(s_std, batch, lr)
+        s_flat, loss_flat = step_flat(s_flat, batch, lr)
+        np.testing.assert_allclose(
+            float(loss_std), float(loss_flat), rtol=1e-6
+        )
+    import jax as _jax
+
+    _jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        s_std.params,
+        unravel(s_flat.params),
+    )
+
+
+def test_flat_params_fit_matches_standard(capsys):
+    """Trainer end-to-end with --flat_params: same console losses,
+    same final params (via standard_params), same predictions."""
+    from helpers import assert_epoch_lines_close
+
+    def run(extra):
+        cfg, mc, train, test = small_setup(epochs=3, **extra)
+        t = Trainer(cfg, mc, train, test)
+        best = t.fit()
+        return t, test, best, capsys.readouterr().out
+
+    t_std, test_s, b_std, out_std = run({})
+    t_flat, _, b_flat, out_flat = run({"flat_params": None})
+    np.testing.assert_allclose(b_std, b_flat, rtol=1e-5)
+    assert_epoch_lines_close(out_std, out_flat, rtol=1e-5)
+    import jax
+
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5
+        ),
+        t_std.standard_params(),
+        t_flat.standard_params(),
+    )
+    for a, b in zip(t_std.predict(test_s[:3]), t_flat.predict(test_s[:3])):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+def test_flat_params_steps_per_dispatch_matches(capsys):
+    """flat_params threads through the K-step scanned dispatch path."""
+    from helpers import assert_epoch_lines_close
+
+    def run(extra):
+        cfg, mc, train, test = small_setup(
+            epochs=2, n_train=8, n_test=4, batch_size=2, **extra
+        )
+        best = Trainer(cfg, mc, train, test).fit()
+        return best, capsys.readouterr().out
+
+    b_plain, out_plain = run({})
+    b_flat, out_flat = run({"flat_params": None, "steps_per_dispatch": 2})
+    np.testing.assert_allclose(b_plain, b_flat, rtol=1e-5)
+    assert_epoch_lines_close(out_plain, out_flat, rtol=1e-5)
+
+
+def test_flat_params_checkpoint_resume(tmp_path):
+    """Flat-layout TrainStates round-trip through Orbax save/resume."""
+    from gnot_tpu.train.checkpoint import Checkpointer
+
+    cfg, mc, train, test = small_setup(
+        epochs=2, checkpoint_dir=str(tmp_path / "ckpt"), checkpoint_every=1,
+        flat_params=None,
+    )
+    t1 = Trainer(cfg, mc, train, test, checkpointer=Checkpointer(cfg.train.checkpoint_dir))
+    t1.fit()
+
+    import dataclasses
+
+    cfg2 = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, resume=True, epochs=2)
+    )
+    t2 = Trainer(cfg2, mc, train, test, checkpointer=Checkpointer(cfg.train.checkpoint_dir))
+    t2.initialize()
+    assert t2.start_epoch == 2
+    np.testing.assert_array_equal(
+        np.asarray(t2.state.params), np.asarray(t1.state.params)
+    )
+
+
+def test_flat_params_rejects_incompatible_layouts():
+    """flat_params needs the tree layout's absence: scan_layers and
+    param-sharding mesh axes raise at construction with named flags."""
+    cfg, mc, train, test = small_setup(epochs=1, flat_params=None, scan_layers=None)
+    with pytest.raises(ValueError, match="flat_params"):
+        Trainer(cfg, mc, train, test)
+
+    cfg, mc, train, test = small_setup(
+        epochs=1, flat_params=None, distributed=None, mesh_model="2", mesh_data="4",
+    )
+    with pytest.raises(ValueError, match="flat_params"):
+        Trainer(cfg, mc, train, test)
+
+
+def test_flat_params_checkpoint_layout_warning(tmp_path, capsys):
+    """Restoring a flat-layout checkpoint into a tree-layout run warns
+    with the flag to flip BEFORE orbax's structure error surfaces."""
+    from gnot_tpu.train.checkpoint import Checkpointer
+
+    cfg, mc, train, test = small_setup(epochs=1, flat_params=None)
+    t = Trainer(cfg, mc, train, test)
+    t.initialize()
+    ck = Checkpointer(str(tmp_path / "ckpt"), extra_meta={"flat_params": True})
+    ck.save_latest(t.state, 1, 0.5)
+    ck.wait()
+
+    cfg2, mc2, train2, test2 = small_setup(epochs=1)
+    t2 = Trainer(cfg2, mc2, train2, test2)
+    t2.initialize()
+    ck2 = Checkpointer(str(tmp_path / "ckpt"), extra_meta={"flat_params": False})
+    with pytest.raises(Exception):
+        ck2.restore_latest(t2.state)
+    out = capsys.readouterr().out
+    assert "--flat_params" in out and "layout" in out

@@ -34,19 +34,30 @@ def main():
     p.add_argument("--k2", type=int, default=100)
     p.add_argument("--windows", type=int, default=3)
     p.add_argument("--remat", action="store_true")
+    p.add_argument("--flat_params", action="store_true",
+                   help="flat [P]-vector state layout")
+    p.add_argument("--n_attn_layers", type=int, default=0,
+                   help="override depth (0 = reference default)")
     args = p.parse_args()
 
     import bench
 
+    overrides = (
+        {"n_attn_layers": args.n_attn_layers} if args.n_attn_layers else None
+    )
     step, state, batch, mc = bench.build(
         args.dtype, args.attention_impl, args.n_points, args.batch_size,
-        args.ffn_impl, args.config, args.remat,
+        args.ffn_impl, args.config, args.remat, args.flat_params, overrides,
     )
     per = bench.time_scan_marginal(
         step, state, batch, jnp.asarray(1e-3, jnp.float32), jax.devices()[0],
         args.k1, args.k2, args.windows,
     )
     label = f"{args.dtype} attn={args.attention_impl} ffn={args.ffn_impl} {args.config}"
+    if args.n_attn_layers:
+        label += f" layers={args.n_attn_layers}"
+    if args.flat_params:
+        label += " flat"
     print(
         f"{label}: {per * 1e3:.2f} ms/step  "
         f"{batch.n_real_points / per / 1e6:.3f}M pts/s"

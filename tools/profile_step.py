@@ -49,7 +49,13 @@ def main():
     p.add_argument("--trace_dir", default="/tmp/gnot_profile")
     p.add_argument("--out", default="docs/artifacts/profile_breakdown.json")
     p.add_argument("--top", type=int, default=25)
+    p.add_argument("--flat_params", action="store_true",
+                   help="profile the flat [P]-vector state layout")
     args = p.parse_args()
+    if args.flat_params and args.out == p.get_default("out"):
+        # Layout-suffixed default: never clobber the committed
+        # tree-layout artifact with flat-layout numbers.
+        args.out = args.out.replace(".json", "_flat.json")
 
     import jax
     import jax.numpy as jnp
@@ -57,7 +63,8 @@ def main():
     import bench
 
     step, state, batch, _ = bench.build(args.dtype, config=args.config,
-                                        n_points=args.n_points)
+                                        n_points=args.n_points,
+                                        flat_params=args.flat_params)
     lr = jnp.asarray(1e-3, jnp.float32)
     multi = bench._scan_program(step)
     copy_tree = jax.jit(lambda s: jax.tree.map(jnp.copy, s))
@@ -109,6 +116,7 @@ def main():
         "workload": {
             "config": args.config, "dtype": args.dtype, "k_steps": args.k,
             "n_points": args.n_points, "batch": 4,
+            "flat_params": args.flat_params,
         },
         "device": jax.devices()[0].device_kind,
         "module_total_ms_per_step": module_ps / 1e6 / args.k,
