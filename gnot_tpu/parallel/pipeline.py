@@ -91,24 +91,17 @@ def convert_state_layout(state, n_layers: int, to: str):
     if to not in ("stacked", "standard"):
         raise ValueError(f"unknown layout {to!r}")
 
-    def convert(node):
+    def rule(node):
         if isinstance(node, dict):
             if to == "stacked" and "block_0" in node:
                 return stack_params(node, n_layers)
             if to == "standard" and "blocks" in node:
                 return unstack_params(node, n_layers)
-            return {k: convert(v) for k, v in node.items()}
-        if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
-            return type(node)(*(convert(v) for v in node))
-        if isinstance(node, (tuple, list)):
-            return type(node)(convert(v) for v in node)
-        return node
+        return None
 
-    import dataclasses as _dc
+    from gnot_tpu.train.trainer import map_state_containers
 
-    return _dc.replace(
-        state, params=convert(state.params), opt_state=convert(state.opt_state)
-    )
+    return map_state_containers(state, rule)
 
 
 # ---------------------------------------------------------------------------

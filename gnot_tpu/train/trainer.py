@@ -271,37 +271,23 @@ def init_flat_state(
     )
 
 
-def convert_flat_state(state: TrainState, params_template, to: str) -> TrainState:
-    """Convert a full TrainState between the flat ``[P]``-vector layout
-    and the standard tree layout — INCLUDING the optimizer moments
-    (and MultiSteps accumulators), whose trees mirror the params — so a
-    checkpoint written by a ``--flat_params`` run can be resumed by a
-    standard run and vice versa (the flat counterpart of
-    ``pipeline.convert_state_layout``). ``params_template`` is a params
-    tree with the target structure/shapes (e.g. ``init_params(...)`` or
-    a restored tree). Operates on host/device values; no-op leaves pass
-    through."""
+def map_state_containers(state: TrainState, rule: Callable) -> TrainState:
+    """Rebuild a TrainState's ``params``/``opt_state`` by recursing
+    through their containers (dicts, optax NamedTuple states,
+    tuples/lists) and applying ``rule`` at every node: the first
+    non-None result replaces that subtree, anything else passes
+    through. THE one traversal both layout converters share
+    (``convert_flat_state`` here, ``pipeline.convert_state_layout``) —
+    optimizer moments mirror the param tree, so a layout change is
+    always "find the param-shaped subtrees wherever optax nested them
+    and rewrite each"."""
     import dataclasses
 
-    from jax.flatten_util import ravel_pytree
-
-    if to not in ("flat", "tree"):
-        raise ValueError(f"unknown layout {to!r} (want 'flat' or 'tree')")
-    flat_t, unravel = ravel_pytree(params_template)
-    size = flat_t.size
-    pstruct = jax.tree_util.tree_structure(params_template)
-
     def convert(node):
-        if (
-            to == "tree"
-            and hasattr(node, "ndim")
-            and node.ndim == 1
-            and node.size == size
-        ):
-            return unravel(node)
+        out = rule(node)
+        if out is not None:
+            return out
         if isinstance(node, dict):
-            if to == "flat" and jax.tree_util.tree_structure(node) == pstruct:
-                return ravel_pytree(node)[0]
             return {k: convert(v) for k, v in node.items()}
         if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
             return type(node)(*(convert(v) for v in node))
@@ -312,6 +298,43 @@ def convert_flat_state(state: TrainState, params_template, to: str) -> TrainStat
     return dataclasses.replace(
         state, params=convert(state.params), opt_state=convert(state.opt_state)
     )
+
+
+def convert_flat_state(state: TrainState, params_template, to: str) -> TrainState:
+    """Convert a full TrainState between the flat ``[P]``-vector layout
+    and the standard tree layout — INCLUDING the optimizer moments
+    (and MultiSteps accumulators), whose trees mirror the params — so a
+    checkpoint written by a ``--flat_params`` run can be resumed by a
+    standard run and vice versa (the flat counterpart of
+    ``pipeline.convert_state_layout``). ``params_template`` is a params
+    tree with the target structure/shapes (e.g. ``init_params(...)`` or
+    a restored tree). Operates on host/device values; no-op leaves pass
+    through."""
+    from jax.flatten_util import ravel_pytree
+
+    if to not in ("flat", "tree"):
+        raise ValueError(f"unknown layout {to!r} (want 'flat' or 'tree')")
+    flat_t, unravel = ravel_pytree(params_template)
+    size = flat_t.size
+    pstruct = jax.tree_util.tree_structure(params_template)
+
+    def rule(node):
+        if (
+            to == "tree"
+            and hasattr(node, "ndim")
+            and node.ndim == 1
+            and node.size == size
+        ):
+            return unravel(node)
+        if (
+            to == "flat"
+            and isinstance(node, dict)
+            and jax.tree_util.tree_structure(node) == pstruct
+        ):
+            return ravel_pytree(node)[0]
+        return None
+
+    return map_state_containers(state, rule)
 
 
 def flat_loss_fn(

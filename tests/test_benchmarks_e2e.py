@@ -254,3 +254,17 @@ def test_heatsink3d_16k_long_context_artifact():
     assert len(epochs) >= 40
     assert all(np.isfinite(r["train_loss"]) for r in epochs)
     assert epochs[-1]["test_metric"] < 0.2 * epochs[0]["test_metric"]
+
+
+def test_heatsink3d_64k_long_context_artifact():
+    """L=65536 single-chip convergence (round 5): 4x the 16k artifact's
+    sequence length, B=1 --remat --dtype bfloat16 — the remat memory
+    lever (3.1x activation reduction measured at exactly this shape)
+    carries a REAL training run, not just a memory analysis."""
+    epochs = [
+        r for r in _load_jsonl_artifact("heatsink3d_64k_convergence.jsonl")
+        if "train_loss" in r
+    ]
+    assert len(epochs) >= 40
+    assert all(np.isfinite(r["train_loss"]) for r in epochs)
+    assert min(r["test_metric"] for r in epochs) < 0.2 * epochs[0]["test_metric"]
