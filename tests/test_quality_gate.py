@@ -277,3 +277,27 @@ def test_full_scale_quality_ab_rerun(tmp_path):
     t_best = min(_series(by, "torch", "parity_f32").values())
     j_best = min(_series(by, "jax", "parity_f32").values())
     assert abs(j_best - t_best) / t_best < 0.01
+
+
+def test_ns2d_60_epoch_artifact_resolves_variant_noise():
+    """Round 5 follow-up to the ns2d 24-epoch scatter: at 60 epochs
+    (docs/artifacts/quality_ab_ns2d_60ep.jsonl, same protocol) every
+    masked TPU variant beats the torch oracle outright and the parity
+    series still tracks it — the 24-epoch straddle was trajectory
+    noise, not a numerics defect."""
+    by = _load_ab("quality_ab_ns2d_60ep.jsonl")
+    # Every series must be complete — a truncated oracle would make
+    # the beats-the-oracle assertions below trivially true.
+    for backend, variant in (("torch", "parity_f32"), ("jax", "parity_f32")):
+        assert len(_series(by, backend, variant)) >= 60
+    torch_best = min(_series(by, "torch", "parity_f32").values())
+    parity_best = min(_series(by, "jax", "parity_f32").values())
+    # Parity class stays inside the BASELINE 1% gate at 2.5x the
+    # gate's horizon (divergence grows with steps; it still doesn't).
+    assert abs(parity_best - torch_best) / torch_best < 0.01
+    for variant in ("masked_erf_f32", "masked_tanh_f32", "masked_tanh_bf16"):
+        v = min(_series(by, "jax", variant).values())
+        assert v < torch_best, (
+            f"{variant} best {v} did not beat the 60-epoch oracle {torch_best}"
+        )
+        assert len(_series(by, "jax", variant)) >= 60

@@ -635,3 +635,60 @@ def test_convert_flat_state_roundtrip_continues_training():
         s_tree.params,
         s_conv.params,
     )
+
+
+def test_convert_flat_state_with_grad_accum_state():
+    """The conversion docstring's MultiSteps claim: acc_grads (a
+    param-shaped tree nested inside MultiStepsState) crosses layouts
+    too, mid-accumulation-window."""
+    import jax
+    import jax.numpy as jnp
+
+    from gnot_tpu.models.gnot import GNOT
+    from gnot_tpu.data.batch import Loader
+    from gnot_tpu.train.trainer import (
+        convert_flat_state,
+        flat_loss_fn,
+        init_flat_state,
+        init_params,
+        make_train_step,
+    )
+
+    cfg, mc, train, _ = small_setup(epochs=1)
+    import dataclasses
+
+    optim = dataclasses.replace(cfg.optim, grad_accum=2, grad_clip_norm=1.0)
+    model = GNOT(mc)
+    batch = next(iter(Loader(train, cfg.data.batch_size)))
+    template = init_params(model, batch, seed=0)
+    s_flat, unravel = init_flat_state(model, optim, batch, seed=0)
+    step_flat = make_train_step(
+        model, optim, cfg.train.loss,
+        loss_fn=flat_loss_fn(model, unravel, cfg.train.loss),
+    )
+    # ONE step: mid-window, acc_grads holds a nonzero accumulator —
+    # assert it, or a window-accounting change could silently turn this
+    # into an all-zeros conversion that tests nothing.
+    s_flat, _ = step_flat(s_flat, batch, jnp.asarray(1e-3, jnp.float32))
+    size = np.asarray(s_flat.params).size
+    mid_window = [
+        leaf
+        for leaf in jax.tree.leaves(s_flat.opt_state)
+        if np.ndim(leaf) == 1 and np.size(leaf) == size and np.any(leaf)
+    ]
+    assert mid_window, "expected a nonzero param-shaped accumulator mid-window"
+
+    tree = convert_flat_state(s_flat, template, "tree")
+    # Every param-shaped piece (params + moments + accumulators) is now
+    # tree-structured: no 1-D size-P leaf survives anywhere.
+    for leaf in jax.tree.leaves(tree):
+        assert not (np.ndim(leaf) == 1 and np.size(leaf) == size)
+    rt = convert_flat_state(tree, template, "flat")
+    assert jax.tree_util.tree_structure(rt) == jax.tree_util.tree_structure(
+        s_flat
+    )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        rt,
+        s_flat,
+    )
