@@ -68,6 +68,24 @@ PEAK_FLOPS = {
 }
 
 
+def _gen_samples(config: str, n_points: int, batch_size: int):
+    """Synthetic samples for a bench config — THE one size mapping
+    (darcy2d is a square grid, so n_points maps to the nearest grid
+    edge; pass 4096 for the BASELINE configs[0] 64x64 grid). Both the
+    padded and the packed builders draw from here so A/Bs compare the
+    same samples."""
+    from gnot_tpu.data import datasets
+
+    gen_kwargs = {
+        "ns2d": {"n_points": n_points},
+        "darcy2d": {"grid_n": max(2, int(n_points**0.5))},
+        "elasticity": {"base_points": n_points},
+        "inductor2d": {"base_points": n_points},
+        "heatsink3d": {"base_points": n_points},
+    }[config]
+    return datasets.SYNTHETIC[config](batch_size, seed=0, **gen_kwargs)
+
+
 def build_data(step_dtype: str, n_points: int, batch_size: int, config: str, attention_impl: str = "xla", ffn_impl: str = "xla", remat: bool = False, model_overrides: dict | None = None):
     """One padded batch + the reference-default ModelConfig
     (main.py:16-22) for the given workload — no jax state.
@@ -77,17 +95,7 @@ def build_data(step_dtype: str, n_points: int, batch_size: int, config: str, att
     from gnot_tpu.data import datasets
     from gnot_tpu.data.batch import Loader
 
-    # Size knobs per synthetic generator; darcy2d is a square grid, so
-    # n_points maps to the nearest grid edge (pass 4096 for the
-    # BASELINE configs[0] 64x64 grid).
-    gen_kwargs = {
-        "ns2d": {"n_points": n_points},
-        "darcy2d": {"grid_n": max(2, int(n_points**0.5))},
-        "elasticity": {"base_points": n_points},
-        "inductor2d": {"base_points": n_points},
-        "heatsink3d": {"base_points": n_points},
-    }[config]
-    samples = datasets.SYNTHETIC[config](batch_size, seed=0, **gen_kwargs)
+    samples = _gen_samples(config, n_points, batch_size)
     mc = ModelConfig(
         dtype=step_dtype,
         attention_impl=attention_impl,
@@ -99,7 +107,7 @@ def build_data(step_dtype: str, n_points: int, batch_size: int, config: str, att
     return next(iter(Loader(samples, batch_size))), mc
 
 
-def build(step_dtype: str, attention_impl: str = "xla", n_points: int = 1024, batch_size: int = 4, ffn_impl: str = "xla", config: str = "ns2d", remat: bool = False, flat_params: bool = False, model_overrides: dict | None = None):
+def build(step_dtype: str, attention_impl: str = "xla", n_points: int = 1024, batch_size: int = 4, ffn_impl: str = "xla", config: str = "ns2d", remat: bool = False, flat_params: bool = False, model_overrides: dict | None = None, packed: bool = False, pack_chunk: int = 128):
     from gnot_tpu.config import OptimConfig
     from gnot_tpu.models.gnot import GNOT
     from gnot_tpu.train.trainer import (
@@ -107,12 +115,26 @@ def build(step_dtype: str, attention_impl: str = "xla", n_points: int = 1024, ba
         init_flat_state,
         init_state,
         make_train_step,
+        packed_loss_fn,
     )
 
     batch, mc = build_data(
         step_dtype, n_points, batch_size, config, attention_impl, ffn_impl,
         remat, model_overrides,
     )
+    if packed and flat_params:
+        raise ValueError(
+            "packed + flat_params not composed (the Trainer rejects the "
+            "combination too); pick one"
+        )
+    if packed:
+        # "Pack, don't pad": rebuild the same samples as ONE packed
+        # dispatch (multiple segments per row) — pts/s stays comparable
+        # because the metric counts REAL points either way.
+        from gnot_tpu.data.batch import PackedLoader
+
+        samples = _gen_samples(config, n_points, batch_size)
+        batch = PackedLoader(samples, batch_size, chunk=pack_chunk).probe_batch()
     model = GNOT(mc)
     optim = OptimConfig(flat_params=flat_params)
     if flat_params:
@@ -120,6 +142,11 @@ def build(step_dtype: str, attention_impl: str = "xla", n_points: int = 1024, ba
         step = make_train_step(
             model, optim, "rel_l2",
             loss_fn=flat_loss_fn(model, unravel, "rel_l2"),
+        )
+    elif packed:
+        state = init_state(model, optim, batch, seed=0)
+        step = make_train_step(
+            model, optim, "rel_l2", loss_fn=packed_loss_fn(model, "rel_l2")
         )
     else:
         state = init_state(model, optim, batch, seed=0)
@@ -328,6 +355,13 @@ def main():
              "update — docs/performance.md)"
     )
     p.add_argument(
+        "--packed", action="store_true",
+        help="packed ragged batching ('pack, don't pad' — multiple "
+             "samples per row as chunk-aligned segments)"
+    )
+    p.add_argument("--pack_chunk", type=int, default=128,
+                   help="packed segment alignment (tokens)")
+    p.add_argument(
         "--mem_stats", action="store_true",
         help="also print the device's peak-memory stats as JSON on stderr "
              "(keeps the stdout one-line contract)"
@@ -344,6 +378,7 @@ def main():
     step, state, batch, _ = build(
         args.dtype, args.attention_impl, args.n_points, args.batch_size,
         args.ffn_impl, args.config, args.remat, args.flat_params,
+        packed=args.packed, pack_chunk=args.pack_chunk,
     )
     if timing == "scan_marginal":
         sec_per_step = time_scan_marginal(

@@ -52,6 +52,36 @@ class MeshBatch:
         return int(np.sum(np.asarray(self.node_mask)))
 
 
+@flax.struct.dataclass
+class PackedBatch:
+    """A PACKED batch: multiple samples share each row as chunk-aligned
+    contiguous segments ("pack, don't pad"). Ragged meshes stop paying
+    bucket-padding FLOPs (~30% of tokens on the ragged benchmark
+    configs); the linear attention stays exactly per-sample via segment
+    Grams (ops.attention.packed_normalized_linear_attention).
+
+    Shapes: R rows, L row length (multiple of the chunk size C),
+    N = L/C chunks per row, S static sample-slot count, F input
+    functions, Lf function pad length. Input functions are NOT packed —
+    they stay slot-indexed ``[F, S, Lf, df]`` (each slot-row is one
+    one-chunk segment), which reuses the per-sample K/V layout and
+    keeps the packer trivial; node tokens dominate the FLOPs."""
+
+    coords: np.ndarray  # [R, L, dx]
+    theta: np.ndarray  # [S, T] per-sample params (slot-indexed)
+    y: np.ndarray  # [R, L, dy]
+    node_mask: np.ndarray  # [R, L]
+    node_seg: np.ndarray  # [R, N] int32 chunk->slot ids; pad chunks = S
+    funcs: np.ndarray | None = None  # [F, S, Lf, df]
+    func_mask: np.ndarray | None = None  # [F, S, Lf]
+    func_seg: np.ndarray | None = None  # [S, 1] slot ids (S for empty slots)
+    n_seg: int = flax.struct.field(pytree_node=False, default=0)
+
+    @property
+    def n_real_points(self) -> int:
+        return int(np.sum(np.asarray(self.node_mask)))
+
+
 @dataclasses.dataclass
 class MeshSample:
     """One ragged sample: ``[X, Y, theta, (f1, f2, ...)]`` — the pickle
@@ -155,6 +185,241 @@ def collate(
     )
 
 
+def pack_collate(
+    samples: Sequence[MeshSample],
+    placements: Sequence[tuple[int, int]],
+    *,
+    n_rows: int,
+    row_len: int,
+    chunk: int,
+    n_slots: int,
+    pad_funcs: int,
+) -> PackedBatch:
+    """Assemble one PackedBatch from samples + their (row, offset)
+    placements (offsets chunk-aligned; produced by ``PackedLoader``).
+    Slot ids are assignment order; unused rows/slots stay zero/pad."""
+    dx = samples[0].coords.shape[-1]
+    dy = samples[0].y.shape[-1]
+    n_funcs = len(samples[0].funcs)
+    coords = np.zeros((n_rows, row_len, dx), np.float32)
+    y = np.zeros((n_rows, row_len, dy), np.float32)
+    node_mask = np.zeros((n_rows, row_len), np.float32)
+    node_seg = np.full((n_rows, row_len // chunk), n_slots, np.int32)
+    theta = np.zeros((n_slots, np.atleast_1d(samples[0].theta).shape[-1]), np.float32)
+    funcs = func_mask = func_seg = None
+    if n_funcs:
+        df = samples[0].funcs[0].shape[-1]
+        funcs = np.zeros((n_funcs, n_slots, pad_funcs, df), np.float32)
+        func_mask = np.zeros((n_funcs, n_slots, pad_funcs), np.float32)
+        func_seg = np.full((n_slots, 1), n_slots, np.int32)
+    for slot, (s, (r, off)) in enumerate(zip(samples, placements)):
+        n = s.coords.shape[0]
+        coords[r, off : off + n] = s.coords
+        y[r, off : off + n] = s.y
+        node_mask[r, off : off + n] = 1.0
+        node_seg[r, off // chunk : (off + n + chunk - 1) // chunk] = slot
+        theta[slot] = np.atleast_1d(np.asarray(s.theta, np.float32))
+        for j, f in enumerate(s.funcs):
+            funcs[j, slot, : f.shape[0]] = f
+            func_mask[j, slot, : f.shape[0]] = 1.0
+        if n_funcs:
+            func_seg[slot, 0] = slot
+    return PackedBatch(
+        coords=coords, theta=theta, y=y, node_mask=node_mask,
+        node_seg=node_seg, funcs=funcs, func_mask=func_mask,
+        func_seg=func_seg, n_seg=n_slots,
+    )
+
+
+class PackedLoader:
+    """Epoch iterator over PACKED batches: the epoch's (shuffled) sample
+    stream is first-fit packed into rows of one fixed length, then R
+    consecutive rows form each dispatch — every dispatch has ONE static
+    shape and rows fill to ~90%+ instead of the ~70% bucket-padding
+    utilization on ragged meshes. ``batch_size`` keeps its meaning as
+    the NOMINAL samples per step (row count R is derived so a dispatch
+    carries ~batch_size samples on average); the actual per-dispatch
+    sample count varies with packing, like the reference's ragged final
+    batch does."""
+
+    def __init__(
+        self,
+        samples: Sequence[MeshSample],
+        batch_size: int,
+        *,
+        chunk: int = 128,
+        shuffle: bool = False,
+        seed: int = 0,
+        prefetch: int = 2,
+    ):
+        if not samples:
+            raise ValueError("PackedLoader needs at least one sample")
+        self.samples = list(samples)
+        self.batch_size = batch_size
+        self.chunk = chunk
+        self.shuffle = shuffle
+        self.seed = seed
+        self.prefetch = prefetch
+        self._epoch = 0
+        aligned = [
+            -(-s.coords.shape[0] // chunk) * chunk for s in self.samples
+        ]
+        self._aligned = aligned
+        max_a, min_a = max(aligned), min(aligned)
+        # Row length: ~2 max-size samples per row, bucketed for a clean
+        # XLA shape, rounded to the chunk grid.
+        row = bucket_length(2 * max_a)
+        self.row_len = -(-row // chunk) * chunk
+        mean_a = float(np.mean(aligned))
+        self.n_rows = max(1, -(-int(batch_size * mean_a) // self.row_len))
+        # Static slot capacity: no R-row window can carry more samples.
+        self.n_slots = self.n_rows * (self.row_len // min_a)
+        self.pad_funcs = max(
+            (f.shape[0] for s in self.samples for f in s.funcs), default=0
+        )
+        if self.pad_funcs:
+            self.pad_funcs = bucket_length(self.pad_funcs)
+        # Standard-loader attribute compatibility (predict() reads these
+        # to build its unpacked inference loader).
+        self.pad_nodes = 0
+        self.bucket = True
+        self._canonical_len: int | None = None
+
+    def set_epoch(self, epoch: int) -> None:
+        self._epoch = epoch
+
+    def probe_batch(self) -> PackedBatch:
+        """One canonical (unshuffled) dispatch for shape probing — does
+        not advance the epoch counter."""
+        epoch, shuffle = self._epoch, self.shuffle
+        self.shuffle = False
+        try:
+            d = self._epoch_dispatches()[0]
+        finally:
+            self._epoch, self.shuffle = epoch, shuffle
+        return self._collate_at(d)
+
+    def _epoch_dispatches(self):
+        order = np.arange(len(self.samples))
+        if self.shuffle:
+            np.random.default_rng((self.seed, self._epoch)).shuffle(order)
+        self._epoch += 1
+        # First-fit packing with OPEN bins (each sample goes into the
+        # first row it fits; rows whose remaining space can't fit any
+        # sample are closed) — measured ~86-89% fill on the ragged
+        # configs vs ~70% for bucket padding and ~76% for the naive
+        # one-open-row scheme.
+        min_a = min(self._aligned)
+        open_rows: list[list] = []  # [used, [(sample_idx, offset)]]
+        closed: list[list] = []
+        for i in order:
+            a = self._aligned[i]
+            for rb in open_rows:
+                if rb[0] + a <= self.row_len:
+                    rb[1].append((int(i), rb[0]))
+                    rb[0] += a
+                    break
+            else:
+                open_rows.append([a, [(int(i), 0)]])
+            open_rows, newly_closed = (
+                [rb for rb in open_rows if self.row_len - rb[0] >= min_a],
+                [rb for rb in open_rows if self.row_len - rb[0] < min_a],
+            )
+            closed.extend(newly_closed)
+        rows = [rb[1] for rb in closed + open_rows]
+        # Group R rows per dispatch.
+        dispatches = []
+        for start in range(0, len(rows), self.n_rows):
+            group = rows[start : start + self.n_rows]
+            idx = [i for row in group for i, _ in row]
+            placements = [
+                (r, off) for r, row in enumerate(group) for _, off in row
+            ]
+            dispatches.append((idx, placements))
+        return dispatches
+
+    def __len__(self) -> int:
+        # EXACT dispatch count for the canonical (unshuffled) stream —
+        # computed by actually packing it once, since first-fit
+        # fragmentation can need a row group more than total/row_len
+        # predicts. Unshuffled loaders (eval) iterate exactly this many
+        # dispatches; a shuffled epoch can still differ by ±1 (callers
+        # that must not truncate iterate exhaustively — see
+        # Trainer.evaluate).
+        if self._canonical_len is None:
+            epoch, shuffle = self._epoch, self.shuffle
+            self.shuffle = False
+            try:
+                self._canonical_len = len(self._epoch_dispatches())
+            finally:
+                self._epoch, self.shuffle = epoch, shuffle
+        return self._canonical_len
+
+    def _collate_at(self, dispatch) -> PackedBatch:
+        idx, placements = dispatch
+        return pack_collate(
+            [self.samples[i] for i in idx],
+            placements,
+            n_rows=self.n_rows,
+            row_len=self.row_len,
+            chunk=self.chunk,
+            n_slots=self.n_slots,
+            pad_funcs=self.pad_funcs,
+        )
+
+    def __iter__(self):
+        yield from _prefetched(
+            self._epoch_dispatches(), self._collate_at, self.prefetch
+        )
+
+
+def _prefetched(items, collate_fn, prefetch: int):
+    """Collate ``items`` on a background thread with a bounded queue so
+    the host packs batch N+1 while the device executes batch N — THE
+    one prefetch pipeline both loaders share. ``prefetch <= 0`` (or a
+    single item) degrades to synchronous collation."""
+    if prefetch <= 0 or len(items) <= 1:
+        for it in items:
+            yield collate_fn(it)
+        return
+
+    q: queue.Queue = queue.Queue(maxsize=prefetch)
+    stop = threading.Event()
+    _END = object()
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for it in items:
+                if not put(collate_fn(it)):
+                    return  # consumer abandoned the epoch
+            put(_END)
+        except BaseException as e:  # surface worker errors to the consumer
+            put(e)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        t.join()
+
+
 class Loader:
     """Epoch iterator: shuffle, batch, collate, background prefetch.
 
@@ -226,44 +491,6 @@ class Loader:
         )
 
     def __iter__(self) -> Iterator[MeshBatch]:
-        chunks = self._epoch_indices()
-        if self.prefetch <= 0 or len(chunks) <= 1:
-            for idx in chunks:
-                yield self._collate_at(idx)
-            return
-
-        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
-        stop = threading.Event()
-        _END = object()
-
-        def put(item) -> bool:
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        def producer():
-            try:
-                for idx in chunks:
-                    if not put(self._collate_at(idx)):
-                        return  # consumer abandoned the epoch
-                put(_END)
-            except BaseException as e:  # surface worker errors to the consumer
-                put(e)
-
-        t = threading.Thread(target=producer, daemon=True)
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is _END:
-                    break
-                if isinstance(item, BaseException):
-                    raise item
-                yield item
-        finally:
-            stop.set()
-            t.join()
+        yield from _prefetched(
+            self._epoch_indices(), self._collate_at, self.prefetch
+        )

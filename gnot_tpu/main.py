@@ -162,6 +162,19 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--no_bucket", action="store_true", help="pad to per-batch max (parity)")
     p.add_argument(
+        "--packed", action="store_true",
+        help="pack multiple samples per sequence row (chunk-aligned "
+             "segments, exact per-sample attention) instead of padding "
+             "each to the bucket length — recovers the ~30% padding "
+             "waste on ragged configs; masked mode, single device",
+    )
+    p.add_argument(
+        "--pack_chunk", type=int, default=128,
+        help="segment alignment granularity for --packed (tokens); also "
+             "the per-chunk Gram contraction depth — 128 is the "
+             "measured on-chip optimum (docs/performance.md)",
+    )
+    p.add_argument(
         "--distributed", action="store_true",
         help="train over the device mesh (sharded jit; spans hosts when "
              "launched one process per host)"
@@ -200,6 +213,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "data.batch_size": args.batch_size,
             "data.seed": args.seed,
             "data.bucket": not args.no_bucket and args.attention_mode != "parity",
+            "data.packed": args.packed,
+            "data.pack_chunk": args.pack_chunk,
             "optim.lr": args.lr,
             "optim.grad_accum": args.grad_accum,
             "optim.flat_params": args.flat_params,

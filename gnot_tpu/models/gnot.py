@@ -58,6 +58,9 @@ class HNABlock(nn.Module):
         *,
         node_mask: Array | None = None,
         func_mask: Array | None = None,
+        node_seg: Array | None = None,
+        func_seg: Array | None = None,
+        n_seg: int = 0,
     ) -> Array:
         cross = LinearAttention(
             self.n_attn_hidden_dim,
@@ -66,7 +69,10 @@ class HNABlock(nn.Module):
             dtype=self.dtype,
             parity=self.parity,
             name="cross_attention",
-        )(query, input_functions, query_mask=node_mask, func_mask=func_mask)
+        )(
+            query, input_functions, query_mask=node_mask, func_mask=func_mask,
+            q_seg=node_seg, kv_seg=func_seg, n_seg=n_seg,
+        )
         ffn1 = GatedExpertFfn(
             self.n_expert,
             self.n_mlp_num_layers,
@@ -86,7 +92,7 @@ class HNABlock(nn.Module):
             dtype=self.dtype,
             parity=self.parity,
             name="self_attention",
-        )(query, query_mask=node_mask)
+        )(query, query_mask=node_mask, q_seg=node_seg, n_seg=n_seg)
         ffn2 = GatedExpertFfn(
             self.n_expert,
             self.n_mlp_num_layers,
@@ -152,6 +158,15 @@ def query_features(coords: Array, theta: Array) -> Array:
         theta[:, None, :], (coords.shape[0], coords.shape[1], theta.shape[-1])
     )
     return jnp.concatenate([coords, theta_b], axis=-1)
+
+
+def packed_query_features(coords: Array, theta: Array, node_seg: Array) -> Array:
+    """Packed layout: theta is PER-SAMPLE ``[S, T]``; each token gathers
+    its segment's theta (pad tokens clip to slot 0 — they are excluded
+    from attention sums and the loss, so their value is inert)."""
+    tok_seg = jnp.repeat(node_seg, coords.shape[1] // node_seg.shape[1], axis=1)
+    th = jnp.take(theta, jnp.clip(tok_seg, 0, theta.shape[0] - 1), axis=0)
+    return jnp.concatenate([coords, th.astype(coords.dtype)], axis=-1)
 
 
 def x_embed_module(cfg: ModelConfig) -> Mlp:
@@ -239,13 +254,28 @@ class GNOT(nn.Module):
         *,
         node_mask: Array | None = None,
         func_mask: Array | None = None,
+        node_seg: Array | None = None,
+        func_seg: Array | None = None,
+        n_seg: int = 0,
     ) -> Array:
+        """``node_seg``/``func_seg``/``n_seg`` select the PACKED layout
+        ("pack, don't pad" — docs/performance.md): rows carry multiple
+        samples as chunk-aligned segments, ``theta`` is per-sample
+        ``[S, T]``, and attention/losses stay exactly per-sample via
+        segment Grams. Masked mode only."""
+        if node_seg is not None and self.config.attention_mode == "parity":
+            raise ValueError(
+                "packed layout requires attention_mode='masked' (parity "
+                "reproduces the reference's per-batch padding pollution, "
+                "which has no packed equivalent)"
+            )
         if self.config.attention_mode == "parity":
             node_mask = func_mask = None
         with precision_scope(self.config):
             return self._gnot_forward(
                 coords, theta, input_functions,
                 node_mask=node_mask, func_mask=func_mask,
+                node_seg=node_seg, func_seg=func_seg, n_seg=n_seg,
             )
 
     def _gnot_forward(
@@ -256,6 +286,9 @@ class GNOT(nn.Module):
         *,
         node_mask: Array | None,
         func_mask: Array | None,
+        node_seg: Array | None = None,
+        func_seg: Array | None = None,
+        n_seg: int = 0,
     ) -> Array:
         cfg = self.config
 
@@ -263,8 +296,12 @@ class GNOT(nn.Module):
         scores = gating_scores(gating_module(cfg)(coords))
 
         # Query embedding: theta broadcast along L, concat to coords
-        # (model.py:158-161).
-        query = x_embed_module(cfg)(query_features(coords, theta))
+        # (model.py:158-161); packed rows gather per-token theta instead.
+        if node_seg is not None:
+            feats = packed_query_features(coords, theta, node_seg)
+        else:
+            feats = query_features(coords, theta)
+        query = x_embed_module(cfg)(feats)
 
         if cfg.n_input_functions > 0 and input_functions is not None:
             funcs = func_embed_module(cfg)(input_functions)  # [F, B, Lf, D]
@@ -277,6 +314,9 @@ class GNOT(nn.Module):
                 funcs is not None,
                 name=f"block_{i}",
                 remat=cfg.remat,
-            )(scores, query, funcs, node_mask=node_mask, func_mask=func_mask)
+            )(
+                scores, query, funcs, node_mask=node_mask, func_mask=func_mask,
+                node_seg=node_seg, func_seg=func_seg, n_seg=n_seg,
+            )
 
         return finalize_output(out_module(cfg)(query))

@@ -20,6 +20,7 @@ from gnot_tpu.ops.attention import (
     feature_softmax,
     merge_heads,
     normalized_linear_attention,
+    packed_normalized_linear_attention,
     split_heads,
 )
 from gnot_tpu.ops.pallas_ffn import fits_vmem, fused_gated_ffn
@@ -150,7 +151,19 @@ class LinearAttention(nn.Module):
         *,
         query_mask: Array | None = None,
         func_mask: Array | None = None,
+        q_seg: Array | None = None,
+        kv_seg: Array | None = None,
+        n_seg: int = 0,
     ) -> Array:
+        """``q_seg``/``kv_seg``/``n_seg`` switch on the PACKED layout
+        (ops.attention.packed_normalized_linear_attention): chunk->
+        segment ids for the query rows and (cross mode) the separately
+        packed input-function rows ``[F, Bf, Nf]``. Masked mode only —
+        parity's interleaved head merge is packing-hostile by design.
+        """
+        packed = q_seg is not None
+        if packed and self.parity:
+            raise ValueError("packed attention requires parity=False")
         e, h = self.n_embed, self.n_head
         q_proj = torch_dense(e, query.shape[-1], name="query", dtype=self.dtype)(query)
 
@@ -171,9 +184,17 @@ class LinearAttention(nn.Module):
             k = feature_softmax(jax.vmap(lambda t: split_heads(t, h))(k_proj))
             v = jax.vmap(lambda t: split_heads(t, h))(v_proj)
             mask_axis = None if func_mask is None else 0
-            out = jax.vmap(_nla_positional, in_axes=(None, 0, 0, mask_axis))(
-                q, k, v, func_mask
-            )  # [F, B, H, Lq, D]
+            if packed:
+                # kv_seg (the slot-row -> segment map) is SHARED by all
+                # F functions — the stacked funcs tensor is slot-indexed.
+                out = jax.vmap(
+                    functools.partial(_packed_nla_positional, n_seg),
+                    in_axes=(None, 0, 0, mask_axis, None, None),
+                )(q, k, v, func_mask, q_seg, kv_seg)  # [F, Bq, H, Lq, D]
+            else:
+                out = jax.vmap(_nla_positional, in_axes=(None, 0, 0, mask_axis))(
+                    q, k, v, func_mask
+                )  # [F, B, H, Lq, D]
             res = self._merge(q) + self._merge(jnp.mean(out, axis=0))
         else:
             k_proj = torch_dense(e, query.shape[-1], name="key", dtype=self.dtype)(
@@ -185,7 +206,13 @@ class LinearAttention(nn.Module):
             q = feature_softmax(split_heads(q_proj, h))
             k = feature_softmax(split_heads(k_proj, h))
             v = split_heads(v_proj, h)
-            out = normalized_linear_attention(q, k, v, kv_mask=query_mask)
+            if packed:
+                out = packed_normalized_linear_attention(
+                    q, k, v, q_seg=q_seg, kv_seg=q_seg, n_seg=n_seg,
+                    kv_mask=query_mask,
+                )
+            else:
+                out = normalized_linear_attention(q, k, v, kv_mask=query_mask)
             res = self._merge(q) + self._merge(out)
 
         return torch_dense(e, e, name="fc_out", dtype=self.dtype)(res)
@@ -194,6 +221,12 @@ class LinearAttention(nn.Module):
 # vmap of normalized_linear_attention needs mask passed positionally; wrap.
 def _nla_positional(q, k, v, mask):
     return normalized_linear_attention(q, k, v, kv_mask=mask)
+
+
+def _packed_nla_positional(n_seg, q, k, v, mask, q_seg, kv_seg):
+    return packed_normalized_linear_attention(
+        q, k, v, q_seg=q_seg, kv_seg=kv_seg, n_seg=n_seg, kv_mask=mask
+    )
 
 
 class GatedExpertFfn(nn.Module):

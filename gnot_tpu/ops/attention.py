@@ -90,6 +90,88 @@ def normalized_linear_attention(
     return alpha[..., None] * out
 
 
+def packed_normalized_linear_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    q_seg: Array,
+    kv_seg: Array,
+    n_seg: int,
+    kv_mask: Array | None = None,
+) -> Array:
+    """Normalized linear attention over PACKED sequences.
+
+    "Pack, don't pad": multiple samples (segments) share one sequence
+    row, each occupying a contiguous chunk-aligned span, so ragged
+    meshes stop paying bucket-padding FLOPs (~30% of tokens on the
+    ragged benchmark configs). The linear-attention form makes exact
+    per-segment attention cheap: ``k_sum`` and ``k^T v`` are sums over
+    the sequence, so per-CHUNK partial Grams (the same total MXU work
+    as the batched op) scatter-add into per-SEGMENT Grams with a tiny
+    one-hot contraction, and each query chunk gathers its segment's
+    Gram back. No token ever attends across segment boundaries; the
+    result is exactly the per-sample computation (up to fp summation
+    order).
+
+    Args:
+      q: ``[Bq, H, Lq, D]`` feature-softmaxed queries; ``Lq = Nq * C``.
+      k: ``[Bk, H, Lk, D]`` feature-softmaxed keys; ``Lk = Nk * C``.
+        The KEY rows may be a different packing than the query rows
+        (cross-attention packs input functions separately) — segments
+        are global ids shared by both sides.
+      v: ``[Bk, H, Lk, D]`` values.
+      q_seg: ``[Bq, Nq]`` int chunk->segment ids in ``[0, n_seg)``;
+        pad chunks use ``n_seg`` (they scatter/gather into a dropped
+        slot).
+      kv_seg: ``[Bk, Nk]`` likewise for the key/value chunks.
+      n_seg: static segment (sample-slot) count.
+      kv_mask: optional ``[Bk, Lk]`` 0/1 token mask for intra-chunk
+        padding (segment tails that don't fill their last chunk).
+
+    Returns:
+      ``[Bq, H, Lq, D]`` — rows aligned with ``q``.
+    """
+    bq, h, lq, d = q.shape
+    bk, _, lk, _ = k.shape
+    nq, nk = q_seg.shape[-1], kv_seg.shape[-1]
+    if lq % nq or lk % nk:
+        raise ValueError(
+            f"sequence lengths {lq}/{lk} not divisible by chunk counts {nq}/{nk}"
+        )
+    cq, ck = lq // nq, lk // nk
+    if kv_mask is not None:
+        k = k * kv_mask[:, None, :, None].astype(k.dtype)
+
+    # One-hot chunk->segment maps; the pad slot (id n_seg) is sliced off,
+    # so pad chunks contribute to and gather from nothing.
+    oh_k = jax.nn.one_hot(kv_seg, n_seg + 1, dtype=k.dtype)[..., :n_seg]  # [Bk,Nk,S]
+    oh_q = jax.nn.one_hot(q_seg, n_seg + 1, dtype=q.dtype)[..., :n_seg]  # [Bq,Nq,S]
+
+    kc = k.reshape(bk, h, nk, ck, d)
+    vc = v.reshape(bk, h, nk, ck, d)
+    # Per-chunk partial Grams / key sums: the SAME total contraction
+    # work as the unpacked op, just summed chunkwise.
+    kv_chunk = jnp.einsum("bhncd,bhnce->bhnde", kc, vc)  # [Bk,H,Nk,D,D]
+    ks_chunk = jnp.sum(kc, axis=3)  # [Bk,H,Nk,D]
+    # Scatter-add into global per-segment Grams (tiny contractions).
+    kv_seg_gram = jnp.einsum("bns,bhnde->shde", oh_k, kv_chunk)  # [S,H,D,D]
+    ks_seg_sum = jnp.einsum("bns,bhnd->shd", oh_k, ks_chunk)  # [S,H,D]
+    # Gather each query chunk's segment Gram / key sum.
+    kv_q = jnp.einsum("bns,shde->bhnde", oh_q, kv_seg_gram)  # [Bq,H,Nq,D,D]
+    ks_q = jnp.einsum("bns,shd->bhnd", oh_q, ks_seg_sum)  # [Bq,H,Nq,D]
+
+    qc = q.reshape(bq, h, nq, cq, d)
+    denom = jnp.einsum("bhncd,bhnd->bhnc", qc, ks_q)
+    # Pad chunks/tokens and empty segments have denom == 0 exactly
+    # (softmaxed k rows are strictly positive — same argument as the
+    # masked unpacked op); select 1 for a clean 0 output there.
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    out = jnp.einsum("bhncd,bhnde->bhnce", qc, kv_q)
+    out = out / denom[..., None]
+    return out.reshape(bq, h, lq, d)
+
+
 def split_heads(x: Array, n_head: int) -> Array:
     """``[B, L, E] -> [B, H, L, E/H]`` (reference model.py:57-58)."""
     b, l, e = x.shape

@@ -75,3 +75,76 @@ def mse_per_sample(predictions: Array, targets: Array, mask: Array) -> Array:
 
 LOSSES = {"rel_l2": rel_l2_loss, "mse": mse_loss}
 PER_SAMPLE_LOSSES = {"rel_l2": rel_l2_per_sample, "mse": mse_per_sample}
+
+
+# --- Packed layout ("pack, don't pad" — multiple samples per row) --------
+
+
+def packed_segment_sums(
+    values: Array, mask: Array, node_seg: Array, n_seg: int
+) -> Array:
+    """Per-SEGMENT masked sums over a packed layout.
+
+    Args:
+      values: ``[R, L, C]`` packed rows.
+      mask: ``[R, L]`` 0/1 token mask.
+      node_seg: ``[R, N]`` chunk->segment ids (pad chunks = ``n_seg``).
+      n_seg: static segment-slot count.
+    Returns:
+      ``[S, C]`` per-segment sums — the packed equivalent of
+      ``masked_segment_sum``'s ``[B, C]``.
+    """
+    tok_seg = jnp.repeat(node_seg, values.shape[1] // node_seg.shape[1], axis=1)
+    oh = jax.nn.one_hot(tok_seg, n_seg + 1, dtype=values.dtype)[..., :n_seg]
+    oh = oh * mask[..., None].astype(values.dtype)
+    return jnp.einsum("rlc,rls->sc", values, oh)
+
+
+def _packed_counts(mask: Array, node_seg: Array, n_seg: int) -> Array:
+    """``[S]`` real-token counts per segment (0 for empty slots)."""
+    tok_seg = jnp.repeat(node_seg, mask.shape[1] // node_seg.shape[1], axis=1)
+    oh = jax.nn.one_hot(tok_seg, n_seg + 1, dtype=jnp.float32)[..., :n_seg]
+    return jnp.einsum("rl,rls->s", mask.astype(jnp.float32), oh)
+
+
+def packed_rel_l2_per_seg(
+    predictions: Array, targets: Array, mask: Array, node_seg: Array, n_seg: int
+) -> tuple[Array, Array]:
+    """``([S] metric, [S] valid)`` — per-segment relative L2 and a 0/1
+    validity mask for empty slots (whose metric is defined as 0)."""
+    num = packed_segment_sums((predictions - targets) ** 2, mask, node_seg, n_seg)
+    den = packed_segment_sums(targets**2, mask, node_seg, n_seg)
+    valid = (_packed_counts(mask, node_seg, n_seg) > 0).astype(num.dtype)
+    # Double-where: empty slots have num == den == 0, and sqrt'(0) is
+    # inf — masking only the VALUE would still propagate 0 * inf = nan
+    # into the gradients. Substitute ratio 1 inside the sqrt for empty
+    # slots, then zero the value.
+    ratio = num / jnp.where(den == 0.0, 1.0, den)
+    ratio = jnp.where(valid[:, None] > 0, ratio, 1.0)
+    per = jnp.mean(jnp.sqrt(ratio), axis=1)
+    return per * valid, valid
+
+
+def packed_rel_l2_loss(
+    predictions: Array, targets: Array, mask: Array, node_seg: Array, n_seg: int
+) -> Array:
+    """Mean per-sample relative L2 over the samples actually present in
+    the packed dispatch — the packed counterpart of ``rel_l2_loss``
+    (whose batch is always exactly B samples)."""
+    per, valid = packed_rel_l2_per_seg(predictions, targets, mask, node_seg, n_seg)
+    return jnp.sum(per) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+def packed_mse_loss(
+    predictions: Array, targets: Array, mask: Array, node_seg: Array, n_seg: int
+) -> Array:
+    """Packed counterpart of ``mse_loss``: per-segment node-mean squared
+    error, mean over present segments and channels."""
+    s = packed_segment_sums((predictions - targets) ** 2, mask, node_seg, n_seg)
+    n = _packed_counts(mask, node_seg, n_seg)
+    valid = (n > 0).astype(s.dtype)
+    per = jnp.mean(s / jnp.maximum(n, 1.0)[:, None].astype(s.dtype), axis=1)
+    return jnp.sum(per * valid) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+PACKED_LOSSES = {"rel_l2": packed_rel_l2_loss, "mse": packed_mse_loss}

@@ -62,9 +62,23 @@ def make_optimizer(cfg: OptimConfig, learning_rate) -> optax.GradientTransformat
     return tx
 
 
-def apply_batch(model: GNOT, params, batch: MeshBatch) -> jax.Array:
-    """The one forward-on-a-MeshBatch invocation (shared by loss, init
-    and inference paths)."""
+def apply_batch(model: GNOT, params, batch) -> jax.Array:
+    """The one forward invocation (shared by loss, init and inference
+    paths); a PackedBatch routes through the packed segment layout."""
+    from gnot_tpu.data.batch import PackedBatch
+
+    if isinstance(batch, PackedBatch):
+        return model.apply(
+            {"params": params},
+            batch.coords,
+            batch.theta,
+            batch.funcs,
+            node_mask=batch.node_mask,
+            func_mask=batch.func_mask,
+            node_seg=batch.node_seg,
+            func_seg=batch.func_seg,
+            n_seg=batch.n_seg,
+        )
     return model.apply(
         {"params": params},
         batch.coords,
@@ -73,6 +87,20 @@ def apply_batch(model: GNOT, params, batch: MeshBatch) -> jax.Array:
         node_mask=batch.node_mask,
         func_mask=batch.func_mask,
     )
+
+
+def packed_loss_fn(model: GNOT, loss_name: str) -> Callable:
+    """loss_fn for the packed layout: packed forward + per-segment
+    pooled loss (mean over the samples present in the dispatch)."""
+    from gnot_tpu.ops.segment import PACKED_LOSSES
+
+    def loss_fn(params, batch):
+        preds = apply_batch(model, params, batch)
+        return PACKED_LOSSES[loss_name](
+            preds, batch.y, batch.node_mask, batch.node_seg, batch.n_seg
+        )
+
+    return loss_fn
 
 
 def batch_loss(model: GNOT, params, batch: MeshBatch, loss_name: str) -> jax.Array:
@@ -228,14 +256,24 @@ def group_batches(batches, k: int):
         yield "single", p
 
 
-def init_params(model: GNOT, sample_batch: MeshBatch, seed: int):
+def init_params(model: GNOT, sample_batch, seed: int):
+    from gnot_tpu.data.batch import PackedBatch
+
+    kwargs = dict(
+        node_mask=sample_batch.node_mask, func_mask=sample_batch.func_mask
+    )
+    if isinstance(sample_batch, PackedBatch):
+        kwargs.update(
+            node_seg=sample_batch.node_seg,
+            func_seg=sample_batch.func_seg,
+            n_seg=sample_batch.n_seg,
+        )
     return model.init(
         jax.random.key(seed),
         sample_batch.coords,
         sample_batch.theta,
         sample_batch.funcs,
-        node_mask=sample_batch.node_mask,
-        func_mask=sample_batch.func_mask,
+        **kwargs,
     )["params"]
 
 
@@ -371,6 +409,29 @@ class Trainer:
         self.config = config
         self.mesh = None
         self._eval_tail = 0  # real samples in a repeat-padded tail eval batch
+        if config.data.packed:
+            # Validate BEFORE any mesh/pad setup so the error names the
+            # real conflict, not a downstream divisibility check.
+            if config.train.distributed:
+                raise ValueError(
+                    "packed mode is single-device for now; drop "
+                    "--distributed (DP over packed rows needs a global "
+                    "segment-Gram psum layout not built yet)"
+                )
+            if model_cfg.attention_mode == "parity":
+                raise ValueError(
+                    "packed mode requires attention_mode='masked' "
+                    "(parity reproduces the reference's per-batch "
+                    "padding pollution, which has no packed equivalent)"
+                )
+            if model_cfg.scan_layers:
+                raise ValueError(
+                    "packed + scan_layers not composed yet; pick one"
+                )
+            if config.optim.flat_params:
+                raise ValueError(
+                    "packed + flat_params not composed yet; pick one"
+                )
         drop_remainder = config.data.drop_remainder
         pad_nodes = config.data.pad_nodes
         pad_funcs = config.data.pad_funcs
@@ -430,24 +491,45 @@ class Trainer:
                     config.data.batch_size - tail
                 )
         self.model = GNOT(model_cfg)
-        self.train_loader = Loader(
-            train_samples,
-            config.data.batch_size,
-            shuffle=config.data.shuffle_train,
-            seed=config.data.seed,
-            bucket=config.data.bucket,
-            drop_remainder=drop_remainder,
-            pad_nodes=pad_nodes,
-            pad_funcs=pad_funcs,
-        )
-        self.test_loader = Loader(
-            test_samples,
-            config.data.batch_size,
-            shuffle=False,
-            bucket=config.data.bucket,
-            pad_nodes=pad_nodes,
-            pad_funcs=pad_funcs,
-        )
+        self._packed = config.data.packed
+        if self._packed:
+            from gnot_tpu.data.batch import PackedLoader
+
+            self.train_loader = PackedLoader(
+                train_samples,
+                config.data.batch_size,
+                chunk=config.data.pack_chunk,
+                shuffle=config.data.shuffle_train,
+                seed=config.data.seed,
+            )
+            self.test_loader = (
+                PackedLoader(
+                    test_samples,
+                    config.data.batch_size,
+                    chunk=config.data.pack_chunk,
+                )
+                if len(test_samples)
+                else Loader([], config.data.batch_size)
+            )
+        else:
+            self.train_loader = Loader(
+                train_samples,
+                config.data.batch_size,
+                shuffle=config.data.shuffle_train,
+                seed=config.data.seed,
+                bucket=config.data.bucket,
+                drop_remainder=drop_remainder,
+                pad_nodes=pad_nodes,
+                pad_funcs=pad_funcs,
+            )
+            self.test_loader = Loader(
+                test_samples,
+                config.data.batch_size,
+                shuffle=False,
+                bucket=config.data.bucket,
+                pad_nodes=pad_nodes,
+                pad_funcs=pad_funcs,
+            )
         # debug_checks: main() enables process-global jax_debug_nans at
         # startup (before any tracing — the only point it reliably
         # instruments, and a global flag is the CLI's to own, not a
@@ -462,6 +544,12 @@ class Trainer:
             and not (self.mesh is not None and self.mesh.shape.get("pipe", 1) > 1)
             else None
         )
+        if self._packed:
+            # Packed forward + per-segment pooled loss for BOTH train
+            # and eval steps (the eval metric is the dispatch's mean
+            # per-sample loss, the packed analogue of the reference's
+            # per-batch mean).
+            self._loss_fn = packed_loss_fn(self.model, config.train.loss)
         self._flat = config.optim.flat_params
         self._unravel = None  # set by initialize() in flat mode
         if self._flat:
@@ -525,7 +613,12 @@ class Trainer:
         # loader would spin up its prefetch thread and collate batches
         # that get thrown away.
         probe = self.test_loader if len(self.test_loader) else self.train_loader
-        sample = probe._collate_at(np.arange(min(probe.batch_size, len(probe.samples))))
+        if self._packed:
+            sample = probe.probe_batch()
+        else:
+            sample = probe._collate_at(
+                np.arange(min(probe.batch_size, len(probe.samples)))
+            )
         if self.mesh is not None and self.mesh.shape.get("pipe", 1) > 1:
             from gnot_tpu.parallel import pipeline
 
@@ -700,10 +793,18 @@ class Trainer:
         # iteration and score it per-sample so the repeats drop out. The
         # loader doesn't shuffle, so the tail is the last batch; divert
         # it while streaming (keeps the prefetch overlap — no list()).
+        # Without a diverted tail, iterate the loader EXHAUSTIVELY —
+        # truncating at len() would silently drop the final dispatch of
+        # a PackedLoader whose first-fit packing needed one more row
+        # group than the canonical count.
         it = iter(self.test_loader)
-        n_full = len(self.test_loader) - (1 if self._eval_tail else 0)
+        stream = (
+            itertools.islice(it, len(self.test_loader) - 1)
+            if self._eval_tail
+            else it
+        )
         metrics: list[np.ndarray] = []
-        for kind, item in group_batches(itertools.islice(it, n_full), k):
+        for kind, item in group_batches(stream, k):
             if kind == "group":
                 metrics.append(
                     np.asarray(

@@ -431,3 +431,101 @@ def test_gelu_tanh_vs_erf_forward_close():
     out_e = np.asarray(model_e.apply({"params": params}, coords, theta, funcs))
     assert np.max(np.abs(out_t - out_e)) < 0.05
     assert np.max(np.abs(out_t - out_e)) > 0  # genuinely different ops
+
+
+def test_packed_forward_matches_per_sample():
+    """GNOT packed forward ("pack, don't pad") == per-sample unpacked
+    masked forward, same params: segments in a packed row never mix and
+    theta/function routing per slot is exact."""
+    import jax
+    import jax.numpy as jnp
+
+    from gnot_tpu.config import ModelConfig
+    from gnot_tpu.data import datasets
+    from gnot_tpu.data.batch import PackedLoader, collate
+    from gnot_tpu.models.gnot import GNOT
+
+    mc = ModelConfig(
+        input_dim=2, theta_dim=1, input_func_dim=3, out_dim=1,
+        n_input_functions=1, n_attn_layers=2, n_attn_hidden_dim=32,
+        n_mlp_num_layers=2, n_mlp_hidden_dim=32, n_input_hidden_dim=32,
+        n_expert=2, n_head=4,
+    )
+    model = GNOT(mc)
+    samples = datasets.synth_elasticity(6, seed=1)
+    loader = PackedLoader(samples, batch_size=6, chunk=64)
+
+    # Init on a standard batch (params are shape-independent in L).
+    std = collate(samples[:2], bucket=False)
+    params = model.init(
+        jax.random.key(0), std.coords, std.theta, std.funcs,
+        node_mask=std.node_mask, func_mask=std.func_mask,
+    )["params"]
+
+    # Every sample appears exactly once across the epoch's dispatches,
+    # and no points are lost to packing.
+    dispatches = loader._epoch_dispatches()
+    seen = sorted(i for idx, _ in dispatches for i in idx)
+    assert seen == list(range(len(samples)))
+
+    chunk = loader.chunk
+    checked = 0
+    for dispatch in dispatches:
+        idx, _ = dispatch
+        packed = loader._collate_at(dispatch)
+        assert packed.n_real_points == sum(
+            samples[i].coords.shape[0] for i in idx
+        )
+        out = model.apply(
+            {"params": params}, packed.coords, packed.theta, packed.funcs,
+            node_mask=packed.node_mask, func_mask=packed.func_mask,
+            node_seg=packed.node_seg, func_seg=packed.func_seg,
+            n_seg=packed.n_seg,
+        )  # [R, L, out]
+        # Reference: each sample alone through the unpacked masked forward.
+        for slot, i in enumerate(idx):
+            s = samples[i]
+            pos = np.argwhere(np.asarray(packed.node_seg) == slot)
+            r = int(pos[0][0])
+            off = int(pos[0][1]) * chunk
+            n = s.coords.shape[0]
+            solo = collate([s], bucket=False)
+            ref = model.apply(
+                {"params": params}, solo.coords, solo.theta, solo.funcs,
+                node_mask=solo.node_mask, func_mask=solo.func_mask,
+            )
+            np.testing.assert_allclose(
+                np.asarray(out[r, off : off + n]),
+                np.asarray(ref[0, :n]),
+                rtol=2e-4, atol=2e-5,
+                err_msg=f"sample {i} (slot {slot}) diverges from solo",
+            )
+            checked += 1
+    assert checked == len(samples)
+
+
+def test_packed_forward_rejects_parity():
+    import jax
+    import pytest as _pytest
+
+    from gnot_tpu.config import ModelConfig
+    from gnot_tpu.data import datasets
+    from gnot_tpu.data.batch import PackedLoader
+    from gnot_tpu.models.gnot import GNOT
+
+    mc = ModelConfig(
+        input_dim=2, theta_dim=1, input_func_dim=3, out_dim=1,
+        n_input_functions=1, n_attn_layers=1, n_attn_hidden_dim=16,
+        n_mlp_num_layers=1, n_mlp_hidden_dim=16, n_input_hidden_dim=16,
+        n_expert=2, n_head=2, attention_mode="parity",
+    )
+    model = GNOT(mc)
+    samples = datasets.synth_elasticity(2, seed=0)
+    packed = next(iter(PackedLoader(samples, batch_size=2)))
+    with _pytest.raises(ValueError, match="packed"):
+        model.init(
+            jax.random.key(0), packed.coords, packed.theta, packed.funcs,
+            node_mask=packed.node_mask, func_mask=packed.func_mask,
+            node_seg=packed.node_seg, func_seg=packed.func_seg,
+            n_seg=packed.n_seg,
+        )

@@ -134,3 +134,81 @@ def test_loss_grads_finite():
     mask = jnp.ones((2, 4))
     g = jax.grad(lambda x: rel_l2_loss(x, t, mask))(p)
     assert np.isfinite(np.asarray(g)).all()
+
+
+def test_packed_attention_matches_per_segment():
+    """packed_normalized_linear_attention == the unpacked op run on
+    each segment separately: no cross-segment leakage, exact
+    per-sample math (fp summation order aside). Covers ragged segment
+    tails (intra-chunk masking), pad chunks, a pad segment slot, and
+    DIFFERENT query/key packings (the cross-attention case)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gnot_tpu.ops.attention import (
+        feature_softmax,
+        normalized_linear_attention,
+        packed_normalized_linear_attention,
+    )
+
+    rng = np.random.RandomState(0)
+    H, D, C = 2, 8, 4
+    n_seg = 3
+    # Segment token counts (queries and keys differ per segment).
+    q_lens = [6, 9, 4]
+    k_lens = [5, 3, 7]
+
+    def pack(lens, rows, row_chunks):
+        """Place segment s's tokens contiguously, chunk-aligned, into
+        the given (row, start_chunk) slots; return arrays + seg map."""
+        L = row_chunks * C
+        x = np.zeros((len(rows and [0]) and max(r for r, _ in rows) + 1, L, H * D), np.float32)
+        seg = np.full((x.shape[0], row_chunks), n_seg, np.int32)
+        mask = np.zeros((x.shape[0], L), np.float32)
+        chunks_used = {}
+        tokens = []
+        for s, (ln, (r, c0)) in enumerate(zip(lens, rows)):
+            t = rng.randn(ln, H * D).astype(np.float32)
+            tokens.append(t)
+            x[r, c0 * C : c0 * C + ln] = t
+            mask[r, c0 * C : c0 * C + ln] = 1.0
+            nch = -(-ln // C)
+            seg[r, c0 : c0 + nch] = s
+        return x, seg, mask, tokens
+
+    # queries: seg0 row0@0, seg1 row0@2 (after seg0's 2 chunks), seg2 row1@0
+    qx, q_seg, q_mask, q_toks = pack(q_lens, [(0, 0), (0, 2), (1, 0)], 5)
+    # keys: different packing entirely
+    kx, k_seg, k_mask, k_toks = pack(k_lens, [(1, 0), (0, 0), (0, 1)], 3)
+    vx = rng.randn(*kx.shape).astype(np.float32)
+
+    def heads(a):
+        b, l, e = a.shape
+        return jnp.asarray(a).reshape(b, l, H, D).transpose(0, 2, 1, 3)
+
+    q = feature_softmax(heads(qx))
+    k = feature_softmax(heads(kx))
+    # Zero padded q/k rows' softmax garbage where it matters: the op
+    # masks k itself; q pad rows produce outputs we never compare.
+    v = heads(vx)
+
+    out = packed_normalized_linear_attention(
+        q, k, v, q_seg=jnp.asarray(q_seg), kv_seg=jnp.asarray(k_seg),
+        n_seg=n_seg, kv_mask=jnp.asarray(k_mask),
+    )  # [Bq, H, Lq, D]
+
+    # Reference: run each segment through the unpacked op alone.
+    q_rows = {0: (0, 0), 1: (0, 2), 2: (1, 0)}
+    k_rows = {0: (1, 0), 1: (0, 0), 2: (0, 1)}
+    for s in range(n_seg):
+        qs = feature_softmax(heads(q_toks[s][None]))
+        ks = feature_softmax(heads(k_toks[s][None]))
+        r, c0 = k_rows[s]
+        vs = heads(vx[None, r, c0 * C : c0 * C + k_lens[s]])
+        ref = normalized_linear_attention(qs, ks, vs)  # [1,H,Lq_s,D]
+        r, c0 = q_rows[s]
+        got = out[r, :, c0 * C : c0 * C + q_lens[s]]
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref[0]), rtol=2e-5, atol=2e-6,
+            err_msg=f"segment {s} diverges from its solo attention",
+        )
