@@ -118,23 +118,35 @@ def build(step_dtype: str, attention_impl: str = "xla", n_points: int = 1024, ba
         packed_loss_fn,
     )
 
-    batch, mc = build_data(
-        step_dtype, n_points, batch_size, config, attention_impl, ffn_impl,
-        remat, model_overrides,
-    )
     if packed and flat_params:
         raise ValueError(
             "packed + flat_params not composed (the Trainer rejects the "
             "combination too); pick one"
         )
     if packed:
-        # "Pack, don't pad": rebuild the same samples as ONE packed
-        # dispatch (multiple segments per row) — pts/s stays comparable
-        # because the metric counts REAL points either way.
+        # "Pack, don't pad": ONE packed dispatch (multiple segments per
+        # row) from the same sample generator the padded path uses —
+        # pts/s stays comparable because the metric counts REAL points
+        # either way. No padded Loader is built on this path.
+        from gnot_tpu.config import ModelConfig
+        from gnot_tpu.data import datasets
         from gnot_tpu.data.batch import PackedLoader
 
         samples = _gen_samples(config, n_points, batch_size)
         batch = PackedLoader(samples, batch_size, chunk=pack_chunk).probe_batch()
+        mc = ModelConfig(
+            dtype=step_dtype,
+            attention_impl=attention_impl,
+            ffn_impl=ffn_impl,
+            remat=remat,
+            **datasets.infer_model_dims(samples),
+            **(model_overrides or {}),
+        )
+    else:
+        batch, mc = build_data(
+            step_dtype, n_points, batch_size, config, attention_impl, ffn_impl,
+            remat, model_overrides,
+        )
     model = GNOT(mc)
     optim = OptimConfig(flat_params=flat_params)
     if flat_params:

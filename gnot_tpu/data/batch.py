@@ -251,6 +251,7 @@ class PackedLoader:
         shuffle: bool = False,
         seed: int = 0,
         prefetch: int = 2,
+        row_multiple: int = 1,
     ):
         if not samples:
             raise ValueError("PackedLoader needs at least one sample")
@@ -272,6 +273,9 @@ class PackedLoader:
         self.row_len = -(-row // chunk) * chunk
         mean_a = float(np.mean(aligned))
         self.n_rows = max(1, -(-int(batch_size * mean_a) // self.row_len))
+        # Mesh runs shard rows over the data axis: round the row count
+        # up so every dispatch splits evenly.
+        self.n_rows = -(-self.n_rows // row_multiple) * row_multiple
         # Static slot capacity: no R-row window can carry more samples.
         self.n_slots = self.n_rows * (self.row_len // min_a)
         self.pad_funcs = max(

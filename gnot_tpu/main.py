@@ -165,8 +165,9 @@ def build_parser() -> argparse.ArgumentParser:
         "--packed", action="store_true",
         help="pack multiple samples per sequence row (chunk-aligned "
              "segments, exact per-sample attention) instead of padding "
-             "each to the bucket length — recovers the ~30% padding "
-             "waste on ragged configs; masked mode, single device",
+             "each to the bucket length — recovers the ~30%% padding "
+             "waste on ragged configs; masked mode; composes with the "
+             "data/model/expert mesh axes (single-process)",
     )
     p.add_argument(
         "--pack_chunk", type=int, default=128,

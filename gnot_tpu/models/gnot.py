@@ -58,9 +58,8 @@ class HNABlock(nn.Module):
         *,
         node_mask: Array | None = None,
         func_mask: Array | None = None,
-        node_seg: Array | None = None,
-        func_seg: Array | None = None,
-        n_seg: int = 0,
+        node_seg_oh: Array | None = None,
+        func_seg_oh: Array | None = None,
     ) -> Array:
         cross = LinearAttention(
             self.n_attn_hidden_dim,
@@ -71,7 +70,7 @@ class HNABlock(nn.Module):
             name="cross_attention",
         )(
             query, input_functions, query_mask=node_mask, func_mask=func_mask,
-            q_seg=node_seg, kv_seg=func_seg, n_seg=n_seg,
+            q_seg_oh=node_seg_oh, kv_seg_oh=func_seg_oh,
         )
         ffn1 = GatedExpertFfn(
             self.n_expert,
@@ -92,7 +91,7 @@ class HNABlock(nn.Module):
             dtype=self.dtype,
             parity=self.parity,
             name="self_attention",
-        )(query, query_mask=node_mask, q_seg=node_seg, n_seg=n_seg)
+        )(query, query_mask=node_mask, q_seg_oh=node_seg_oh)
         ffn2 = GatedExpertFfn(
             self.n_expert,
             self.n_mlp_num_layers,
@@ -308,6 +307,19 @@ class GNOT(nn.Module):
         else:
             funcs = None
 
+        # One-hot segment maps, computed ONCE and threaded as arrays:
+        # inside the blocks no static int remains, so the packed layout
+        # composes with nn.remat (which traces every call argument).
+        if node_seg is not None:
+            from gnot_tpu.ops.attention import segment_one_hot
+
+            node_seg_oh = segment_one_hot(node_seg, n_seg)
+            func_seg_oh = (
+                segment_one_hot(func_seg, n_seg) if func_seg is not None else None
+            )
+        else:
+            node_seg_oh = func_seg_oh = None
+
         for i in range(cfg.n_attn_layers):
             query = block_module(
                 cfg,
@@ -316,7 +328,7 @@ class GNOT(nn.Module):
                 remat=cfg.remat,
             )(
                 scores, query, funcs, node_mask=node_mask, func_mask=func_mask,
-                node_seg=node_seg, func_seg=func_seg, n_seg=n_seg,
+                node_seg_oh=node_seg_oh, func_seg_oh=func_seg_oh,
             )
 
         return finalize_output(out_module(cfg)(query))

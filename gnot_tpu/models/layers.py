@@ -151,17 +151,18 @@ class LinearAttention(nn.Module):
         *,
         query_mask: Array | None = None,
         func_mask: Array | None = None,
-        q_seg: Array | None = None,
-        kv_seg: Array | None = None,
-        n_seg: int = 0,
+        q_seg_oh: Array | None = None,
+        kv_seg_oh: Array | None = None,
     ) -> Array:
-        """``q_seg``/``kv_seg``/``n_seg`` switch on the PACKED layout
-        (ops.attention.packed_normalized_linear_attention): chunk->
-        segment ids for the query rows and (cross mode) the separately
-        packed input-function rows ``[F, Bf, Nf]``. Masked mode only —
-        parity's interleaved head merge is packing-hostile by design.
+        """``q_seg_oh``/``kv_seg_oh`` switch on the PACKED layout
+        (ops.attention.packed_normalized_linear_attention): one-hot
+        chunk->segment maps for the query rows and (cross mode) the
+        slot-indexed input-function rows — ARRAYS, precomputed once per
+        forward by the caller (segment_one_hot), so no static int
+        crosses a remat boundary. Masked mode only — parity's
+        interleaved head merge is packing-hostile by design.
         """
-        packed = q_seg is not None
+        packed = q_seg_oh is not None
         if packed and self.parity:
             raise ValueError("packed attention requires parity=False")
         e, h = self.n_embed, self.n_head
@@ -185,12 +186,13 @@ class LinearAttention(nn.Module):
             v = jax.vmap(lambda t: split_heads(t, h))(v_proj)
             mask_axis = None if func_mask is None else 0
             if packed:
-                # kv_seg (the slot-row -> segment map) is SHARED by all
-                # F functions — the stacked funcs tensor is slot-indexed.
+                # kv_seg_oh (the slot-row -> segment map) is SHARED by
+                # all F functions — the stacked funcs tensor is
+                # slot-indexed.
                 out = jax.vmap(
-                    functools.partial(_packed_nla_positional, n_seg),
+                    _packed_nla_positional,
                     in_axes=(None, 0, 0, mask_axis, None, None),
-                )(q, k, v, func_mask, q_seg, kv_seg)  # [F, Bq, H, Lq, D]
+                )(q, k, v, func_mask, q_seg_oh, kv_seg_oh)  # [F, Bq, H, Lq, D]
             else:
                 out = jax.vmap(_nla_positional, in_axes=(None, 0, 0, mask_axis))(
                     q, k, v, func_mask
@@ -208,7 +210,7 @@ class LinearAttention(nn.Module):
             v = split_heads(v_proj, h)
             if packed:
                 out = packed_normalized_linear_attention(
-                    q, k, v, q_seg=q_seg, kv_seg=q_seg, n_seg=n_seg,
+                    q, k, v, q_seg_oh=q_seg_oh, kv_seg_oh=q_seg_oh,
                     kv_mask=query_mask,
                 )
             else:
@@ -223,9 +225,9 @@ def _nla_positional(q, k, v, mask):
     return normalized_linear_attention(q, k, v, kv_mask=mask)
 
 
-def _packed_nla_positional(n_seg, q, k, v, mask, q_seg, kv_seg):
+def _packed_nla_positional(q, k, v, mask, q_seg_oh, kv_seg_oh):
     return packed_normalized_linear_attention(
-        q, k, v, q_seg=q_seg, kv_seg=kv_seg, n_seg=n_seg, kv_mask=mask
+        q, k, v, q_seg_oh=q_seg_oh, kv_seg_oh=kv_seg_oh, kv_mask=mask
     )
 
 

@@ -90,14 +90,21 @@ def normalized_linear_attention(
     return alpha[..., None] * out
 
 
+def segment_one_hot(seg: Array, n_seg: int, dtype=jnp.float32) -> Array:
+    """``[.., N]`` chunk->segment ids -> ``[.., N, S]`` one-hot map with
+    the pad slot (id ``n_seg``) sliced off. Computed ONCE per forward
+    (outside any remat boundary — ``n_seg`` is a static int that must
+    not become a tracer) and threaded as an array through the blocks."""
+    return jax.nn.one_hot(seg, n_seg + 1, dtype=dtype)[..., :n_seg]
+
+
 def packed_normalized_linear_attention(
     q: Array,
     k: Array,
     v: Array,
     *,
-    q_seg: Array,
-    kv_seg: Array,
-    n_seg: int,
+    q_seg_oh: Array,
+    kv_seg_oh: Array,
     kv_mask: Array | None = None,
 ) -> Array:
     """Normalized linear attention over PACKED sequences.
@@ -121,11 +128,10 @@ def packed_normalized_linear_attention(
         (cross-attention packs input functions separately) — segments
         are global ids shared by both sides.
       v: ``[Bk, H, Lk, D]`` values.
-      q_seg: ``[Bq, Nq]`` int chunk->segment ids in ``[0, n_seg)``;
-        pad chunks use ``n_seg`` (they scatter/gather into a dropped
-        slot).
-      kv_seg: ``[Bk, Nk]`` likewise for the key/value chunks.
-      n_seg: static segment (sample-slot) count.
+      q_seg_oh: ``[Bq, Nq, S]`` one-hot chunk->segment map
+        (``segment_one_hot``); pad chunks have all-zero rows, so they
+        scatter to and gather from nothing.
+      kv_seg_oh: ``[Bk, Nk, S]`` likewise for the key/value chunks.
       kv_mask: optional ``[Bk, Lk]`` 0/1 token mask for intra-chunk
         padding (segment tails that don't fill their last chunk).
 
@@ -134,7 +140,7 @@ def packed_normalized_linear_attention(
     """
     bq, h, lq, d = q.shape
     bk, _, lk, _ = k.shape
-    nq, nk = q_seg.shape[-1], kv_seg.shape[-1]
+    nq, nk = q_seg_oh.shape[-2], kv_seg_oh.shape[-2]
     if lq % nq or lk % nk:
         raise ValueError(
             f"sequence lengths {lq}/{lk} not divisible by chunk counts {nq}/{nk}"
@@ -143,10 +149,8 @@ def packed_normalized_linear_attention(
     if kv_mask is not None:
         k = k * kv_mask[:, None, :, None].astype(k.dtype)
 
-    # One-hot chunk->segment maps; the pad slot (id n_seg) is sliced off,
-    # so pad chunks contribute to and gather from nothing.
-    oh_k = jax.nn.one_hot(kv_seg, n_seg + 1, dtype=k.dtype)[..., :n_seg]  # [Bk,Nk,S]
-    oh_q = jax.nn.one_hot(q_seg, n_seg + 1, dtype=q.dtype)[..., :n_seg]  # [Bq,Nq,S]
+    oh_k = kv_seg_oh.astype(k.dtype)  # [Bk,Nk,S]
+    oh_q = q_seg_oh.astype(q.dtype)  # [Bq,Nq,S]
 
     kc = k.reshape(bk, h, nk, ck, d)
     vc = v.reshape(bk, h, nk, ck, d)

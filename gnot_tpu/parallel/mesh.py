@@ -79,18 +79,52 @@ def batch_pspecs() -> MeshBatch:
     )
 
 
-def stacked_batch_pspecs() -> MeshBatch:
-    """PartitionSpecs for a K-step stacked MeshBatch (leading step axis
+def packed_batch_pspecs():
+    """PartitionSpecs for a PackedBatch: ROWS shard over ``data``; the
+    slot-indexed pieces (theta, the input-function slot rows, the
+    slot->segment map) replicate — segments are global ids, so the
+    per-segment Gram scatter (a contraction over the sharded row axis)
+    lowers to one GSPMD psum per attention, and each device gathers
+    from the full replicated segment table. seq is not composed with
+    packing (a segment would straddle the seq shards)."""
+    from gnot_tpu.data.batch import PackedBatch
+
+    return PackedBatch(
+        coords=P("data", None, None),
+        theta=P(),
+        y=P("data", None, None),
+        node_mask=P("data", None),
+        node_seg=P("data", None),
+        funcs=P(None, None, None, None),
+        func_mask=P(None, None, None),
+        func_seg=P(),
+        n_seg=0,  # static field — not a pytree leaf, value unused here
+    )
+
+
+def _base_pspecs(batch):
+    """Spec tree matching ``batch``'s type (MeshBatch or PackedBatch).
+    For PackedBatch the static ``n_seg`` is copied over so the spec
+    tree's treedef (which includes static fields) matches the batch's."""
+    from gnot_tpu.data.batch import PackedBatch
+
+    if isinstance(batch, PackedBatch):
+        return packed_batch_pspecs().replace(n_seg=batch.n_seg)
+    return batch_pspecs()
+
+
+def stacked_batch_pspecs(base=None):
+    """PartitionSpecs for a K-step stacked batch (leading step axis
     unsharded — the scan iterates it)."""
     return jax.tree.map(
         lambda spec: P(*((None,) + tuple(spec))),
-        batch_pspecs(),
+        batch_pspecs() if base is None else base,
         is_leaf=lambda x: isinstance(x, P),
     )
 
 
-def batch_shardings(mesh: Mesh, batch: MeshBatch, specs: MeshBatch | None = None) -> MeshBatch:
-    specs = batch_pspecs() if specs is None else specs
+def batch_shardings(mesh: Mesh, batch, specs=None):
+    specs = _base_pspecs(batch) if specs is None else specs
     return jax.tree.map(
         lambda spec, leaf: NamedSharding(mesh, spec) if leaf is not None else None,
         specs,
@@ -99,10 +133,10 @@ def batch_shardings(mesh: Mesh, batch: MeshBatch, specs: MeshBatch | None = None
     )
 
 
-def shard_batch(mesh: Mesh, batch: MeshBatch, *, stacked: bool = False) -> MeshBatch:
+def shard_batch(mesh: Mesh, batch, *, stacked: bool = False):
     """Host->device transfer with the batch layout applied
     (``stacked=True`` for a K-step stacked batch)."""
-    specs = stacked_batch_pspecs() if stacked else None
+    specs = stacked_batch_pspecs(_base_pspecs(batch)) if stacked else None
     return jax.tree.map(
         lambda leaf, sh: jax.device_put(leaf, sh),
         batch,

@@ -413,11 +413,19 @@ class Trainer:
             # Validate BEFORE any mesh/pad setup so the error names the
             # real conflict, not a downstream divisibility check.
             if config.train.distributed:
-                raise ValueError(
-                    "packed mode is single-device for now; drop "
-                    "--distributed (DP over packed rows needs a global "
-                    "segment-Gram psum layout not built yet)"
-                )
+                if jax.process_count() > 1:
+                    raise ValueError(
+                        "packed + multi-process not composed yet (the "
+                        "cross-host packed global-batch assembly is not "
+                        "built); packed meshes are single-process"
+                    )
+                if config.mesh.seq > 1 or config.mesh.pipe > 1:
+                    raise ValueError(
+                        "packed composes with the data/model/expert mesh "
+                        "axes only: a seq shard would straddle packed "
+                        "segments, and the pipeline forward does not "
+                        "thread segment ids; set mesh seq=pipe=1"
+                    )
             if model_cfg.attention_mode == "parity":
                 raise ValueError(
                     "packed mode requires attention_mode='masked' "
@@ -435,7 +443,16 @@ class Trainer:
         drop_remainder = config.data.drop_remainder
         pad_nodes = config.data.pad_nodes
         pad_funcs = config.data.pad_funcs
-        if config.train.distributed:
+        if config.train.distributed and config.data.packed:
+            # Packed dispatches already have ONE static shape (R rows x
+            # row_len); none of the pad-fixing / remainder / tail
+            # machinery below applies — the only mesh requirement is
+            # that the row count splits over the data axis, enforced by
+            # the loader's row_multiple.
+            from gnot_tpu.parallel import multihost
+
+            self.mesh = multihost.make_hybrid_mesh(config.mesh)
+        elif config.train.distributed:
             from gnot_tpu.data.batch import fixed_pad_lengths
             from gnot_tpu.parallel import multihost
 
@@ -495,18 +512,21 @@ class Trainer:
         if self._packed:
             from gnot_tpu.data.batch import PackedLoader
 
+            row_multiple = self.mesh.shape["data"] if self.mesh is not None else 1
             self.train_loader = PackedLoader(
                 train_samples,
                 config.data.batch_size,
                 chunk=config.data.pack_chunk,
                 shuffle=config.data.shuffle_train,
                 seed=config.data.seed,
+                row_multiple=row_multiple,
             )
             self.test_loader = (
                 PackedLoader(
                     test_samples,
                     config.data.batch_size,
                     chunk=config.data.pack_chunk,
+                    row_multiple=row_multiple,
                 )
                 if len(test_samples)
                 else Loader([], config.data.batch_size)

@@ -529,3 +529,59 @@ def test_packed_forward_rejects_parity():
             node_seg=packed.node_seg, func_seg=packed.func_seg,
             n_seg=packed.n_seg,
         )
+
+
+def test_packed_composes_with_remat():
+    """nn.remat traces every block call argument; the one-hot segment
+    maps are arrays (computed outside the remat boundary), so packed +
+    remat must produce the same outputs AND gradients as packed alone."""
+    import jax
+    import jax.numpy as jnp
+    import dataclasses
+
+    from gnot_tpu.config import ModelConfig
+    from gnot_tpu.data import datasets
+    from gnot_tpu.data.batch import PackedLoader
+    from gnot_tpu.models.gnot import GNOT
+
+    mc = ModelConfig(
+        input_dim=2, theta_dim=1, input_func_dim=3, out_dim=1,
+        n_input_functions=1, n_attn_layers=2, n_attn_hidden_dim=32,
+        n_mlp_num_layers=1, n_mlp_hidden_dim=32, n_input_hidden_dim=32,
+        n_expert=2, n_head=4,
+    )
+    samples = datasets.synth_elasticity(4, seed=0)
+    packed = PackedLoader(samples, batch_size=4, chunk=64).probe_batch()
+
+    def run(cfg):
+        model = GNOT(cfg)
+        params = model.init(
+            jax.random.key(0), packed.coords, packed.theta, packed.funcs,
+            node_mask=packed.node_mask, func_mask=packed.func_mask,
+            node_seg=packed.node_seg, func_seg=packed.func_seg,
+            n_seg=packed.n_seg,
+        )["params"]
+
+        def loss(p):
+            out = model.apply(
+                {"params": p}, packed.coords, packed.theta, packed.funcs,
+                node_mask=packed.node_mask, func_mask=packed.func_mask,
+                node_seg=packed.node_seg, func_seg=packed.func_seg,
+                n_seg=packed.n_seg,
+            )
+            return jnp.sum(out**2)
+
+        return jax.value_and_grad(loss)(params)
+
+    l0, g0 = run(mc)
+    l1, g1 = run(dataclasses.replace(mc, remat=True))
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    import jax as _jax
+
+    _jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        g0,
+        g1,
+    )

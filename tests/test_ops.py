@@ -149,6 +149,7 @@ def test_packed_attention_matches_per_segment():
         feature_softmax,
         normalized_linear_attention,
         packed_normalized_linear_attention,
+        segment_one_hot,
     )
 
     rng = np.random.RandomState(0)
@@ -193,8 +194,10 @@ def test_packed_attention_matches_per_segment():
     v = heads(vx)
 
     out = packed_normalized_linear_attention(
-        q, k, v, q_seg=jnp.asarray(q_seg), kv_seg=jnp.asarray(k_seg),
-        n_seg=n_seg, kv_mask=jnp.asarray(k_mask),
+        q, k, v,
+        q_seg_oh=segment_one_hot(jnp.asarray(q_seg), n_seg),
+        kv_seg_oh=segment_one_hot(jnp.asarray(k_seg), n_seg),
+        kv_mask=jnp.asarray(k_mask),
     )  # [Bq, H, Lq, D]
 
     # Reference: run each segment through the unpacked op alone.

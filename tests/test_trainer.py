@@ -738,8 +738,21 @@ def test_packed_rejects_incompatible_modes():
         ({"packed": None, "attention_mode": "parity", "no_bucket": None}, "masked"),
         ({"packed": None, "scan_layers": None}, "scan_layers"),
         ({"packed": None, "flat_params": None}, "flat_params"),
-        ({"packed": None, "distributed": None}, "single-device"),
+        ({"packed": None, "distributed": None, "mesh_seq": "2"}, "seq"),
     ):
         cfg, mc, train, test = small_setup(epochs=1, **extra)
         with pytest.raises(ValueError, match=match):
             Trainer(cfg, mc, train, test)
+
+
+def test_packed_distributed_fit():
+    """--packed --distributed (single-process mesh): rows shard over
+    the data axis, training runs and converges."""
+    cfg, mc, train, test = small_setup(
+        epochs=3, synthetic="elasticity", packed=None, distributed=None,
+        mesh_data="4", mesh_model="2",
+    )
+    trainer = Trainer(cfg, mc, train, test)
+    assert trainer.train_loader.n_rows % 4 == 0
+    best = trainer.fit()
+    assert np.isfinite(best)
