@@ -86,23 +86,32 @@ def _gen_samples(config: str, n_points: int, batch_size: int):
     return datasets.SYNTHETIC[config](batch_size, seed=0, **gen_kwargs)
 
 
-def build_data(step_dtype: str, n_points: int, batch_size: int, config: str, attention_impl: str = "xla", ffn_impl: str = "xla", remat: bool = False, model_overrides: dict | None = None):
-    """One padded batch + the reference-default ModelConfig
-    (main.py:16-22) for the given workload — no jax state.
-    ``model_overrides`` replaces ModelConfig fields (e.g. a deeper
-    ``n_attn_layers`` for layout A/Bs)."""
+def _model_config(samples, step_dtype: str, attention_impl: str, ffn_impl: str, remat: bool, model_overrides: dict | None):
+    """THE one bench ModelConfig construction (padded and packed
+    builders both call it, so A/Bs benchmark the same model)."""
     from gnot_tpu.config import ModelConfig
     from gnot_tpu.data import datasets
-    from gnot_tpu.data.batch import Loader
 
-    samples = _gen_samples(config, n_points, batch_size)
-    mc = ModelConfig(
+    return ModelConfig(
         dtype=step_dtype,
         attention_impl=attention_impl,
         ffn_impl=ffn_impl,
         remat=remat,
         **datasets.infer_model_dims(samples),
         **(model_overrides or {}),
+    )
+
+
+def build_data(step_dtype: str, n_points: int, batch_size: int, config: str, attention_impl: str = "xla", ffn_impl: str = "xla", remat: bool = False, model_overrides: dict | None = None):
+    """One padded batch + the reference-default ModelConfig
+    (main.py:16-22) for the given workload — no jax state.
+    ``model_overrides`` replaces ModelConfig fields (e.g. a deeper
+    ``n_attn_layers`` for layout A/Bs)."""
+    from gnot_tpu.data.batch import Loader
+
+    samples = _gen_samples(config, n_points, batch_size)
+    mc = _model_config(
+        samples, step_dtype, attention_impl, ffn_impl, remat, model_overrides
     )
     return next(iter(Loader(samples, batch_size))), mc
 
@@ -128,19 +137,12 @@ def build(step_dtype: str, attention_impl: str = "xla", n_points: int = 1024, ba
         # row) from the same sample generator the padded path uses —
         # pts/s stays comparable because the metric counts REAL points
         # either way. No padded Loader is built on this path.
-        from gnot_tpu.config import ModelConfig
-        from gnot_tpu.data import datasets
         from gnot_tpu.data.batch import PackedLoader
 
         samples = _gen_samples(config, n_points, batch_size)
         batch = PackedLoader(samples, batch_size, chunk=pack_chunk).probe_batch()
-        mc = ModelConfig(
-            dtype=step_dtype,
-            attention_impl=attention_impl,
-            ffn_impl=ffn_impl,
-            remat=remat,
-            **datasets.infer_model_dims(samples),
-            **(model_overrides or {}),
+        mc = _model_config(
+            samples, step_dtype, attention_impl, ffn_impl, remat, model_overrides
         )
     else:
         batch, mc = build_data(

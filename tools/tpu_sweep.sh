@@ -34,6 +34,7 @@ run heat_k4        --synthetic heatsink3d --steps_per_dispatch 4 --batch_size 4
 run darcy_parity   --synthetic darcy2d --attention_mode parity --no_bucket
 run ns2d_ffnpallas --synthetic ns2d --ffn_impl pallas
 run ns2d_flat      --synthetic ns2d --flat_params --dtype bfloat16
+run elas_packed    --synthetic elasticity --packed --dtype bfloat16 --batch_size 8
 run darcy_ckpt     --synthetic darcy2d --checkpoint_dir "$CKPT" --checkpoint_every 1 \
                    --predict_out "$LOGDIR/sweep_preds.pkl" --export_torch "$LOGDIR/sweep_model.pth"
 run darcy_resume   --synthetic darcy2d --checkpoint_dir "$CKPT" --eval_only
