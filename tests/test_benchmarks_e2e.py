@@ -268,3 +268,20 @@ def test_heatsink3d_64k_long_context_artifact():
     assert len(epochs) >= 40
     assert all(np.isfinite(r["train_loss"]) for r in epochs)
     assert min(r["test_metric"] for r in epochs) < 0.2 * epochs[0]["test_metric"]
+
+
+def test_packed_quality_ab_artifact():
+    """On-chip 24-epoch elasticity A/B (same regime, B=16, bf16):
+    packed training reaches the padded path's quality — the throughput
+    win does not trade away convergence. Recorded by two CLI runs with
+    --metrics_path; docs/performance.md 'Pack, don't pad'."""
+    records = _load_jsonl_artifact("packed_quality_ab.jsonl")
+    best = {}
+    for r in records:
+        if r.get("test_metric") is not None:
+            m = r["mode"]
+            best[m] = min(best.get(m, float("inf")), r["test_metric"])
+    assert set(best) == {"padded", "packed"}
+    assert np.isfinite(best["packed"]) and np.isfinite(best["padded"])
+    # Parity-or-better with trajectory-noise headroom.
+    assert best["packed"] < best["padded"] * 1.1, best
