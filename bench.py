@@ -380,6 +380,13 @@ def main():
         help="also print the device's peak-memory stats as JSON on stderr "
              "(keeps the stdout one-line contract)"
     )
+    p.add_argument(
+        "--metrics_path", type=str, default="",
+        help="also append the result record to this JSONL file in the "
+             "trainer's MetricsSink schema (plus a run.json manifest "
+             "next to it), so one report tool reads bench and training "
+             "runs alike"
+    )
     args = p.parse_args()
 
     lr = jnp.asarray(1e-3, jnp.float32)
@@ -459,22 +466,36 @@ def main():
         cpu_value = batch_c.n_real_points / cpu_sec
         vs_baseline = value / cpu_value
 
-    print(
-        json.dumps(
-            {
-                "metric": f"{args.config}_mesh_points_per_sec_per_chip",
-                "value": round(value, 1),
-                "unit": "points/sec/chip",
-                "vs_baseline": round(vs_baseline, 3),
-                "ms_per_step": round(sec_per_step * 1e3, 4),
-                "flops_per_step": flops,
-                "achieved_tflops": round(achieved / 1e12, 2) if achieved else None,
-                "mfu": round(mfu, 4) if mfu is not None else None,
-                "timing": timing,
-                "dtype": args.dtype,
-            }
+    result = {
+        "metric": f"{args.config}_mesh_points_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "points/sec/chip",
+        "vs_baseline": round(vs_baseline, 3),
+        "ms_per_step": round(sec_per_step * 1e3, 4),
+        "flops_per_step": flops,
+        "achieved_tflops": round(achieved / 1e12, 2) if achieved else None,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "timing": timing,
+        "dtype": args.dtype,
+    }
+    print(json.dumps(result))
+    if args.metrics_path:
+        # Same JSONL schema/writer as the trainer (utils.metrics) plus a
+        # run.json manifest, so one report tool reads bench AND training
+        # runs (docs/observability.md).
+        import sys
+
+        from gnot_tpu.obs import manifest as manifest_lib
+        from gnot_tpu.utils.metrics import MetricsSink
+
+        with MetricsSink(args.metrics_path) as sink:
+            sink.log(kind="bench", **result)
+        manifest_lib.write_manifest(
+            manifest_lib.manifest_path_for(args.metrics_path),
+            config=vars(args),
+            argv=sys.argv[1:],
+            extra={"metrics_path": args.metrics_path, "kind": "bench"},
         )
-    )
 
 
 if __name__ == "__main__":

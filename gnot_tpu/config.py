@@ -220,6 +220,14 @@ class TrainConfig:
     checkpoint_every: int = 0  # epochs; 0 = best-only (reference behavior)
     log_every: int = 0  # steps; 0 = per-epoch only
     metrics_path: str = ""  # JSONL sink; "" = console only
+    # On-device telemetry + health monitors (obs/): grad/param/update
+    # norms, per-layer gate load/entropy and padding waste as side
+    # outputs of the compiled step (drained every log_every steps — no
+    # per-step host syncs), plus recompile detection, slow-step outlier
+    # gauges and the NaN watchdog. Off by default: the side outputs
+    # change the compiled program (a different executable, extra
+    # reductions), so the perf-measurement default stays untouched.
+    telemetry: bool = False
     profile_dir: str = ""  # jax.profiler trace output
     # Debug-build numeric guard: jax_debug_nans — the first NaN/inf in
     # any step raises with the producing op's location instead of

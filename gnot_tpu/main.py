@@ -147,6 +147,15 @@ def build_parser() -> argparse.ArgumentParser:
         "--log_every", type=int, default=0,
         help="per-step JSONL metric cadence (0 = per-epoch only; needs --metrics_path)"
     )
+    p.add_argument(
+        "--telemetry", action="store_true",
+        help="on-device telemetry + health monitors (obs/): grad/param/"
+             "update norms, per-layer gate load/entropy, padding waste "
+             "as side outputs of the compiled step, drained every "
+             "--log_every steps without per-step host syncs; plus "
+             "recompile detection, slow-step outliers and the NaN "
+             "watchdog (docs/observability.md)"
+    )
     p.add_argument("--profile_dir", type=str, default="")
     p.add_argument(
         "--debug_checks", action="store_true",
@@ -228,6 +237,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "train.stop_after_epoch": args.stop_after_epoch,
             "train.metrics_path": args.metrics_path,
             "train.log_every": args.log_every,
+            "train.telemetry": args.telemetry,
             "train.profile_dir": args.profile_dir,
             "train.debug_checks": args.debug_checks,
             "train.steps_per_dispatch": args.steps_per_dispatch,
@@ -441,64 +451,90 @@ def main(argv=None) -> float:
     # Metrics are process-0-only: on multi-process runs every host
     # computes the same global metrics, and p writers on one JSONL path
     # would interleave duplicates (and the per-step float() sync would
-    # hit every host).
+    # hit every host). The ExitStack closes the sink on EVERY exit path
+    # — an exception mid-run (NaN watchdog, preemption, Ctrl-C) must
+    # not strand buffered records.
+    import contextlib
+
     import jax
 
-    sink = (
-        MetricsSink(cfg.train.metrics_path)
-        if cfg.train.metrics_path and jax.process_index() == 0
-        else None
-    )
-    checkpointer = None
-    if cfg.train.checkpoint_dir:
-        from gnot_tpu.train.checkpoint import Checkpointer
-
-        checkpointer = Checkpointer(
-            cfg.train.checkpoint_dir,
-            # Resolved numerics provenance: restore warns if a later run
-            # auto-resolves a different gelu flavor (the masked-mode
-            # default moved erf->tanh in round 4).
-            extra_meta={
-                "gelu": mc.gelu,
-                "attention_mode": mc.attention_mode,
-                "dtype": mc.dtype,
-                # State LAYOUT provenance (not numerics): a flat-layout
-                # checkpoint restores only into a flat-layout trainer
-                # (orbax restores by structure), so the mismatch warning
-                # names the flag to flip instead of an opaque tree error.
-                "flat_params": args.flat_params,
-            },
+    with contextlib.ExitStack() as stack:
+        sink = (
+            stack.enter_context(MetricsSink(cfg.train.metrics_path))
+            if cfg.train.metrics_path and jax.process_index() == 0
+            else None
         )
-    trainer = Trainer(
-        cfg, mc, train_samples, test_samples, metrics_sink=sink, checkpointer=checkpointer
-    )
-    if args.eval_only:
-        result = trainer.evaluate_from_checkpoint()
-    else:
-        result = trainer.fit()
+        checkpointer = None
+        if cfg.train.checkpoint_dir:
+            from gnot_tpu.train.checkpoint import Checkpointer
 
-    if (args.export_torch or args.predict_out) and not args.eval_only:
-        if checkpointer is not None:
-            # Export/predict from the BEST checkpoint, not the final
-            # epoch, so both artifacts correspond to the reported best
-            # metric. (eval_only already restored it into trainer.state.)
-            restored = checkpointer.restore_best(trainer.state)
-            if restored is not None:
-                trainer.state = restored[0]
-        else:
-            print(
-                "note: no --checkpoint_dir, so export/predict artifacts "
-                "use the FINAL-epoch weights, not the reported best"
+            checkpointer = Checkpointer(
+                cfg.train.checkpoint_dir,
+                # Resolved numerics provenance: restore warns if a later run
+                # auto-resolves a different gelu flavor (the masked-mode
+                # default moved erf->tanh in round 4).
+                extra_meta={
+                    "gelu": mc.gelu,
+                    "attention_mode": mc.attention_mode,
+                    "dtype": mc.dtype,
+                    # State LAYOUT provenance (not numerics): a flat-layout
+                    # checkpoint restores only into a flat-layout trainer
+                    # (orbax restores by structure), so the mismatch warning
+                    # names the flag to flip instead of an opaque tree error.
+                    "flat_params": args.flat_params,
+                },
             )
-    if args.export_torch:
-        _export_torch(trainer, mc, args.export_torch)
-    if args.predict_out:
-        # Collective on multi-process runs (params allgather inside
-        # predict): every process computes the full predictions, only
-        # process 0 writes the file.
-        preds = trainer.predict(full_test_samples)
-        if jax.process_index() == 0:
-            _write_predictions(full_test_samples, preds, args.predict_out)
+        trainer = Trainer(
+            cfg, mc, train_samples, test_samples, metrics_sink=sink,
+            checkpointer=checkpointer,
+        )
+        if cfg.train.metrics_path and jax.process_index() == 0:
+            # Provenance BEFORE training (a crashed run still has its
+            # manifest): config snapshot, git rev, versions, topology,
+            # mesh shape, compile-cache stats — docs/observability.md.
+            import sys
+
+            from gnot_tpu.obs import manifest as manifest_lib
+
+            mpath = manifest_lib.manifest_path_for(cfg.train.metrics_path)
+            manifest_lib.write_manifest(
+                mpath,
+                config=cfg,
+                model_config=mc,
+                mesh=trainer.mesh,
+                argv=list(argv) if argv is not None else sys.argv[1:],
+                extra={
+                    "metrics_path": cfg.train.metrics_path,
+                    "kind": "eval" if args.eval_only else "train",
+                },
+            )
+        if args.eval_only:
+            result = trainer.evaluate_from_checkpoint()
+        else:
+            result = trainer.fit()
+
+        if (args.export_torch or args.predict_out) and not args.eval_only:
+            if checkpointer is not None:
+                # Export/predict from the BEST checkpoint, not the final
+                # epoch, so both artifacts correspond to the reported best
+                # metric. (eval_only already restored it into trainer.state.)
+                restored = checkpointer.restore_best(trainer.state)
+                if restored is not None:
+                    trainer.state = restored[0]
+            else:
+                print(
+                    "note: no --checkpoint_dir, so export/predict artifacts "
+                    "use the FINAL-epoch weights, not the reported best"
+                )
+        if args.export_torch:
+            _export_torch(trainer, mc, args.export_torch)
+        if args.predict_out:
+            # Collective on multi-process runs (params allgather inside
+            # predict): every process computes the full predictions, only
+            # process 0 writes the file.
+            preds = trainer.predict(full_test_samples)
+            if jax.process_index() == 0:
+                _write_predictions(full_test_samples, preds, args.predict_out)
     return result
 
 

@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from gnot_tpu.config import ModelConfig
-from gnot_tpu.models.layers import GatedExpertFfn, LinearAttention, Mlp
+from gnot_tpu.models.layers import GatedExpertFfn, LinearAttention, Mlp, gate_stats
 
 Array = jax.Array
 
@@ -61,6 +61,14 @@ class HNABlock(nn.Module):
         node_seg_oh: Array | None = None,
         func_seg_oh: Array | None = None,
     ) -> Array:
+        # Gate telemetry side-channel: per-layer expert load fractions +
+        # entropy, sown into the "intermediates" collection. Free unless
+        # the caller applies with mutable=["intermediates"] (the
+        # telemetry train step, obs/telemetry.py); sown per BLOCK even
+        # though the reference shares one gate across layers, so a
+        # future per-layer gate keeps the same record schema.
+        for k, v in gate_stats(scores, node_mask).items():
+            self.sow("intermediates", k, v)
         cross = LinearAttention(
             self.n_attn_hidden_dim,
             self.n_head,

@@ -231,6 +231,23 @@ def _packed_nla_positional(q, k, v, mask, q_seg_oh, kv_seg_oh):
     )
 
 
+def gate_stats(scores: Array, mask: Array | None) -> dict[str, Array]:
+    """Gate-health scalars for one layer's geometry-gating ``scores``
+    ``[B, L, E]``: per-expert load fractions (masked token mean — a
+    collapsed gate shows one expert's load -> 1) and the mean per-token
+    gate entropy in nats (uniform gating -> log E, collapse -> 0).
+    Pure f32 reductions; ``mask=None`` (parity mode) averages every
+    token, matching parity's pads-are-real semantics."""
+    s = scores.astype(jnp.float32)
+    ent = -jnp.sum(s * jnp.log(jnp.clip(s, 1e-20)), axis=-1)  # [B, L]
+    if mask is None:
+        return {"gate_load": jnp.mean(s, axis=(0, 1)), "gate_entropy": jnp.mean(ent)}
+    m = mask.astype(jnp.float32)
+    denom = jnp.maximum(jnp.sum(m), 1.0)
+    load = jnp.einsum("ble,bl->e", s, m) / denom
+    return {"gate_load": load, "gate_entropy": jnp.sum(ent * m) / denom}
+
+
 class GatedExpertFfn(nn.Module):
     """Dense soft mixture-of-experts FFN (model.py:123-124,128-131).
 

@@ -1,0 +1,16 @@
+"""Observability subsystem: on-device telemetry, run manifests, health
+monitors (ROADMAP north star: every perf/parity PR must be debuggable).
+
+Three pieces, all off the hot path by construction:
+
+* ``telemetry`` — model-internals scalars (grad/param/update norms,
+  per-layer MoE gate load + entropy, padding waste) computed as side
+  outputs INSIDE the compiled train step and buffered as device arrays;
+  the host syncs once per drain window, not per step.
+* ``manifest`` — a ``run.json`` provenance snapshot (config, git rev,
+  library versions, device topology, mesh shape, compile-cache stats)
+  written at startup next to the metrics file.
+* ``health`` — recompile detection (trace-counter deltas), slow-step
+  outlier gauges, and a NaN watchdog that localizes the producing op by
+  re-executing the offending batch under ``utils.debug.checked``.
+"""

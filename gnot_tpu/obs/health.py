@@ -1,0 +1,127 @@
+"""Training health monitors: recompile detection, slow-step outliers,
+NaN localization.
+
+All three are host-side and drain-cadence — they read what the
+telemetry buffer already fetched (losses, dispatch wall-times) or cheap
+host counters (jit cache sizes), so none of them adds device syncs to
+the hot path. Semantics are documented in docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+logger = logging.getLogger(__name__)
+
+
+class RecompileMonitor:
+    """Trace-counter deltas over registered jitted callables.
+
+    ``jax.jit`` wrappers expose ``_cache_size()`` — the number of
+    distinct (shape, dtype, static-arg) specializations compiled so
+    far. The first ``check()`` snapshots the warm-up compiles as the
+    baseline; any later positive delta is a RECOMPILE (a shape leak —
+    e.g. unbucketed lengths, an LR passed as a Python float) and is the
+    "silent recompile storm" signal the per-epoch prints can't see.
+    On a jax without the counter the monitor degrades to no-op.
+    """
+
+    def __init__(self) -> None:
+        self._fns: dict[str, Callable] = {}
+        self._last: dict[str, int] = {}
+        self._baselined = False
+
+    def register(self, name: str, fn) -> None:
+        if fn is not None and callable(getattr(fn, "_cache_size", None)):
+            self._fns[name] = fn
+
+    def _sizes(self) -> dict[str, int]:
+        out = {}
+        for name, fn in self._fns.items():
+            try:
+                out[name] = int(fn._cache_size())
+            except Exception:  # counter went away mid-run; drop the fn
+                continue
+        return out
+
+    def check(self) -> dict[str, int]:
+        """Per-fn compile-count deltas since the previous check. The
+        first call records the baseline (initial traces) and returns
+        ``{}``; later calls return only fns that recompiled."""
+        sizes = self._sizes()
+        if not self._baselined:
+            self._last = sizes
+            self._baselined = True
+            return {}
+        deltas = {
+            name: n - self._last.get(name, 0)
+            for name, n in sizes.items()
+            if n > self._last.get(name, 0)
+        }
+        self._last = sizes
+        return deltas
+
+
+class SlowStepMonitor:
+    """Dispatch-interval outlier gauge.
+
+    Observes the host wall-time between step dispatches (measured by
+    the telemetry buffer). On the async dispatch path this interval is
+    near-zero until the device queue backpressures, so a spike means a
+    host-side stall: a recompile blocking dispatch, a straggling
+    collective, input-pipeline starvation. An observation counts as an
+    outlier when it exceeds ``factor`` x the rolling median of the last
+    ``window`` observations, after ``warmup`` observations have
+    seeded the median (compile steps land in the warmup).
+    """
+
+    def __init__(self, factor: float = 3.0, warmup: int = 10, window: int = 256):
+        if factor <= 1.0:
+            raise ValueError(f"factor must be > 1, got {factor}")
+        self.factor = factor
+        self.warmup = warmup
+        self.window = window
+        self._times: list[float] = []
+        self._seen = 0
+
+    def observe(self, dt: float) -> dict | None:
+        """Feed one dispatch interval (seconds); returns the outlier
+        record (``step_time_s``/``median_s``/``slowdown``) or None."""
+        self._seen += 1
+        out = None
+        if self._seen > self.warmup and len(self._times) >= 2:
+            import statistics
+
+            med = statistics.median(self._times)
+            if med > 0 and dt > self.factor * med:
+                out = {
+                    "step_time_s": dt,
+                    "median_s": med,
+                    "slowdown": dt / med,
+                }
+        self._times.append(dt)
+        if len(self._times) > self.window:
+            del self._times[: len(self._times) - self.window]
+        return out
+
+
+def localize_nan(loss_fn, params, batch) -> str | None:
+    """Re-execute ``loss_fn(params, batch)`` under
+    ``utils.debug.checked`` to name the op that produced the first
+    NaN/inf. Returns checkify's report (op + source location) or None
+    when the re-run comes back clean — a NON-reproducing NaN, which
+    with the post-update ``params`` the trainer passes means the bad
+    value came from the state the offending step already consumed (the
+    watchdog fires one drain window after the fact, by design: the hot
+    path carries no per-step syncs)."""
+    from jax.experimental import checkify
+
+    from gnot_tpu.utils.debug import checked
+
+    guarded = checked(loss_fn)
+    try:
+        guarded(params, batch)
+    except checkify.JaxRuntimeError as exc:
+        return str(exc)
+    return None

@@ -1,0 +1,154 @@
+"""Run manifest: one ``run.json`` of provenance per run.
+
+Answers "what exactly produced this metrics file" without re-deriving it
+from shell history: config snapshot, git revision, library versions,
+device topology, mesh shape, and persistent compile-cache stats. Written
+at startup (before training can crash) by ``main.py`` next to the
+``--metrics_path`` JSONL, and by ``bench.py --metrics_path`` — one
+report tool reads both.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import time
+from typing import Any
+
+
+def _git_rev() -> dict:
+    """Best-effort git provenance of the installed package tree; a
+    non-repo install (wheel, bare container) reports nulls, never
+    raises."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    out = {"rev": None, "dirty": None}
+    try:
+        rev = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=root, capture_output=True,
+            text=True, timeout=10,
+        )
+        if rev.returncode == 0:
+            out["rev"] = rev.stdout.strip()
+            status = subprocess.run(
+                ["git", "status", "--porcelain"], cwd=root,
+                capture_output=True, text=True, timeout=10,
+            )
+            if status.returncode == 0:
+                out["dirty"] = bool(status.stdout.strip())
+    except (OSError, subprocess.SubprocessError):
+        pass
+    return out
+
+
+def _versions() -> dict:
+    vers = {}
+    for name in ("jax", "jaxlib", "flax", "optax", "numpy", "orbax.checkpoint"):
+        try:
+            mod = __import__(name)
+            for part in name.split(".")[1:]:
+                mod = getattr(mod, part)
+            vers[name] = getattr(mod, "__version__", None)
+        except ImportError:
+            vers[name] = None
+    return vers
+
+
+def _devices() -> dict:
+    import jax
+
+    devices = jax.devices()
+    return {
+        "platform": devices[0].platform if devices else None,
+        "device_kind": getattr(devices[0], "device_kind", None) if devices else None,
+        "n_devices": len(devices),
+        "n_local_devices": jax.local_device_count(),
+        "process_count": jax.process_count(),
+        "process_index": jax.process_index(),
+    }
+
+
+def _compile_cache_stats() -> dict:
+    """Size/entry count of the persistent XLA compile cache
+    (utils/cache.py enables it by default): a near-empty cache on a
+    supposedly warm host explains a slow first epoch; entry-count
+    growth across runs is the compile-churn signal."""
+    import jax
+
+    path = getattr(jax.config, "jax_compilation_cache_dir", None)
+    stats = {"dir": path, "entries": None, "bytes": None}
+    if path and os.path.isdir(path):
+        entries = n_bytes = 0
+        try:
+            for de in os.scandir(path):
+                if de.is_file():
+                    entries += 1
+                    n_bytes += de.stat().st_size
+            stats["entries"], stats["bytes"] = entries, n_bytes
+        except OSError:
+            pass
+    return stats
+
+
+def _snapshot(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return dataclasses.asdict(obj)
+    return obj
+
+
+def build_manifest(
+    *,
+    config: Any = None,
+    model_config: Any = None,
+    mesh=None,
+    argv=None,
+    extra: dict | None = None,
+) -> dict:
+    manifest = {
+        "ts": time.time(),
+        "argv": list(argv) if argv is not None else None,
+        "config": _snapshot(config),
+        "model_config": _snapshot(model_config),
+        "git": _git_rev(),
+        "versions": _versions(),
+        "devices": _devices(),
+        "mesh": dict(mesh.shape) if mesh is not None else None,
+        "compile_cache": _compile_cache_stats(),
+    }
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def write_manifest(path: str, **kwargs) -> dict:
+    """Build and atomically write the manifest (tmp + rename: a reader
+    polling the run dir never sees a torn file). Returns the dict."""
+    manifest = build_manifest(**kwargs)
+    if d := os.path.dirname(path):
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=2, default=str)
+        f.write("\n")
+    os.replace(tmp, path)
+    return manifest
+
+
+def manifest_path_for(metrics_path: str) -> str:
+    """The manifest lives next to the metrics JSONL as ``run.json`` —
+    unless a DIFFERENT run's ``run.json`` is already there (two runs
+    sharing a directory, e.g. a bench alongside a training run), in
+    which case it falls back to ``<metrics-stem>.run.json`` so the
+    first run's provenance is not clobbered."""
+    metrics_path = os.path.abspath(metrics_path)
+    default = os.path.join(os.path.dirname(metrics_path), "run.json")
+    try:
+        with open(default) as f:
+            existing = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return default  # absent or torn: ours to (re)write
+    if os.path.abspath(existing.get("metrics_path") or "") == metrics_path:
+        return default  # a re-run of the same metrics file
+    stem = os.path.splitext(os.path.basename(metrics_path))[0]
+    return os.path.join(os.path.dirname(metrics_path), f"{stem}.run.json")

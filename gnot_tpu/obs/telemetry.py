@@ -1,0 +1,274 @@
+"""On-device scalar telemetry for the jitted train step.
+
+Design constraint (docs/observability.md): telemetry must not add host
+syncs to the hot path. Everything here is therefore computed INSIDE the
+already-compiled step — the norms reduce values the backward pass
+materializes anyway, the gate stats ride the forward as sown
+intermediates — and returned as a second output the trainer buffers as
+device arrays. The host fetches one whole drain window at a time
+(``TelemetryBuffer``), so the per-step cost is a handful of fused
+reductions plus one deferred tiny transfer per ``log_every`` steps.
+
+Step builders mirror the trainer's (single-device, K-step scanned,
+GSPMD-sharded); each returns ``(state, (loss, telem))`` where ``telem``
+is a flat dict of f32 scalars plus ``[n_expert]`` gate-load vectors.
+With the standard forward the gate stats are captured per block via the
+``intermediates`` collection (models/gnot.py sows them); overridden
+forwards (flat/packed/stacked loss_fn) keep their own loss math and get
+the norm/padding telemetry only — the mutable-apply capture does not
+reach through their custom apply paths.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from gnot_tpu.ops.segment import LOSSES
+
+
+def telemetry_loss_fn(model, loss_name: str) -> Callable:
+    """Standard masked/parity forward + loss, with the model's sown
+    ``intermediates`` (per-block gate stats) returned as aux."""
+
+    def loss_fn(params, batch):
+        preds, mut = model.apply(
+            {"params": params},
+            batch.coords,
+            batch.theta,
+            batch.funcs,
+            node_mask=batch.node_mask,
+            func_mask=batch.func_mask,
+            mutable=["intermediates"],
+        )
+        loss = LOSSES[loss_name](preds, batch.y, batch.node_mask)
+        return loss, mut.get("intermediates", {})
+
+    return loss_fn
+
+
+def instrument(aux, grads, updates, params, batch) -> dict:
+    """The train_step_body telemetry hook: device-side reductions over
+    values the step already holds. ``params`` is the POST-update tree
+    (param-norm tracks where the model is, not where it was)."""
+    telem = {
+        "grad_norm": optax.global_norm(grads),
+        "update_norm": optax.global_norm(updates),
+        "param_norm": optax.global_norm(params),
+    }
+    mask = getattr(batch, "node_mask", None)
+    if mask is not None:
+        telem["padding_waste"] = 1.0 - jnp.mean(mask.astype(jnp.float32))
+    if aux:
+        # intermediates tree: {block_i: {gate_load: (v,), gate_entropy: (v,)}}
+        # (flax sow appends into tuples). Flatten to "gate_load/block_i".
+        for block, stats in aux.items():
+            for key, v in stats.items():
+                telem[f"{key}/{block}"] = v[0] if isinstance(v, tuple) else v
+    return telem
+
+
+def _telemetry_body(model, optim_cfg, loss_name: str, loss_fn):
+    from gnot_tpu.train.trainer import train_step_body
+
+    if loss_fn is None:
+        return train_step_body(
+            model, optim_cfg, loss_name,
+            loss_fn=telemetry_loss_fn(model, loss_name),
+            instrument=instrument, loss_has_aux=True,
+        )
+    # Overridden forward (flat / packed / stacked): its loss math stays
+    # untouched; telemetry degrades to the norm/padding scalars.
+    return train_step_body(
+        model, optim_cfg, loss_name, loss_fn=loss_fn, instrument=instrument
+    )
+
+
+def make_train_step(model, optim_cfg, loss_name: str, *, loss_fn=None) -> Callable:
+    import functools
+
+    body = _telemetry_body(model, optim_cfg, loss_name, loss_fn)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def train_step(state, batch, lr):
+        return body(state, (batch, lr))
+
+    return train_step
+
+
+def make_multi_train_step(model, optim_cfg, loss_name: str, *, loss_fn=None) -> Callable:
+    """K-step scanned telemetry step: ys stack to ``(loss[K], telem[K])``
+    — the scan body is the same instrumented train_step_body."""
+    import functools
+
+    body = _telemetry_body(model, optim_cfg, loss_name, loss_fn)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def multi_step(state, batches, lrs):
+        return jax.lax.scan(body, state, (batches, lrs))
+
+    return multi_step
+
+
+#: The one copy of the pipeline-rejection message (Trainer.__init__
+#: raises it early from config, the sharded builders from the mesh).
+PIPE_ERROR = (
+    "telemetry does not compose with the pipeline mesh path yet (the "
+    "shard_map schedule builds its own step); set mesh pipe=1 or "
+    "disable telemetry"
+)
+
+
+def _reject_pipe(mesh) -> None:
+    if mesh.shape.get("pipe", 1) > 1:
+        raise ValueError(PIPE_ERROR)
+
+
+def make_sharded_train_step(
+    model, optim_cfg, loss_name: str, mesh, state, microbatches: int = 0,
+    loss_fn=None,
+) -> Callable:
+    """GSPMD telemetry step: the telemetry outputs come back replicated
+    (they are full reductions, so XLA's psums make them globally-reduced
+    on every host — multi-host aggregation by construction). Signature
+    mirrors ``mesh.make_sharded_train_step`` so the trainer selects the
+    builder with one conditional; ``microbatches`` only ever routed to
+    the pipeline path, which telemetry rejects."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from gnot_tpu.parallel import mesh as mesh_lib
+
+    _reject_pipe(mesh)
+    mesh_lib._validate_gspmd(model, mesh)
+    body = _telemetry_body(model, optim_cfg, loss_name, loss_fn)
+    st_sh = mesh_lib.state_shardings(mesh, state)
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        lambda state, batch, lr: body(state, (batch, lr)),
+        in_shardings=(st_sh, None, replicated),
+        out_shardings=(st_sh, replicated),  # prefix: (loss, telem) replicate
+        donate_argnums=(0,),
+    )
+
+
+def make_sharded_multi_train_step(
+    model, optim_cfg, loss_name: str, mesh, state, *, loss_fn=None
+) -> Callable:
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from gnot_tpu.parallel import mesh as mesh_lib
+
+    _reject_pipe(mesh)
+    mesh_lib._validate_gspmd(model, mesh)
+    body = _telemetry_body(model, optim_cfg, loss_name, loss_fn)
+    st_sh = mesh_lib.state_shardings(mesh, state)
+    replicated = NamedSharding(mesh, P())
+    return jax.jit(
+        lambda state, batches, lrs: jax.lax.scan(body, state, (batches, lrs)),
+        in_shardings=(st_sh, None, replicated),
+        out_shardings=(st_sh, replicated),
+        donate_argnums=(0,),
+    )
+
+
+class TelemetryBuffer:
+    """Device-resident telemetry accumulator with batched drains.
+
+    ``append`` stores the step's ``(loss, telem)`` DEVICE arrays plus
+    host bookkeeping (steps, lrs, dispatch wall-time, batch refs) — no
+    transfer, no sync. ``drain`` fetches the whole window in one
+    ``jax.device_get``, runs the health hooks (slow-step gauge, NaN
+    watchdog) over every step, and writes one JSONL record per
+    ``log_every``-multiple step to the sink. The trainer drains on the
+    window boundary and at epoch end, so at ``log_every=10`` the hot
+    path sees one deferred fetch of ~10 tiny arrays per 10 steps.
+
+    ``sink=None`` (non-zero processes of a multi-host run) keeps the
+    health checks without writing records. ``on_nonfinite(step, epoch,
+    loss, batch)`` fires at most once, on the FIRST non-finite loss in
+    a drained window (the NaN watchdog — it raises, ending the run).
+    ``keep_batches=False`` drops the batch refs (multi-process runs,
+    where the watchdog skips the localization re-run anyway — no point
+    pinning a window of padded batches in host RAM).
+    """
+
+    #: drain cadence when log_every is 0 (telemetry on, records off —
+    #: health monitors still need periodic loss visibility).
+    DEFAULT_DRAIN = 50
+
+    def __init__(
+        self, sink, log_every: int, *, slow_step=None, on_nonfinite=None,
+        keep_batches: bool = True,
+    ):
+        self.sink = sink
+        self.record_every = max(0, int(log_every))
+        self.drain_every = self.record_every or self.DEFAULT_DRAIN
+        self.keep_batches = keep_batches
+        self._entries: list[dict] = []
+        self._pending_steps = 0
+        self._slow = slow_step
+        self._on_nonfinite = on_nonfinite
+        self._last_t: float | None = None
+
+    def append(self, *, steps, epoch, lrs, loss, telem, batches) -> None:
+        """One dispatch's outputs: ``steps``/``lrs``/``batches`` are
+        length-K lists (K=1 single step), ``loss``/``telem`` the device
+        outputs (stacked on a leading K axis for K > 1)."""
+        now = time.perf_counter()
+        dt = (now - self._last_t) / len(steps) if self._last_t is not None else None
+        self._last_t = now
+        if not self.keep_batches:
+            batches = [None] * len(steps)
+        self._entries.append(
+            dict(steps=list(steps), epoch=epoch, lrs=list(lrs), loss=loss,
+                 telem=telem, batches=list(batches), dt=dt)
+        )
+        self._pending_steps += len(steps)
+        if self._pending_steps >= self.drain_every:
+            self.drain()
+
+    def drain(self) -> None:
+        if not self._entries:
+            return
+        entries, self._entries = self._entries, []
+        self._pending_steps = 0
+        # Reset the dispatch-interval clock: whatever happens between a
+        # drain and the next append (the epoch-end eval/checkpoint pass
+        # after the trainer's flush — or this drain's own fetch+writes)
+        # is not a step interval, and timing it would hand the slow-step
+        # monitor a guaranteed false outlier every epoch.
+        self._last_t = None
+        fetched = jax.device_get([(e["loss"], e["telem"]) for e in entries])
+        for e, (loss, telem) in zip(entries, fetched):
+            k = len(e["steps"])
+            if self._slow is not None and e["dt"] is not None:
+                outlier = self._slow.observe(e["dt"])
+                if outlier is not None and self.sink is not None:
+                    self.sink.log(
+                        event="slow_step", step=e["steps"][-1],
+                        epoch=e["epoch"], **outlier,
+                    )
+            loss = np.atleast_1d(np.asarray(loss))
+            for i, step in enumerate(e["steps"]):
+                li = float(loss[i] if k > 1 else loss[0])
+                if (
+                    self.sink is not None
+                    and self.record_every
+                    and step % self.record_every == 0
+                ):
+                    rec = {"step": step, "epoch": e["epoch"], "loss": li,
+                           "lr": e["lrs"][i]}
+                    for key, v in telem.items():
+                        arr = np.asarray(v)
+                        rec[key] = arr[i] if k > 1 else arr
+                    self.sink.log(**rec)
+                if not math.isfinite(li) and self._on_nonfinite is not None:
+                    # Records up to and including the bad step are
+                    # already written; the watchdog raises.
+                    self._on_nonfinite(step, e["epoch"], li, e["batches"][i])

@@ -25,6 +25,36 @@ import jax
 Array = jax.Array
 
 
+def shard_map(f, mesh, in_specs, out_specs, *, axis_names=None, check_vma=True):
+    """``jax.shard_map`` across jax versions — THE one entry point for
+    this repo's explicit shard_map paths (pipeline, fused pallas
+    attention). Newer jax exposes ``jax.shard_map`` with
+    ``axis_names``/``check_vma``; 0.4.x ships
+    ``jax.experimental.shard_map.shard_map`` with the complementary
+    ``auto`` set and ``check_rep`` instead — same semantics, translated
+    here so call sites stay on the modern spelling."""
+    new = getattr(jax, "shard_map", None)
+    if new is not None:
+        kwargs = dict(
+            mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=check_vma,
+        )
+        if axis_names is not None:
+            kwargs["axis_names"] = axis_names
+        return new(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as legacy
+
+    auto = (
+        frozenset(mesh.axis_names) - frozenset(axis_names)
+        if axis_names is not None
+        else frozenset()
+    )
+    return legacy(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=check_vma, auto=auto,
+    )
+
+
 def ring_allreduce(x: Array, axis_name: str, axis_size: int) -> Array:
     """Sum ``x`` over ``axis_name`` with S-1 neighbor hops instead of a
     one-shot psum. Differentiable (scan over ppermute; ppermute

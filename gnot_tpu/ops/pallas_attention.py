@@ -385,9 +385,7 @@ def fused_nla_sp(
     psum, the ring replays in reverse, through the per-stage custom
     VJPs).
     """
-    from jax import shard_map
-
-    from gnot_tpu.ops.collectives import ring_allreduce
+    from gnot_tpu.ops.collectives import ring_allreduce, shard_map
 
     if sp_collective not in ("psum", "ring"):
         raise ValueError(f"unknown sp_collective {sp_collective!r}")

@@ -197,6 +197,23 @@ def shard_samples(
     return list(samples)[i::n]
 
 
+def per_host_gauge(value: float) -> "np.ndarray":
+    """Allgather one host-local float into a ``[process_count]`` f32
+    array in process order — the straggler gauge primitive (each host
+    contributes its step/epoch wall-time; process 0 logs the max-min
+    skew). COLLECTIVE: every process must call it together, whether or
+    not it owns a metrics sink. Single-process returns ``[value]``."""
+    import numpy as np
+
+    if jax.process_count() == 1:
+        return np.asarray([value], np.float32)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(
+        multihost_utils.process_allgather(np.asarray(value, np.float32))
+    )
+
+
 def global_batch(
     mesh: Mesh, local_batch: MeshBatch, *, stacked: bool = False
 ) -> MeshBatch:

@@ -268,7 +268,9 @@ def _pipe_blocks(
     # in particular ``model``, so tensor parallelism inside a stage is
     # the ordinary sharding-annotation kind (state_shardings puts heads
     # / FFN hidden over model and GSPMD inserts the psums).
-    mapped = jax.shard_map(
+    from gnot_tpu.ops.collectives import shard_map
+
+    mapped = shard_map(
         local_fn,
         mesh=mesh,
         in_specs=tuple(in_specs),

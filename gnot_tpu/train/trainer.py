@@ -117,6 +117,8 @@ def train_step_body(
     loss_name: str,
     *,
     loss_fn: Callable | None = None,
+    instrument: Callable | None = None,
+    loss_has_aux: bool = False,
 ):
     """THE training-step math — the one copy every step builder wraps
     (single-device, GSPMD-sharded, K-step scanned, and pipelined), so
@@ -125,20 +127,34 @@ def train_step_body(
     traced scalar: optax.adamw is pure, so building the transform inside
     the compiled step is free and recompile-safe. ``loss_fn(params,
     batch)`` overrides the forward (the pipeline path substitutes its
-    shard_map forward); default is the standard ``batch_loss``."""
+    shard_map forward); default is the standard ``batch_loss``.
+
+    ``instrument(aux, grads, updates, params, batch) -> dict`` is the
+    telemetry side-output hook (obs/telemetry.py): when set, the body
+    returns ``(state, (loss, telem))`` instead of ``(state, loss)`` —
+    the telemetry is computed INSIDE the compiled step (device
+    reductions over values the backward pass already materialized), so
+    enabling it adds no host syncs and does not change the update math.
+    ``loss_has_aux=True`` marks a loss_fn returning ``(loss, aux)``
+    (the intermediates-capturing telemetry forward)."""
     if loss_fn is None:
         loss_fn = lambda p, batch: batch_loss(model, p, batch, loss_name)
 
     def body(state: TrainState, xs):
         batch, lr = xs
-        loss, grads = jax.value_and_grad(lambda p: loss_fn(p, batch))(state.params)
+        out, grads = jax.value_and_grad(
+            lambda p: loss_fn(p, batch), has_aux=loss_has_aux
+        )(state.params)
+        loss, aux = out if loss_has_aux else (out, None)
         tx = make_optimizer(optim_cfg, lr)
         updates, opt_state = tx.update(grads, state.opt_state, state.params)
         params = optax.apply_updates(state.params, updates)
-        return (
-            TrainState(params=params, opt_state=opt_state, step=state.step + 1),
-            loss,
+        new_state = TrainState(
+            params=params, opt_state=opt_state, step=state.step + 1
         )
+        if instrument is None:
+            return new_state, loss
+        return new_state, (loss, instrument(aux, grads, updates, params, batch))
 
     return body
 
@@ -409,6 +425,12 @@ class Trainer:
         self.config = config
         self.mesh = None
         self._eval_tail = 0  # real samples in a repeat-padded tail eval batch
+        if config.train.telemetry and config.train.distributed and config.mesh.pipe > 1:
+            # BEFORE any mesh/pipeline setup so the error names the real
+            # conflict, not a downstream pipeline validation.
+            from gnot_tpu.obs.telemetry import PIPE_ERROR
+
+            raise ValueError(PIPE_ERROR)
         if config.data.packed:
             # Validate BEFORE any mesh/pad setup so the error names the
             # real conflict, not a downstream divisibility check.
@@ -615,6 +637,9 @@ class Trainer:
                 f"steps_per_dispatch must be >= 1, got "
                 f"{config.train.steps_per_dispatch}"
             )
+        # Telemetry runtime pieces (obs/): built in fit() when enabled.
+        self._telemetry = None
+        self._recompiles = None
         self.metrics_sink = metrics_sink
         self.checkpointer = checkpointer
         self.multi_train_step = None
@@ -685,8 +710,18 @@ class Trainer:
             if restored is not None:
                 self.state, self.start_epoch, self.best_metric = restored
                 self.host_step = int(self.state.step)  # one-time sync
+        # Telemetry swaps in the instrumented step builders — SAME
+        # signatures, same train_step_body math, extra side outputs
+        # (obs/telemetry.py) — selected once here; eval steps are
+        # shared with the plain path.
+        telemetry_on = self.config.train.telemetry
+        if telemetry_on:
+            from gnot_tpu.obs import telemetry as obs_telemetry
         if self.mesh is None:
-            self.train_step = make_train_step(
+            build_step = (
+                obs_telemetry.make_train_step if telemetry_on else make_train_step
+            )
+            self.train_step = build_step(
                 self.model, self.config.optim, self.config.train.loss,
                 loss_fn=self._loss_fn,
             )
@@ -696,7 +731,12 @@ class Trainer:
         if self.mesh is not None:
             from gnot_tpu.parallel import mesh as mesh_lib
 
-            self.train_step = mesh_lib.make_sharded_train_step(
+            build_step = (
+                obs_telemetry.make_sharded_train_step
+                if telemetry_on
+                else mesh_lib.make_sharded_train_step
+            )
+            self.train_step = build_step(
                 self.model, self.config.optim, self.config.train.loss,
                 self.mesh, self.state, self.config.mesh.microbatches,
                 loss_fn=self._loss_fn,
@@ -726,7 +766,12 @@ class Trainer:
                 )
         if self.config.train.steps_per_dispatch > 1:
             if self.mesh is None:
-                self.multi_train_step = make_multi_train_step(
+                build_multi = (
+                    obs_telemetry.make_multi_train_step
+                    if telemetry_on
+                    else make_multi_train_step
+                )
+                self.multi_train_step = build_multi(
                     self.model, self.config.optim, self.config.train.loss,
                     loss_fn=self._loss_fn,
                 )
@@ -736,7 +781,12 @@ class Trainer:
             else:
                 from gnot_tpu.parallel import mesh as mesh_lib
 
-                self.multi_train_step = mesh_lib.make_sharded_multi_train_step(
+                build_multi = (
+                    obs_telemetry.make_sharded_multi_train_step
+                    if telemetry_on
+                    else mesh_lib.make_sharded_multi_train_step
+                )
+                self.multi_train_step = build_multi(
                     self.model, self.config.optim, self.config.train.loss,
                     self.mesh, self.state, loss_fn=self._loss_fn,
                 )
@@ -994,10 +1044,72 @@ class Trainer:
         print(f"Eval (best checkpoint from epoch {epoch}): {res}")
         return res
 
+    def _watchdog_loss_fn(self):
+        """Scalar loss for the current layout — what the NaN watchdog
+        re-executes under utils.debug.checked to localize the op."""
+        if self._loss_fn is not None:
+            return self._loss_fn
+        model, loss_name = self.model, self.config.train.loss
+        return lambda p, b: batch_loss(model, p, b, loss_name)
+
+    def _handle_nonfinite_loss(self, step, epoch, loss, batch) -> None:
+        """NaN watchdog (fires from TelemetryBuffer.drain on the first
+        non-finite loss): localize via a checkify re-run of the
+        offending batch, record the event, and stop the run — training
+        past a NaN only burns chips. Multi-process runs skip the
+        localization re-run (only process 0 would enter it: a one-host
+        collective would hang the job before the error surfaces)."""
+        detail = None
+        if jax.process_count() == 1:
+            from gnot_tpu.obs import health
+
+            detail = health.localize_nan(
+                self._watchdog_loss_fn(), self.state.params, batch
+            )
+        if self.metrics_sink is not None:
+            self.metrics_sink.log(
+                event="non_finite_loss", step=step, epoch=epoch, loss=loss,
+                detail=detail,
+            )
+            self.metrics_sink.flush()
+        raise FloatingPointError(
+            f"non-finite train loss at epoch {epoch}, step {step}"
+            + (
+                f" (checkify: {detail})"
+                if detail
+                else " (checkify re-run did not reproduce — the bad "
+                     "value predates this step's forward)"
+                if jax.process_count() == 1
+                else ""
+            )
+        )
+
     def fit(self) -> float:
         if self.state is None:
             self.initialize()
         cfg = self.config
+        if cfg.train.telemetry:
+            from gnot_tpu.obs import health
+            from gnot_tpu.obs import telemetry as obs_telemetry
+
+            self._recompiles = health.RecompileMonitor()
+            self._recompiles.register("train_step", self.train_step)
+            self._recompiles.register("eval_step", self.eval_step)
+            self._recompiles.register("multi_train_step", self.multi_train_step)
+            self._recompiles.register("multi_eval_step", self.multi_eval_step)
+            # Buffer on EVERY process (the health checks need the
+            # replicated losses everywhere); only process 0 has a sink
+            # and writes records.
+            self._telemetry = obs_telemetry.TelemetryBuffer(
+                self.metrics_sink,
+                cfg.train.log_every,
+                slow_step=health.SlowStepMonitor(),
+                on_nonfinite=self._handle_nonfinite_loss,
+                # Batch refs feed only the (single-process) checkify
+                # localization; multi-process skips it, so don't pin a
+                # drain window of padded batches per host for nothing.
+                keep_batches=jax.process_count() == 1,
+            )
         # Trace the second executed epoch (warm jit caches), or the only
         # one if the run has a single epoch.
         trace_at = min(self.start_epoch + 1, cfg.train.epochs - 1)
@@ -1011,13 +1123,23 @@ class Trainer:
 
             def run_single(batch):
                 lr = self.lr_fn(self.host_step, epoch)
-                self.state, loss = self.train_step(
+                # The telemetry step returns (state, (loss, telem));
+                # the plain step (state, loss) — one call site, the
+                # unpack is the only difference.
+                self.state, out = self.train_step(
                     self.state,
                     self._device_batch(batch),
                     jnp.asarray(lr, jnp.float32),
                 )
+                loss, telem = out if self._telemetry is not None else (out, None)
                 self.host_step += 1
                 losses.append(loss)
+                if self._telemetry is not None:
+                    # Device arrays only — the buffer syncs at drains.
+                    self._telemetry.append(
+                        steps=[self.host_step], epoch=epoch, lrs=[lr],
+                        loss=loss, telem=telem, batches=[batch],
+                    )
                 if cfg.train.debug_checks and not np.isfinite(
                     float(np.asarray(loss))
                 ):
@@ -1029,12 +1151,15 @@ class Trainer:
                         f"step {self.host_step}"
                     )
                 if (
-                    self.metrics_sink is not None
+                    self._telemetry is None
+                    and self.metrics_sink is not None
                     and cfg.train.log_every
                     and self.host_step % cfg.train.log_every == 0
                 ):
                     # float(loss) syncs; per-step logging is opt-in
-                    # and meant for coarse cadences.
+                    # and meant for coarse cadences. (With telemetry on
+                    # the buffer writes richer step records instead,
+                    # without the per-step sync.)
                     self.metrics_sink.log(
                         step=self.host_step,
                         epoch=epoch,
@@ -1049,14 +1174,25 @@ class Trainer:
                     self.lr_fn(self.host_step + i, epoch)
                     for i in range(len(group))
                 ]
-                self.state, loss_k = self.multi_train_step(
+                self.state, out = self.multi_train_step(
                     self.state,
                     self._device_batch(stack_batches(group), stacked=True),
                     jnp.asarray(lrs, dtype=jnp.float32),
                 )
+                loss_k, telem_k = (
+                    out if self._telemetry is not None else (out, None)
+                )
                 start = self.host_step
                 self.host_step += len(group)
                 losses.append(loss_k)
+                if self._telemetry is not None:
+                    # One stacked entry for the K scanned steps; the
+                    # drain unstacks after the (single) fetch.
+                    self._telemetry.append(
+                        steps=list(range(start + 1, start + len(group) + 1)),
+                        epoch=epoch, lrs=lrs, loss=loss_k, telem=telem_k,
+                        batches=group,
+                    )
                 if cfg.train.debug_checks and not np.all(
                     np.isfinite(np.asarray(loss_k))
                 ):
@@ -1064,7 +1200,11 @@ class Trainer:
                         f"non-finite train loss at epoch {epoch}, "
                         f"steps {start + 1}..{self.host_step}"
                     )
-                if self.metrics_sink is not None and cfg.train.log_every:
+                if (
+                    self._telemetry is None
+                    and self.metrics_sink is not None
+                    and cfg.train.log_every
+                ):
                     host_lk = None
                     for i in range(len(group)):
                         s = start + i + 1
@@ -1091,6 +1231,12 @@ class Trainer:
                         else:
                             points += item.n_real_points
                             run_single(item)
+                if self._telemetry is not None:
+                    # Flush the partial window BEFORE eval: the NaN
+                    # watchdog must fire before eval wastes a pass on a
+                    # dead run, and the epoch boundary is a sync point
+                    # anyway (train_loss fetch below).
+                    self._telemetry.drain()
                 train_loss = float(
                     np.mean(
                         np.concatenate(
@@ -1106,6 +1252,38 @@ class Trainer:
                     res = self.evaluate()
             print(f"Epoch {epoch}, Test Metric: {res}")
             print("-----------------------------------")
+
+            if self._recompiles is not None:
+                # First check baselines the warm-up compiles; later
+                # positive deltas are recompiles (shape leaks).
+                deltas = self._recompiles.check()
+                if deltas:
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "recompilation detected during epoch %d: %s "
+                        "(shape leak? check bucketing and static args)",
+                        epoch, deltas,
+                    )
+                    if self.metrics_sink is not None:
+                        self.metrics_sink.log(
+                            event="recompile", epoch=epoch,
+                            **{f"compiles/{k}": v for k, v in deltas.items()},
+                        )
+            if self._telemetry is not None and jax.process_count() > 1:
+                # Straggler gauge — COLLECTIVE, so every process calls
+                # it; only process 0 (the sink owner) writes.
+                from gnot_tpu.parallel import multihost
+
+                per_host = multihost.per_host_gauge(
+                    dt / max(1, len(self.train_loader))
+                )
+                if self.metrics_sink is not None:
+                    self.metrics_sink.log(
+                        event="host_skew", epoch=epoch,
+                        step_time_per_host=per_host,
+                        skew_s=float(per_host.max() - per_host.min()),
+                    )
 
             if self.metrics_sink is not None:
                 self.metrics_sink.log(

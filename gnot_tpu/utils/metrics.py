@@ -3,7 +3,8 @@
 The reference logs via three ``print`` lines per epoch
 (``/root/reference/main.py:105,147-148``). The trainer keeps those exact
 console lines for diffability; this module adds structured JSONL metrics
-(loss, LR, throughput, step time) on top — SURVEY.md §5 observability.
+(loss, LR, throughput, step time, and the obs/ telemetry records —
+docs/observability.md documents the full schema) on top.
 """
 
 from __future__ import annotations
@@ -17,8 +18,41 @@ from typing import Any, TextIO
 import numpy as np
 
 
+def _coerce(v: Any) -> Any:
+    """JSON-safe recursive coercion: numpy scalars -> Python, arrays ->
+    (nested) lists, non-finite floats -> null. json.dumps would emit
+    bare NaN/Infinity tokens (invalid JSON) for non-finite floats —
+    e.g. a diverged loss or the inf metric of an empty test set — and
+    rejects numpy scalars/arrays outright. Telemetry records carry
+    ``[n_expert]`` gate-load vectors, hence the recursion."""
+    if isinstance(v, dict):
+        return {k: _coerce(x) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_coerce(x) for x in v]
+    if isinstance(v, np.floating):
+        v = float(v)
+    elif isinstance(v, np.integer):
+        return int(v)
+    elif isinstance(v, np.bool_):
+        return bool(v)
+    elif isinstance(v, np.ndarray) or (
+        hasattr(v, "__array__") and not isinstance(v, (str, bytes, int, float, bool))
+    ):
+        # numpy AND jax arrays; 0-d arrays tolist() to a bare scalar.
+        return _coerce(np.asarray(v).tolist())
+    if isinstance(v, float) and not math.isfinite(v):
+        return None
+    return v
+
+
 class MetricsSink:
-    """Append-only JSONL metrics writer."""
+    """Append-only JSONL metrics writer.
+
+    Context manager: ``with MetricsSink(path) as sink: ...`` closes the
+    file on every exit path — an exception mid-run must not strand
+    buffered records (the file is line-buffered, but the final partial
+    line and the OS-level flush still need the close).
+    """
 
     def __init__(self, path: str):
         self.path = path
@@ -28,25 +62,19 @@ class MetricsSink:
 
     def log(self, **record: Any) -> None:
         record.setdefault("ts", time.time())
-        # json.dumps would emit bare NaN/Infinity tokens (invalid JSON)
-        # for non-finite floats — e.g. a diverged loss or the inf metric
-        # of an empty test set — and rejects numpy scalars outright, so
-        # coerce numpy scalars to Python first, then null non-finites.
-        def coerce(v):
-            if isinstance(v, np.floating):
-                return float(v)
-            if isinstance(v, np.integer):
-                return int(v)
-            if isinstance(v, np.bool_):
-                return bool(v)
-            return v
-
-        record = {k: coerce(v) for k, v in record.items()}
-        record = {
-            k: (None if isinstance(v, float) and not math.isfinite(v) else v)
-            for k, v in record.items()
-        }
+        record = {k: _coerce(v) for k, v in record.items()}
         self._fh.write(json.dumps(record) + "\n")
 
+    def flush(self) -> None:
+        if not self._fh.closed:
+            self._fh.flush()
+
     def close(self) -> None:
-        self._fh.close()
+        if not self._fh.closed:
+            self._fh.close()
+
+    def __enter__(self) -> "MetricsSink":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()

@@ -1,0 +1,52 @@
+"""Guard the committed artifact corpus: every docs/artifacts/*.jsonl
+must parse as valid JSONL (the quality-gate tests pin against these
+files; a hand-edit or a writer regression that emits bare NaN tokens
+would otherwise surface as an obscure gate failure much later)."""
+
+import glob
+import json
+import os
+
+import pytest
+
+ARTIFACT_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "docs", "artifacts",
+)
+
+
+def _jsonl_files():
+    return sorted(glob.glob(os.path.join(ARTIFACT_DIR, "*.jsonl")))
+
+
+def test_artifact_corpus_present():
+    assert _jsonl_files(), f"no JSONL artifacts under {ARTIFACT_DIR}"
+
+
+@pytest.mark.parametrize(
+    "path", _jsonl_files(), ids=[os.path.basename(p) for p in _jsonl_files()]
+)
+def test_artifact_parses_as_jsonl(path):
+    with open(path) as f:
+        lines = [l for l in f.read().splitlines() if l.strip()]
+    assert lines, f"{path} is empty"
+    for i, line in enumerate(lines, 1):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError as exc:
+            raise AssertionError(
+                f"{os.path.basename(path)}:{i} is not valid JSON: {exc}"
+            ) from exc
+        assert isinstance(rec, dict), (
+            f"{os.path.basename(path)}:{i} is not a JSON object"
+        )
+
+
+@pytest.mark.parametrize(
+    "path",
+    sorted(glob.glob(os.path.join(ARTIFACT_DIR, "*.json"))),
+    ids=lambda p: os.path.basename(p),
+)
+def test_json_artifact_parses(path):
+    with open(path) as f:
+        json.load(f)

@@ -25,9 +25,20 @@ import os, sys
 proc_id = int(sys.argv[1]); n_procs = int(sys.argv[2])
 n_devices = int(sys.argv[3]); port = sys.argv[4]
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Worker processes are fresh interpreters: set the device count BEFORE
+# jax imports, via XLA_FLAGS, which every jax version honors
+# (jax_num_cpu_devices does not exist on 0.4.x builds).
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n_devices}"
+    ).strip()
 import jax
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", n_devices)
+try:
+    jax.config.update("jax_num_cpu_devices", n_devices)
+except AttributeError:
+    pass  # XLA_FLAGS above already provisioned the devices
 jax.distributed.initialize(
     coordinator_address=f"localhost:{port}", num_processes=n_procs, process_id=proc_id
 )

@@ -1,0 +1,354 @@
+"""Observability subsystem (gnot_tpu/obs/): telemetry record schema,
+run manifests, health monitors, and the trainer/CLI integration."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gnot_tpu.config import ModelConfig, make_config
+from gnot_tpu.data import datasets
+from gnot_tpu.train.trainer import Trainer
+from gnot_tpu.utils.metrics import MetricsSink
+
+TINY_ARGS = [
+    "--n_attn_layers", "2", "--n_attn_hidden_dim", "16",
+    "--n_mlp_num_layers", "1", "--n_mlp_hidden_dim", "16",
+    "--n_input_hidden_dim", "16", "--n_expert", "3", "--n_head", "2",
+    "--epochs", "2", "--n_train", "8", "--n_test", "4",
+    "--synthetic", "ns2d",
+]
+
+
+def read_jsonl(path):
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+# --- gate stats (models/layers.py) ----------------------------------------
+
+
+def test_gate_stats_uniform_scores():
+    from gnot_tpu.models.layers import gate_stats
+
+    e = 4
+    scores = jnp.full((2, 8, e), 1.0 / e)
+    out = gate_stats(scores, None)
+    np.testing.assert_allclose(np.asarray(out["gate_load"]), np.full(e, 1 / e), rtol=1e-6)
+    np.testing.assert_allclose(float(out["gate_entropy"]), np.log(e), rtol=1e-6)
+
+
+def test_gate_stats_masked_tokens_excluded():
+    from gnot_tpu.models.layers import gate_stats
+
+    # Real token gates expert 0; the padded token gates expert 1 and
+    # must not contribute.
+    scores = jnp.asarray([[[1.0, 0.0], [0.0, 1.0]]])
+    mask = jnp.asarray([[1.0, 0.0]])
+    out = gate_stats(scores, mask)
+    np.testing.assert_allclose(np.asarray(out["gate_load"]), [1.0, 0.0], atol=1e-6)
+    np.testing.assert_allclose(float(out["gate_entropy"]), 0.0, atol=1e-5)
+    # Collapsed gate signature: one load ~1, entropy ~0.
+
+
+# --- telemetry records via the CLI (the acceptance-criteria test) ---------
+
+
+def test_telemetry_run_produces_manifest_and_step_records(tmp_path):
+    """--telemetry --metrics_path: run.json manifest + JSONL step
+    records with grad-norm and per-layer gate-load stats (ISSUE 1
+    acceptance criterion)."""
+    from gnot_tpu.main import main
+
+    mp = str(tmp_path / "metrics.jsonl")
+    best = main(TINY_ARGS + ["--metrics_path", mp, "--log_every", "2", "--telemetry"])
+    assert np.isfinite(best)
+
+    # Manifest: next to the metrics file, with the provenance fields.
+    man_path = str(tmp_path / "run.json")
+    assert os.path.exists(man_path)
+    man = json.load(open(man_path))
+    assert man["config"]["train"]["telemetry"] is True
+    assert man["config"]["train"]["metrics_path"] == mp
+    assert man["model_config"]["n_expert"] == 3
+    assert "jax" in man["versions"]
+    assert man["devices"]["n_devices"] >= 1 and man["devices"]["platform"] == "cpu"
+    assert "rev" in man["git"] and "dirty" in man["git"]
+    assert "dir" in man["compile_cache"]
+
+    recs = read_jsonl(mp)
+    step_recs = [r for r in recs if "grad_norm" in r]
+    assert step_recs, "no telemetry step records written"
+    for r in step_recs:
+        # 8 train / batch 4 = 2 steps/epoch; log_every=2 -> even steps.
+        assert r["step"] % 2 == 0
+        for key in ("loss", "lr", "grad_norm", "param_norm", "update_norm",
+                    "padding_waste", "ts"):
+            assert isinstance(r[key], float), (key, r[key])
+        for layer in range(2):  # per-layer gate stats, n_attn_layers=2
+            load = r[f"gate_load/block_{layer}"]
+            assert isinstance(load, list) and len(load) == 3  # n_expert
+            np.testing.assert_allclose(sum(load), 1.0, rtol=1e-4)
+            assert isinstance(r[f"gate_entropy/block_{layer}"], float)
+    # Per-epoch records still written alongside.
+    assert [r for r in recs if "test_metric" in r]
+
+
+def test_telemetry_off_by_default(tmp_path):
+    from gnot_tpu.config import Config
+    from gnot_tpu.main import main
+
+    assert Config().train.telemetry is False
+    mp = str(tmp_path / "metrics.jsonl")
+    main(TINY_ARGS[:-2] + ["--epochs", "1", "--metrics_path", mp, "--log_every", "2"])
+    assert not any("grad_norm" in r for r in read_jsonl(mp))
+
+
+def test_telemetry_does_not_change_training(capsys):
+    """The instrumented step is the same train_step_body math: console
+    epoch losses match the plain run's."""
+    from helpers import assert_epoch_lines_close
+    from gnot_tpu.main import build_parser, config_from_args, model_config
+
+    def run(extra):
+        args = build_parser().parse_args(TINY_ARGS + extra)
+        cfg = config_from_args(args)
+        train, test = datasets.load(cfg.data)
+        mc = model_config(cfg, args, train)
+        best = Trainer(cfg, mc, train, test).fit()
+        return best, capsys.readouterr().out
+
+    b_plain, out_plain = run([])
+    b_tel, out_tel = run(["--telemetry"])
+    np.testing.assert_allclose(b_plain, b_tel, rtol=1e-5)
+    assert_epoch_lines_close(out_plain, out_tel, rtol=1e-5)
+
+
+def test_telemetry_steps_per_dispatch(tmp_path):
+    """The scanned K-step dispatch path stacks telemetry per step: every
+    step gets its record, same schema."""
+    from gnot_tpu.main import main
+
+    mp = str(tmp_path / "metrics.jsonl")
+    main(TINY_ARGS + ["--epochs", "1", "--batch_size", "2",
+                      "--metrics_path", mp, "--log_every", "1",
+                      "--telemetry", "--steps_per_dispatch", "2"])
+    step_recs = [r for r in read_jsonl(mp) if "grad_norm" in r]
+    assert [r["step"] for r in step_recs] == [1, 2, 3, 4]
+    assert all(len(r["gate_load/block_0"]) == 3 for r in step_recs)
+
+
+def test_telemetry_sharded_mesh(tmp_path):
+    """GSPMD path: telemetry outputs come back replicated; records carry
+    the same schema; the manifest names the mesh."""
+    from gnot_tpu.main import main
+
+    mp = str(tmp_path / "metrics.jsonl")
+    main(TINY_ARGS + ["--epochs", "1", "--metrics_path", mp,
+                      "--log_every", "1", "--telemetry",
+                      "--distributed", "--mesh_data", "4", "--mesh_model", "2"])
+    step_recs = [r for r in read_jsonl(mp) if "grad_norm" in r]
+    assert step_recs and all(len(r["gate_load/block_0"]) == 3 for r in step_recs)
+    man = json.load(open(tmp_path / "run.json"))
+    assert man["mesh"]["data"] == 4 and man["mesh"]["model"] == 2
+
+
+def test_telemetry_rejects_pipeline_mesh():
+    cfg = make_config(**{
+        "train.telemetry": True, "train.distributed": True,
+        "mesh.pipe": 2, "mesh.data": 4,
+    })
+    train = datasets.synth_ns2d(8, n_points=16, seed=0)
+    mc = ModelConfig(
+        n_attn_layers=2, n_attn_hidden_dim=16, n_mlp_num_layers=1,
+        n_mlp_hidden_dim=16, n_input_hidden_dim=16, n_expert=2, n_head=2,
+        **datasets.infer_model_dims(train),
+    )
+    with pytest.raises(ValueError, match="telemetry"):
+        Trainer(cfg, mc, train, [])
+
+
+def test_manifest_does_not_clobber_other_runs(tmp_path):
+    """Two runs sharing a directory: the second manifest falls back to
+    <metrics-stem>.run.json; a re-run of the SAME metrics file keeps
+    run.json."""
+    from gnot_tpu.obs import manifest as manifest_lib
+
+    mp1 = str(tmp_path / "train.jsonl")
+    p1 = manifest_lib.manifest_path_for(mp1)
+    assert os.path.basename(p1) == "run.json"
+    manifest_lib.write_manifest(p1, extra={"metrics_path": mp1, "kind": "train"})
+
+    # Same metrics file again -> same manifest path (re-run).
+    assert manifest_lib.manifest_path_for(mp1) == p1
+
+    # A different run in the same dir -> fallback name, original intact.
+    mp2 = str(tmp_path / "bench.jsonl")
+    p2 = manifest_lib.manifest_path_for(mp2)
+    assert os.path.basename(p2) == "bench.run.json"
+    manifest_lib.write_manifest(p2, extra={"metrics_path": mp2, "kind": "bench"})
+    assert json.load(open(p1))["kind"] == "train"
+    assert json.load(open(p2))["kind"] == "bench"
+
+
+# --- NaN watchdog ---------------------------------------------------------
+
+
+def test_nan_watchdog_localizes_and_records(tmp_path):
+    """First non-finite loss: checkify re-run names the producing op,
+    the sink gets the event record, the run stops."""
+    train = datasets.synth_ns2d(8, n_points=16, seed=0)
+    train[2].coords[0, 0] = np.nan  # poison one sample of batch 0
+    test = datasets.synth_ns2d(4, n_points=16, seed=1)
+    mp = str(tmp_path / "metrics.jsonl")
+    cfg = make_config(**{
+        "data.n_train": 8, "data.n_test": 4, "train.epochs": 1,
+        "train.telemetry": True, "train.log_every": 2,
+        "data.shuffle_train": False, "train.metrics_path": mp,
+    })
+    mc = ModelConfig(
+        n_attn_layers=1, n_attn_hidden_dim=16, n_mlp_num_layers=1,
+        n_mlp_hidden_dim=16, n_input_hidden_dim=16, n_expert=2, n_head=2,
+        **datasets.infer_model_dims(train),
+    )
+    with MetricsSink(mp) as sink:
+        trainer = Trainer(cfg, mc, train, test, metrics_sink=sink)
+        with pytest.raises(FloatingPointError, match="epoch 0"):
+            trainer.fit()
+    events = [r for r in read_jsonl(mp) if r.get("event") == "non_finite_loss"]
+    assert len(events) == 1
+    assert events[0]["step"] == 1 and events[0]["loss"] is None
+    assert "nan" in events[0]["detail"]  # checkify localization
+
+
+# --- health monitors ------------------------------------------------------
+
+
+def test_recompile_monitor_detects_new_trace():
+    from gnot_tpu.obs.health import RecompileMonitor
+
+    f = jax.jit(lambda x: x * 2)
+    f(jnp.ones((2,)))
+    mon = RecompileMonitor()
+    mon.register("f", f)
+    assert mon.check() == {}  # baseline
+    assert mon.check() == {}  # no new traces
+    f(jnp.ones((3,)))  # shape leak -> recompile
+    assert mon.check() == {"f": 1}
+
+
+def test_recompile_monitor_ignores_uncountable_fns():
+    from gnot_tpu.obs.health import RecompileMonitor
+
+    mon = RecompileMonitor()
+    mon.register("not_jitted", lambda x: x)
+    mon.register("none", None)
+    assert mon.check() == {}
+
+
+def test_slow_step_monitor_flags_outliers():
+    from gnot_tpu.obs.health import SlowStepMonitor
+
+    mon = SlowStepMonitor(factor=3.0, warmup=5)
+    # A huge spike during warmup must NOT flag (compiles live there).
+    assert mon.observe(50.0) is None
+    for _ in range(10):
+        assert mon.observe(0.1) is None
+    out = mon.observe(1.0)  # 10x the median
+    assert out is not None and out["slowdown"] > 3.0
+    assert out["median_s"] == pytest.approx(0.1)
+    assert mon.observe(0.1) is None  # back to normal
+
+
+def test_localize_nan_reports_clean_run():
+    from gnot_tpu.obs.health import localize_nan
+
+    loss_fn = lambda p, b: jnp.sum(jnp.sqrt(p))
+    assert localize_nan(loss_fn, jnp.asarray([4.0]), None) is None
+    detail = localize_nan(loss_fn, jnp.asarray([-4.0]), None)
+    assert detail is not None and "nan" in detail
+
+
+def test_per_host_gauge_single_process():
+    from gnot_tpu.parallel import multihost
+
+    out = multihost.per_host_gauge(0.25)
+    np.testing.assert_allclose(out, [0.25])
+
+
+# --- telemetry buffer -----------------------------------------------------
+
+
+def test_telemetry_buffer_drains_on_window_and_flush(tmp_path):
+    from gnot_tpu.obs.telemetry import TelemetryBuffer
+
+    mp = str(tmp_path / "m.jsonl")
+    with MetricsSink(mp) as sink:
+        buf = TelemetryBuffer(sink, log_every=2)
+        for s in range(1, 4):
+            buf.append(steps=[s], epoch=0, lrs=[1e-3],
+                       loss=jnp.asarray(float(s)),
+                       telem={"grad_norm": jnp.asarray(0.5)},
+                       batches=[None])
+        # 3 appended, window=2: steps 1-2 drained, step 3 pending.
+        assert [r["step"] for r in read_jsonl(mp)] == [2]
+        buf.drain()  # epoch-end flush
+        recs = read_jsonl(mp)
+        assert [r["step"] for r in recs] == [2]  # step 3 not a multiple
+        assert recs[0]["loss"] == 2.0 and recs[0]["grad_norm"] == 0.5
+
+
+# --- satellites: sink context manager, bench --metrics_path ---------------
+
+
+def test_metrics_sink_context_manager_closes_on_error(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with pytest.raises(RuntimeError):
+        with MetricsSink(path) as sink:
+            sink.log(a=1)
+            raise RuntimeError("mid-run crash")
+    assert sink._fh.closed
+    assert read_jsonl(path)[0]["a"] == 1  # record survived the crash
+    sink.close()  # idempotent
+
+
+def test_metrics_sink_coerces_arrays_and_nonfinite(tmp_path):
+    path = str(tmp_path / "m.jsonl")
+    with MetricsSink(path) as sink:
+        sink.log(
+            vec=np.asarray([1.0, np.nan, np.inf]),
+            scalar0d=np.asarray(2.5),
+            jarr=jnp.asarray([0.5, 1.5]),
+            nested=[np.float32(1.0), float("nan")],
+        )
+    rec = read_jsonl(path)[0]
+    assert rec["vec"] == [1.0, None, None]
+    assert rec["scalar0d"] == 2.5
+    assert rec["jarr"] == [0.5, 1.5]
+    assert rec["nested"] == [1.0, None]
+
+
+def test_bench_metrics_path_emits_sink_schema(tmp_path, monkeypatch, capsys):
+    """bench.py --metrics_path writes the MetricsSink JSONL schema plus
+    a run.json manifest — one report tool reads bench and trainer."""
+    import bench
+
+    mp = str(tmp_path / "bench.jsonl")
+    monkeypatch.setattr("sys.argv", [
+        "bench.py", "--timing", "persstep", "--steps", "2", "--warmup", "1",
+        "--repeats", "1", "--cpu_steps", "0", "--n_points", "64",
+        "--batch_size", "2", "--dtype", "float32", "--metrics_path", mp,
+    ])
+    bench.main()
+    out = capsys.readouterr().out
+    printed = json.loads(out.strip().splitlines()[-1])
+    recs = read_jsonl(mp)
+    assert len(recs) == 1 and recs[0]["kind"] == "bench"
+    assert recs[0]["metric"] == printed["metric"]
+    assert recs[0]["value"] == printed["value"]
+    assert "ts" in recs[0]  # the sink's timestamp, same as trainer records
+    man = json.load(open(tmp_path / "run.json"))
+    assert man["kind"] == "bench" and man["config"]["n_points"] == 64

@@ -228,11 +228,13 @@ def test_ring_allreduce_matches_psum_generic():
     mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(8), ("s",))
     x = jax.random.normal(jax.random.key(0), (8, 4, 4))
 
-    ring = jax.shard_map(
+    from gnot_tpu.ops.collectives import shard_map
+
+    ring = shard_map(
         lambda t: ring_allreduce(t, "s", 8),
         mesh=mesh, in_specs=P("s"), out_specs=P("s"),
     )(x)
-    ps = jax.shard_map(
+    ps = shard_map(
         lambda t: jax.lax.psum(t, "s"),
         mesh=mesh, in_specs=P("s"), out_specs=P("s"),
     )(x)

@@ -40,6 +40,27 @@ def test_trace_epoch_noop_for_other_epochs(tmp_path):
         pass
 
 
+def test_trace_epoch_custom_trace_at(tmp_path):
+    """trace_at selects which epoch fires (Trainer picks the second
+    executed epoch to keep compile noise out of the trace)."""
+    d = str(tmp_path / "prof3")
+    with profiling.trace_epoch(d, epoch=1, trace_at=3):
+        pass
+    assert not os.path.exists(d)
+    with profiling.trace_epoch(d, epoch=3, trace_at=3):
+        jnp.zeros((4,)).sum().block_until_ready()
+    assert os.path.isdir(d) and os.listdir(d)
+
+
+def test_annotate_outside_trace_is_harmless():
+    """annotate() is a reentrant no-op span when no trace is active —
+    the trainer wraps every epoch in it unconditionally."""
+    with profiling.annotate("outer"):
+        with profiling.annotate("inner"):
+            x = float(jnp.ones(()).sum())
+    assert x == 1.0
+
+
 def test_eval_only_roundtrip(tmp_path):
     """Train 2 epochs with checkpointing, then eval-only from the best
     checkpoint reproduces the best metric."""

@@ -1,0 +1,167 @@
+"""Telemetry-overhead A/B: step time with obs telemetry OFF vs ON.
+
+The acceptance bar for the telemetry subsystem (docs/observability.md)
+is <2% step-time regression at ``log_every=10`` on the ns2d CPU
+micro-bench. This tool measures it honestly: both arms run the REAL hot
+path — the ON arm uses the instrumented train step plus a live
+``TelemetryBuffer`` draining into a real ``MetricsSink`` file every
+``log_every`` steps, so the measured cost includes the extra compiled
+reductions, the buffered device-array bookkeeping, the batched
+``device_get`` and the JSONL writes. Timed windows are best-of-N with a
+hard fetch at the end (the bench.py methodology; stalls only ever add
+time).
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/telemetry_ab.py \
+        --steps 60 --repeats 3 --out docs/artifacts/telemetry_overhead_ab.jsonl
+
+Emits one JSONL record per arm plus a summary record with
+``overhead_frac``; committed as docs/artifacts/telemetry_overhead_ab.jsonl.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build(telemetry: bool, n_points: int, batch_size: int):
+    from gnot_tpu.config import ModelConfig, OptimConfig
+    from gnot_tpu.data import datasets
+    from gnot_tpu.data.batch import Loader
+    from gnot_tpu.models.gnot import GNOT
+    from gnot_tpu.obs import telemetry as obs_telemetry
+    from gnot_tpu.train.trainer import init_state, make_train_step
+
+    samples = datasets.synth_ns2d(batch_size, n_points=n_points, seed=0)
+    batch = next(iter(Loader(samples, batch_size)))
+    # Micro-bench architecture: reference shape at half width/depth so a
+    # CPU arm finishes in seconds while norms/gate stats keep realistic
+    # relative cost.
+    mc = ModelConfig(
+        n_attn_layers=2, n_attn_hidden_dim=128, n_mlp_num_layers=2,
+        n_mlp_hidden_dim=128, n_input_hidden_dim=128, n_expert=3, n_head=4,
+        **datasets.infer_model_dims(samples),
+    )
+    model = GNOT(mc)
+    optim = OptimConfig()
+    state = init_state(model, optim, batch, seed=0)
+    if telemetry:
+        step = obs_telemetry.make_train_step(model, optim, "rel_l2")
+    else:
+        step = make_train_step(model, optim, "rel_l2")
+    return step, state, batch
+
+
+def _window(step, state0, batch, telemetry: bool, steps: int, log_every: int,
+            copy_tree, lr) -> float:
+    """One timed window of ``steps`` steps; the ON arm runs the full
+    buffer+sink hot path. Warm-up step outside the window."""
+    from gnot_tpu.obs.telemetry import TelemetryBuffer
+    from gnot_tpu.utils.metrics import MetricsSink
+
+    state = copy_tree(state0)
+    sink = buf = None
+    if telemetry:
+        sink = MetricsSink(os.path.join(tempfile.mkdtemp(), "telemetry_ab.jsonl"))
+        buf = TelemetryBuffer(sink, log_every)
+
+    def one(state, i):
+        if telemetry:
+            state, (loss, telem) = step(state, batch, lr)
+            buf.append(steps=[i], epoch=0, lrs=[1e-3], loss=loss,
+                       telem=telem, batches=[batch])
+        else:
+            state, loss = step(state, batch, lr)
+        return state, loss
+
+    state, loss = one(state, 0)
+    np.asarray(loss)
+    t0 = time.perf_counter()
+    for i in range(1, steps + 1):
+        state, loss = one(state, i)
+    if buf is not None:
+        buf.drain()
+    np.asarray(loss)  # hard fetch: the window ends when the device does
+    sec = (time.perf_counter() - t0) / steps
+    if sink is not None:
+        sink.close()
+    return sec
+
+
+def time_ab(n_points: int, batch_size: int, steps: int, log_every: int,
+            repeats: int) -> tuple[float, float]:
+    """Best-of-``repeats`` seconds/step for (off, on), with the arms'
+    timed windows INTERLEAVED off/on/off/on: ambient machine-load drift
+    over the minutes the A/B takes hits both arms alike instead of
+    whichever ran second (observed mis-attributing ~5% to the second
+    arm on a shared host)."""
+    step_off, state_off, batch = build(False, n_points, batch_size)
+    step_on, state_on, _ = build(True, n_points, batch_size)
+    lr = jnp.asarray(1e-3, jnp.float32)
+    copy_tree = jax.jit(lambda s: jax.tree.map(jnp.copy, s))
+    best_off = best_on = float("inf")
+    for _ in range(max(1, repeats)):
+        best_off = min(
+            best_off,
+            _window(step_off, state_off, batch, False, steps, log_every,
+                    copy_tree, lr),
+        )
+        best_on = min(
+            best_on,
+            _window(step_on, state_on, batch, True, steps, log_every,
+                    copy_tree, lr),
+        )
+    return best_off, best_on
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--n_points", type=int, default=512)
+    p.add_argument("--batch_size", type=int, default=4)
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--log_every", type=int, default=10)
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--out", type=str, default="")
+    args = p.parse_args()
+
+    platform = jax.devices()[0].platform
+    sec_off, sec_on = time_ab(
+        args.n_points, args.batch_size, args.steps, args.log_every,
+        args.repeats,
+    )
+    records = []
+    for arm, sec in (("telemetry_off", sec_off), ("telemetry_on", sec_on)):
+        records.append({
+            "arm": arm, "ms_per_step": round(sec * 1e3, 4),
+            "platform": platform, "n_points": args.n_points,
+            "batch_size": args.batch_size, "steps": args.steps,
+            "log_every": args.log_every, "repeats": args.repeats,
+        })
+    off, on = records[0]["ms_per_step"], records[1]["ms_per_step"]
+    records.append({
+        "summary": "telemetry_overhead", "config": "ns2d_micro",
+        "ms_per_step_off": off, "ms_per_step_on": on,
+        "overhead_frac": round(on / off - 1.0, 4),
+        "bar": "overhead_frac < 0.02 at log_every=10",
+    })
+    out = "\n".join(json.dumps(r) for r in records) + "\n"
+    sys.stdout.write(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out)
+
+
+if __name__ == "__main__":
+    main()
