@@ -243,9 +243,50 @@ class TrainConfig:
     # Fault injection: stop cleanly after this many epochs (0 = off),
     # simulating a preemption mid-run. The schedule/epoch horizon stays
     # sized by `epochs`, so a --resume run continues the SAME regime —
-    # this is how resume correctness is tested.
+    # this is how resume correctness is tested. Alias for the
+    # ``stop_epoch@N`` entry of `inject_fault` (resilience/faults.py);
+    # both drive the same injection framework.
     stop_after_epoch: int = 0
+    # Deterministic fault injection spec (resilience/faults.py):
+    # comma-separated ``kind@N`` entries — nan_grad@step, bad_sample@
+    # step, sigterm@step, ckpt_io@count, corrupt_ckpt@epoch,
+    # stop_epoch@epochs. "" = no faults. Every recovery path below is
+    # testable on CPU through this knob (docs/robustness.md).
+    inject_fault: str = ""
+    # Automatic NaN recovery (resilience/supervisor.py): keep a rolling
+    # last-good on-device snapshot every `snapshot_every` steps; a
+    # detected non-finite loss rolls back to it, quarantines the
+    # offending dispatch, and continues — escalating to checkpoint
+    # restore after `max_rollbacks`, then to the hard abort. Off by
+    # default: recovery CHANGES the training trajectory (skipped
+    # batches, replayed steps), so the fail-fast default stays exact.
+    recovery: bool = False
+    snapshot_every: int = 50  # steps between last-good snapshots
+    max_rollbacks: int = 3  # rollback budget before escalating
+    # Graceful preemption (resilience/preemption.py): SIGTERM/SIGINT
+    # stop the run at the next step boundary — saving `latest` when a
+    # checkpointer is present, flushing the sink, exiting resume-ready
+    # — instead of dying mid-step. Multi-host runs coordinate the stop
+    # step via an allgathered flag every `preempt_sync_every`
+    # dispatches (1 = every step boundary; raise it when the per-
+    # dispatch collective matters).
+    graceful_preempt: bool = True
+    preempt_sync_every: int = 1
     seed: int = 0
+
+    def __post_init__(self) -> None:
+        if self.snapshot_every < 1:
+            raise ValueError(
+                f"snapshot_every must be >= 1, got {self.snapshot_every}"
+            )
+        if self.max_rollbacks < 0:
+            raise ValueError(
+                f"max_rollbacks must be >= 0, got {self.max_rollbacks}"
+            )
+        if self.preempt_sync_every < 1:
+            raise ValueError(
+                f"preempt_sync_every must be >= 1, got {self.preempt_sync_every}"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

@@ -32,9 +32,18 @@ def load_pickle(path: str) -> list[MeshSample]:
     value (kept uncast by the reference), input functions as a tuple or
     list (both truthy-checked there), possibly absent or empty.
     Malformed records raise a ValueError naming the record and the
-    expected schema, not an index/broadcast error from deep inside."""
-    with open(path, "rb") as f:
-        records = pickle.load(f)
+    expected schema, not an index/broadcast error from deep inside.
+    The read itself retries transient OSErrors with backoff
+    (resilience/retry.py) — dataset files live on the same flaky
+    remote filesystems checkpoints do; a truncated/garbled pickle
+    (``UnpicklingError``) is NOT transient and raises immediately."""
+    from gnot_tpu.resilience.retry import retry_io
+
+    def read():
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    records = retry_io(read, describe=f"dataset read {path}")
     if not isinstance(records, (list, tuple)):
         raise ValueError(
             f"{path}: expected a pickled list of [X, Y, theta, (f...)] "

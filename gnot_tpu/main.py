@@ -140,7 +140,32 @@ def build_parser() -> argparse.ArgumentParser:
         "--stop_after_epoch", type=int, default=0,
         help="fault injection: stop cleanly after N epochs as if "
              "preempted (schedule stays sized by --epochs; resume with "
-             "--resume to continue the same regime)"
+             "--resume to continue the same regime); alias for "
+             "--inject_fault stop_epoch@N"
+    )
+    p.add_argument(
+        "--inject_fault", type=str, default="",
+        help="deterministic fault injection (docs/robustness.md): "
+             "comma-separated kind@N entries — nan_grad@step, "
+             "bad_sample@step, sigterm@step, ckpt_io@count, "
+             "corrupt_ckpt@epoch, stop_epoch@epochs"
+    )
+    p.add_argument(
+        "--recovery", action="store_true",
+        help="automatic NaN recovery: rolling last-good on-device "
+             "snapshot every --snapshot_every steps; a non-finite loss "
+             "rolls back, quarantines the offending batch, and "
+             "continues — escalating to checkpoint restore after "
+             "--max_rollbacks, then to the hard abort (off by default: "
+             "recovery changes the training trajectory)"
+    )
+    p.add_argument("--snapshot_every", type=int, default=50)
+    p.add_argument("--max_rollbacks", type=int, default=3)
+    p.add_argument(
+        "--no_preempt", action="store_true",
+        help="disable graceful SIGTERM/SIGINT handling (stop at the "
+             "next step boundary + 'latest' save + resume-ready exit; "
+             "on by default)"
     )
     p.add_argument("--metrics_path", type=str, default="")
     p.add_argument(
@@ -235,6 +260,11 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "train.resume": args.resume,
             "train.checkpoint_every": args.checkpoint_every,
             "train.stop_after_epoch": args.stop_after_epoch,
+            "train.inject_fault": args.inject_fault,
+            "train.recovery": args.recovery,
+            "train.snapshot_every": args.snapshot_every,
+            "train.max_rollbacks": args.max_rollbacks,
+            "train.graceful_preempt": not args.no_preempt,
             "train.metrics_path": args.metrics_path,
             "train.log_every": args.log_every,
             "train.telemetry": args.telemetry,
@@ -488,10 +518,8 @@ def main(argv=None) -> float:
             cfg, mc, train_samples, test_samples, metrics_sink=sink,
             checkpointer=checkpointer,
         )
-        if cfg.train.metrics_path and jax.process_index() == 0:
-            # Provenance BEFORE training (a crashed run still has its
-            # manifest): config snapshot, git rev, versions, topology,
-            # mesh shape, compile-cache stats — docs/observability.md.
+        def write_run_manifest():
+            # Provenance manifest — docs/observability.md.
             import sys
 
             from gnot_tpu.obs import manifest as manifest_lib
@@ -506,11 +534,37 @@ def main(argv=None) -> float:
                 extra={
                     "metrics_path": cfg.train.metrics_path,
                     "kind": "eval" if args.eval_only else "train",
+                    # Which checkpoint (if any) this run resumed from —
+                    # including fallback provenance (checkpoint.py).
+                    "restore": (
+                        checkpointer.last_restore
+                        if checkpointer is not None
+                        else None
+                    ),
                 },
             )
+
+        manifests_on = cfg.train.metrics_path and jax.process_index() == 0
+        if manifests_on:
+            # BEFORE any heavy init: a run that crashes compiling or
+            # restoring still leaves its provenance on disk.
+            write_run_manifest()
         if args.eval_only:
             result = trainer.evaluate_from_checkpoint()
+            if manifests_on and checkpointer is not None:
+                # Record which 'best' checkpoint the eval actually
+                # restored (including any fallback walk) — known only
+                # after the restore above.
+                write_run_manifest()
         else:
+            trainer.initialize()  # every process (fit() would, identically)
+            if manifests_on and checkpointer is not None:
+                # Re-write with the restore provenance initialize() just
+                # produced (atomic; same content plus the `restore`
+                # field) — a resume that silently fell back from
+                # 'latest' to 'best' must be visible in run.json, not
+                # just the console.
+                write_run_manifest()
             result = trainer.fit()
 
         if (args.export_torch or args.predict_out) and not args.eval_only:

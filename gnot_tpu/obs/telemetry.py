@@ -233,6 +233,15 @@ class TelemetryBuffer:
         if self._pending_steps >= self.drain_every:
             self.drain()
 
+    def discard(self) -> None:
+        """Drop the buffered window WITHOUT draining (recovery rollback,
+        resilience/supervisor.py): the rolled-back steps' records would
+        be bogus, and the non-finite loss buried in them must not
+        re-fire the watchdog on the next drain."""
+        self._entries.clear()
+        self._pending_steps = 0
+        self._last_t = None
+
     def drain(self) -> None:
         if not self._entries:
             return

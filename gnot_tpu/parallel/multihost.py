@@ -214,6 +214,41 @@ def per_host_gauge(value: float) -> "np.ndarray":
     )
 
 
+def sync_flag(value: bool) -> bool:
+    """All-reduce OR of one host-local boolean across processes — the
+    preemption-coordination primitive (resilience/preemption.py): a
+    SIGTERM landing on ONE host must stop EVERY host on the same step,
+    or the survivors hang in the next collective. COLLECTIVE: every
+    process must call it together (the SPMD dispatch loop guarantees
+    the cadence). Single-process returns ``value`` without
+    communicating."""
+    if jax.process_count() == 1:
+        return bool(value)
+    return bool(per_host_gauge(float(bool(value))).max() > 0)
+
+
+def all_agree(token: str) -> bool:
+    """Whether every process holds the same ``token`` — the checkpoint
+    fallback walk's guard (train/checkpoint.py): the collective orbax
+    restore deadlocks if hosts attempt DIFFERENT candidate directories
+    (per-host transient I/O can desynchronize the walk), so each
+    candidate is agreed on before the restore and a divergence fails
+    loudly instead of hanging the pod. COLLECTIVE: every process must
+    call it together. Single-process returns True."""
+    if jax.process_count() == 1:
+        return True
+    import hashlib
+
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    digest = np.frombuffer(
+        hashlib.md5(token.encode()).digest(), np.uint8
+    ).copy()
+    gathered = np.asarray(multihost_utils.process_allgather(digest))
+    return bool((gathered == gathered.reshape(-1, 16)[0]).all())
+
+
 def global_batch(
     mesh: Mesh, local_batch: MeshBatch, *, stacked: bool = False
 ) -> MeshBatch:

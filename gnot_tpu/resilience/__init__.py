@@ -1,0 +1,41 @@
+"""Fault injection + automatic recovery (ROADMAP north star: a
+production-scale TPU stack survives the failures TPU fleets actually
+have — preemptions, NaN steps, corrupted or flaky checkpoint storage —
+instead of stranding hours of pod time on the first one).
+
+Four pieces, composing with the obs/ subsystem (every recovery action
+becomes a sink event — docs/robustness.md and docs/observability.md):
+
+* ``faults`` — a deterministic fault-injection framework driven by the
+  ``train.inject_fault`` config spec (``--inject_fault``): NaN
+  gradients or bad data samples at step k, SIGTERM at step k,
+  transient checkpoint-I/O errors, corrupted/truncated checkpoint
+  directories, clean stop after epoch N. Every recovery path is
+  thereby testable on CPU (tests/test_resilience.py, the chaos suite).
+* ``supervisor`` — the recovery ladder wired into ``Trainer.fit``: a
+  rolling last-good on-device snapshot every ``train.snapshot_every``
+  steps; a watchdog-detected non-finite loss rolls back to it,
+  quarantines the offending dispatch, and continues — under a bounded
+  budget (``train.max_rollbacks``) that escalates to checkpoint
+  restore and then to the hard abort with the localized-op report.
+* ``preemption`` — graceful SIGTERM/SIGINT handling: stop at the next
+  step boundary (coordinated across hosts so multi-host runs stop on
+  the same step), save ``latest``, flush the sink, exit resume-ready.
+* ``retry`` — exponential backoff + jitter around checkpoint and
+  dataset I/O.
+"""
+
+from gnot_tpu.resilience.faults import (  # noqa: F401
+    FaultInjector,
+    FaultSpec,
+    InjectedIOError,
+    corrupt_checkpoint,
+    parse_fault_spec,
+)
+from gnot_tpu.resilience.preemption import PreemptionHandler  # noqa: F401
+from gnot_tpu.resilience.retry import RetryPolicy, retry_io  # noqa: F401
+from gnot_tpu.resilience.supervisor import (  # noqa: F401
+    NonFiniteLossError,
+    PreemptionRequested,
+    RecoverySupervisor,
+)

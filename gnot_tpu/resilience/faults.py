@@ -1,0 +1,206 @@
+"""Deterministic fault injection, driven by ``train.inject_fault``.
+
+Every recovery path in the trainer exists because a real failure mode
+exists on TPU fleets; every one of them must therefore be reproducible
+on a CPU dev box or the recovery code rots untested. The spec grammar
+is a comma-separated list of ``kind@arg`` entries:
+
+* ``nan_grad@STEP`` — poison the batch dispatched as global step STEP
+  so its loss/gradients are NaN (the "one bad step" pathology: an
+  overflow, a poisoned collective, a flaky chip).
+* ``bad_sample@STEP`` — NaN the batch's *inputs* at step STEP (a
+  corrupt record that slipped through the data pipeline).
+* ``sigterm@STEP`` — deliver a real SIGTERM to this process before
+  dispatching step STEP (a TPU-VM preemption notice mid-epoch).
+* ``ckpt_io@N`` — arm N transient ``InjectedIOError``s against
+  checkpoint save/restore I/O (flaky remote filesystem).
+* ``corrupt_ckpt@EPOCH`` — after the ``latest`` save of epoch EPOCH
+  commits, truncate its directory on disk (torn write / partial
+  upload), so a later restore must fall back.
+* ``stop_epoch@N`` — stop cleanly after N epochs (the former
+  ``--stop_after_epoch`` fault, now one mechanism with the rest; the
+  flag remains as an alias).
+
+Steps are 1-indexed global update counts (the trainer's ``host_step``
+after the dispatch), matching the step numbers in metrics records.
+Step- and epoch-keyed faults fire once; ``ckpt_io`` decrements its
+budget per injected error.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import shutil
+import signal
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+KINDS = ("nan_grad", "bad_sample", "sigterm", "ckpt_io", "corrupt_ckpt", "stop_epoch")
+
+
+class InjectedIOError(OSError):
+    """A deliberately injected transient I/O failure (subclass of
+    OSError so the retry machinery treats it exactly like the real
+    thing)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    kind: str  # one of KINDS
+    at: int  # step / epoch / error budget, per kind
+
+
+def parse_fault_spec(spec: str) -> list[FaultSpec]:
+    """Parse ``"kind@N,kind@N"`` into FaultSpecs; raises ValueError
+    naming the bad entry and the grammar, not an unpack error."""
+    out: list[FaultSpec] = []
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        kind, sep, arg = entry.partition("@")
+        if not sep or kind not in KINDS or not arg.lstrip("-").isdigit():
+            raise ValueError(
+                f"bad fault spec entry {entry!r}: want kind@N with kind in "
+                f"{KINDS} and integer N (got spec {spec!r})"
+            )
+        at = int(arg)
+        if at < 1:
+            raise ValueError(f"fault spec entry {entry!r}: N must be >= 1")
+        out.append(FaultSpec(kind, at))
+    return out
+
+
+class FaultInjector:
+    """Holds the parsed plan; the trainer/checkpointer consult it at
+    the few hookable boundaries (pre-dispatch, checkpoint I/O,
+    post-save, epoch end). Single-fire bookkeeping lives here so the
+    call sites stay branch-free when no fault is armed."""
+
+    def __init__(self, specs: list[FaultSpec]):
+        self.specs = list(specs)
+        self._fired: set[tuple[str, int]] = set()
+        self._io_budget = sum(s.at for s in specs if s.kind == "ckpt_io")
+
+    @classmethod
+    def from_config(cls, train_cfg) -> "FaultInjector | None":
+        """Build from TrainConfig: the ``inject_fault`` spec plus the
+        legacy ``stop_after_epoch`` alias (mapped to ``stop_epoch@N``
+        so resume tests and chaos tests share one mechanism). Returns
+        None when nothing is armed (the common case — the trainer then
+        skips every hook)."""
+        specs = parse_fault_spec(getattr(train_cfg, "inject_fault", "") or "")
+        stop = getattr(train_cfg, "stop_after_epoch", 0)
+        if stop and not any(s.kind == "stop_epoch" for s in specs):
+            specs.append(FaultSpec("stop_epoch", stop))
+        return cls(specs) if specs else None
+
+    def _take(self, kind: str, at: int) -> bool:
+        """True exactly once per (kind, at) armed in the plan."""
+        key = (kind, at)
+        if key in self._fired:
+            return False
+        if any(s.kind == kind and s.at == at for s in self.specs):
+            self._fired.add(key)
+            return True
+        return False
+
+    # -- trainer hooks -----------------------------------------------------
+
+    def poison_batch(self, batch, step: int):
+        """Apply any batch-level fault armed for global step ``step``
+        (the 1-indexed step this batch will be dispatched as). Returns
+        the (possibly poisoned) batch — a copy; loader-owned arrays are
+        never written in place."""
+        if self._take("nan_grad", step):
+            logger.warning("fault injection: NaN targets at step %d", step)
+            return batch.replace(y=np.full_like(np.asarray(batch.y), np.nan))
+        if self._take("bad_sample", step):
+            logger.warning("fault injection: bad sample (NaN coords) at step %d", step)
+            return batch.replace(
+                coords=np.full_like(np.asarray(batch.coords), np.nan)
+            )
+        return batch
+
+    def maybe_sigterm(self, step: int) -> None:
+        """Deliver a real SIGTERM to this process before step ``step``
+        dispatches — exercising the actual signal path, not a mock."""
+        if self._take("sigterm", step):
+            logger.warning("fault injection: SIGTERM before step %d", step)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def stop_after_epoch(self, epoch: int) -> bool:
+        """Clean stop once ``epoch + 1`` epochs have completed (the
+        former ``--stop_after_epoch`` semantics)."""
+        return any(
+            s.kind == "stop_epoch" and epoch + 1 >= s.at for s in self.specs
+        )
+
+    # -- checkpoint hooks --------------------------------------------------
+
+    def maybe_io_error(self, op: str) -> None:
+        """Raise one InjectedIOError per armed ``ckpt_io`` budget unit
+        (the Checkpointer calls this at the top of each save/restore
+        I/O attempt, inside the retry wrapper)."""
+        if self._io_budget > 0:
+            self._io_budget -= 1
+            logger.warning(
+                "fault injection: transient I/O error on %s (%d left)",
+                op, self._io_budget,
+            )
+            raise InjectedIOError(f"injected transient failure during {op}")
+
+    def post_save(self, name: str, directory: str, epoch: int) -> None:
+        """``corrupt_ckpt@EPOCH``: truncate the just-committed ``latest``
+        directory of that epoch (files vanish, sidecar still points at
+        it — the torn-write shape restore fallback must survive)."""
+        if name == "latest" and self._take("corrupt_ckpt", epoch):
+            logger.warning(
+                "fault injection: truncating checkpoint dir %s", directory
+            )
+            corrupt_checkpoint(directory, mode="truncate")
+
+
+def corrupt_checkpoint(path: str, *, mode: str = "truncate") -> None:
+    """Corrupt a committed orbax checkpoint directory in one of the
+    shapes real storage produces (shared by the injector and the chaos
+    tests):
+
+    * ``truncate`` — delete roughly half the files under the directory
+      (partial upload / torn write); the dir exists but orbax restore
+      fails on it.
+    * ``remove`` — delete the directory outright (sidecar now dangles).
+    """
+    if mode == "remove":
+        shutil.rmtree(path, ignore_errors=True)
+        return
+    if mode != "truncate":
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    victims = []
+    for root, _, files in os.walk(path):
+        victims.extend(os.path.join(root, f) for f in sorted(files))
+    if not victims:
+        raise FileNotFoundError(f"no files to corrupt under {path}")
+    # Deterministic: drop every other file plus the orbax metadata (the
+    # restore-breaking piece), and truncate the survivors' first file.
+    for f in victims[:: 2]:
+        os.remove(f)
+    for f in victims:
+        if os.path.exists(f) and os.path.basename(f).startswith("_"):
+            os.remove(f)
+    survivors = [f for f in victims if os.path.exists(f)]
+    if survivors:
+        with open(survivors[0], "wb") as fh:
+            fh.write(b"\0")
+
+
+def dangle_sidecar(directory: str, name: str) -> None:
+    """Point ``<name>.json`` at a directory that does not exist (the
+    crash-window shape: sidecar committed, dir later lost)."""
+    meta_path = os.path.join(directory, f"{name}.json")
+    with open(meta_path) as f:
+        meta = json.load(f)
+    gone = meta.get("dir", name)
+    shutil.rmtree(os.path.join(directory, gone), ignore_errors=True)

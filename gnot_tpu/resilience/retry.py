@@ -1,0 +1,87 @@
+"""Exponential-backoff retries for transient I/O.
+
+Checkpoint storage on TPU fleets is remote (GCS/NFS) and flakes:
+transient 5xx/ESTALE-class errors on save or restore must not kill an
+hours-long run when a 1-second retry would succeed. The policy is the
+standard full-jitter exponential backoff (delay_i = uniform(0, min(cap,
+base * 2**i))) — jitter decorrelates the retry storms of many hosts
+hitting the same flaky filesystem together.
+
+Defaults (documented in docs/robustness.md): 3 retries, base 0.5 s,
+cap 8 s. Deterministic callers (tests) pass ``sleep=lambda s: None``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import random
+import time
+from typing import Callable, Iterable
+
+logger = logging.getLogger(__name__)
+
+#: Exceptions treated as transient by default: filesystem/network-class
+#: errors. ValueError/TypeError (corrupt content) are NOT transient —
+#: retrying a truncated checkpoint re-reads the same bad bytes; the
+#: fallback walk (checkpoint.py) handles those instead.
+TRANSIENT_ERRORS: tuple[type[BaseException], ...] = (OSError, IOError)
+
+#: OSError subclasses that are PERMANENT: retrying a missing path or a
+#: permission denial re-reads the same answer, so these surface
+#: immediately (a typo'd --train_data path must not sit through the
+#: full backoff schedule behind 'transient — retrying' warnings, and a
+#: truncated checkpoint dir must advance the fallback walk, not stall
+#: it).
+PERMANENT_ERRORS: tuple[type[BaseException], ...] = (
+    FileNotFoundError,
+    PermissionError,
+    NotADirectoryError,
+    IsADirectoryError,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    attempts: int = 4  # total tries: 1 initial + 3 retries
+    base_delay_s: float = 0.5
+    max_delay_s: float = 8.0
+
+    def delays(self) -> Iterable[float]:
+        for i in range(max(0, self.attempts - 1)):
+            yield random.uniform(
+                0.0, min(self.max_delay_s, self.base_delay_s * (2.0**i))
+            )
+
+
+def retry_io(
+    fn: Callable,
+    *,
+    policy: RetryPolicy | None = None,
+    transient: tuple[type[BaseException], ...] = TRANSIENT_ERRORS,
+    describe: str = "io",
+    on_retry: Callable[[int, BaseException], None] | None = None,
+    sleep: Callable[[float], None] = time.sleep,
+):
+    """Call ``fn()``; on a transient error, back off and retry up to
+    ``policy.attempts`` total tries. The final failure re-raises the
+    LAST error (the one a human debugs). ``on_retry(attempt, exc)``
+    fires before each sleep — the trainer routes it to the sink as an
+    ``io_retry`` event so flaky storage is visible, not silent."""
+    policy = policy or RetryPolicy()
+    delays = list(policy.delays())
+    for attempt in range(policy.attempts):
+        try:
+            return fn()
+        except transient as exc:
+            if isinstance(exc, PERMANENT_ERRORS):
+                raise
+            if attempt >= policy.attempts - 1:
+                raise
+            logger.warning(
+                "transient %s error (attempt %d/%d): %s — retrying",
+                describe, attempt + 1, policy.attempts, exc,
+            )
+            if on_retry is not None:
+                on_retry(attempt + 1, exc)
+            sleep(delays[attempt])

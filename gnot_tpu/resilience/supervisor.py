@@ -1,0 +1,206 @@
+"""The recovery supervisor: rolling on-device snapshots + the
+rollback → checkpoint-restore → abort ladder.
+
+Failure model: a single bad step (NaN gradients from an overflow, a
+corrupt batch, a flaky chip) poisons the params, and every later step
+is garbage — training past it burns chips. Before this subsystem the
+first detected NaN hard-aborted the run (trainer.py's watchdog). The
+supervisor instead keeps a last-known-good copy of the TrainState on
+device (refreshed every ``train.snapshot_every`` steps, after a
+finiteness check of every loss since the previous snapshot — so a
+snapshot is never taken from poisoned state), and on detection:
+
+1. **rollback** — restore the snapshot, quarantine the offending
+   dispatch (it is skipped on replay; the loader's seeded order makes
+   the replay deterministic), re-run the ≤ K lost steps. Bounded by
+   ``train.max_rollbacks`` per run.
+2. **checkpoint restore** — budget exhausted (or no clean snapshot):
+   restore ``latest``/``best`` via the hardened Checkpointer walk and
+   re-enter the epoch loop at the restored epoch. Used at most once.
+3. **abort** — the current behavior: localize the op via checkify when
+   a batch is in hand, write the ``non_finite_loss`` event, raise.
+
+Detection is the cheapest sufficient signal: one ``device_get`` of the
+K loss scalars per snapshot window (the same cadence discipline as the
+telemetry buffer — no per-step syncs). The telemetry NaN watchdog and
+``--debug_checks``, when enabled, feed the same ladder through
+``NonFiniteLossError``. Multi-host runs need no extra coordination:
+losses are replicated, so every host detects the same step and rolls
+back identically (SPMD all the way down).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class NonFiniteLossError(FloatingPointError):
+    """A detected non-finite training loss, carrying enough context to
+    recover: the step/epoch, the dispatch ordinal within the epoch (when
+    known), and the offending host batch (when retained). Subclasses
+    FloatingPointError so pre-recovery callers and tests that catch the
+    hard abort keep working unchanged."""
+
+    def __init__(
+        self, message: str, *, step: int, epoch: int,
+        ordinal: int | None = None, batch: Any = None,
+    ):
+        super().__init__(message)
+        self.step = step
+        self.epoch = epoch
+        self.ordinal = ordinal
+        self.batch = batch
+
+
+class PreemptionRequested(Exception):
+    """Raised at a step boundary when a stop was requested (SIGTERM/
+    SIGINT or injected); the trainer saves ``latest`` and exits
+    resume-ready."""
+
+    def __init__(self, epoch: int, step: int):
+        super().__init__(f"preemption requested at epoch {epoch}, step {step}")
+        self.epoch = epoch
+        self.step = step
+
+
+class RestoreEscalation(Exception):
+    """Internal: the ladder escalates past device rollback; the outer
+    epoch loop restores from checkpoint (or aborts)."""
+
+    def __init__(self, cause: NonFiniteLossError):
+        super().__init__(str(cause))
+        self.cause = cause
+
+
+@dataclasses.dataclass
+class _Snapshot:
+    state: Any  # device copy of the TrainState
+    ordinal: int  # dispatches completed in this epoch at snapshot time
+    host_step: int
+    n_losses: int
+    points: int
+
+
+def _copy_state(state):
+    """Device-side copy: the live state's buffers get donated away by
+    the next dispatch, so the snapshot must own distinct buffers (the
+    copy is async — no host sync here)."""
+    return jax.tree.map(jnp.copy, state)
+
+
+class RecoverySupervisor:
+    def __init__(self, *, snapshot_every: int = 50, max_rollbacks: int = 3):
+        # Bounds validated at config construction (TrainConfig
+        # __post_init__) — the one place every in-repo path goes through.
+        self.snapshot_every = max(1, snapshot_every)
+        self.max_rollbacks = max_rollbacks
+        self.rollbacks_used = 0
+        self.restore_used = False
+        self._snap: _Snapshot | None = None
+        self._dispatch_log: list[tuple[int, int, int]] = []  # (ordinal, start, end)
+        self._checked = 0  # losses verified finite so far this epoch
+        self._last_snap_step = 0
+
+    # -- epoch lifecycle ---------------------------------------------------
+
+    def begin_epoch(self, state, *, host_step: int) -> None:
+        """Snapshot the epoch-entry state (always a legal rollback
+        target) and reset the per-epoch dispatch log."""
+        self._snap = _Snapshot(
+            state=_copy_state(state), ordinal=0, host_step=host_step,
+            n_losses=0, points=0,
+        )
+        self._dispatch_log = []
+        self._checked = 0
+        self._last_snap_step = host_step
+
+    def after_dispatch(
+        self, state, *, ordinal: int, start_step: int, end_step: int,
+        losses: list, points: int, epoch: int,
+    ) -> None:
+        """Record the dispatch; at each ``snapshot_every`` boundary,
+        verify every loss since the last check is finite (one
+        device_get of ≤ K scalars) and refresh the snapshot. Raises
+        NonFiniteLossError on the first bad loss — BEFORE snapshotting,
+        so the held snapshot is always pre-poisoning."""
+        self._dispatch_log.append((ordinal, start_step, end_step))
+        if end_step - self._last_snap_step < self.snapshot_every:
+            return
+        self.check_losses(losses, epoch=epoch)
+        self._snap = _Snapshot(
+            state=_copy_state(state), ordinal=ordinal + 1,
+            host_step=end_step, n_losses=len(losses), points=points,
+        )
+        self._last_snap_step = end_step
+
+    def check_losses(self, losses: list, *, epoch: int) -> None:
+        """Finiteness-check the unchecked tail of the epoch's per-
+        dispatch losses (the trainer also calls this at epoch end so a
+        NaN in the final partial window cannot reach eval)."""
+        tail = losses[self._checked :]
+        if not tail:
+            return
+        fetched = jax.device_get(tail)
+        for i, loss in enumerate(fetched):
+            arr = np.atleast_1d(np.asarray(loss))
+            bad = ~np.isfinite(arr)
+            if bad.any():
+                ordinal, start, _ = self._dispatch_log[self._checked + i]
+                step = start + int(np.argmax(bad)) + 1
+                raise NonFiniteLossError(
+                    f"non-finite train loss at epoch {epoch}, step {step}",
+                    step=step, epoch=epoch, ordinal=ordinal,
+                )
+        self._checked = len(losses)
+
+    def ordinal_for_step(self, step: int) -> int | None:
+        """Map a step number (e.g. from the telemetry watchdog) to its
+        dispatch ordinal in the current epoch's log."""
+        for ordinal, start, end in self._dispatch_log:
+            if start < step <= end:
+                return ordinal
+        return None
+
+    # -- the ladder --------------------------------------------------------
+
+    def plan(self, err: NonFiniteLossError) -> str:
+        """Choose the next rung for this failure: ``"rollback"`` while
+        snapshot + budget allow, else ``"restore"`` once, else
+        ``"abort"``."""
+        if self._snap is not None and self.rollbacks_used < self.max_rollbacks:
+            return "rollback"
+        if not self.restore_used:
+            self.restore_used = True
+            return "restore"
+        return "abort"
+
+    def last_good_state(self):
+        """A copy of the last-known-good snapshot state (or None) — the
+        preemption save's fallback when the final telemetry drain
+        reveals a NaN buried in the un-drained window: checkpointing
+        the live (possibly poisoned) state would strand the resume."""
+        return None if self._snap is None else _copy_state(self._snap.state)
+
+    def rollback(self) -> _Snapshot:
+        """Consume one budget unit and hand back a COPY of the
+        snapshot (the returned state's buffers will be donated by the
+        replayed steps; the held snapshot must survive a second
+        rollback). Truncates the dispatch log/checked counter to the
+        snapshot point."""
+        assert self._snap is not None
+        self.rollbacks_used += 1
+        snap = self._snap
+        self._dispatch_log = [
+            d for d in self._dispatch_log if d[0] < snap.ordinal
+        ]
+        self._checked = min(self._checked, snap.n_losses)
+        self._last_snap_step = snap.host_step
+        return dataclasses.replace(snap, state=_copy_state(snap.state))

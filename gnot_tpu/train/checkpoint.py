@@ -20,21 +20,47 @@ wait/save). A crash anywhere in the window therefore leaves the old
 meta pointing at the old, still-intact checkpoint; superseded
 directories are pruned only once the new one is committed and named by
 the sidecar.
+
+I/O hardening (resilience/, docs/robustness.md): save kickoff, restore
+reads, and sidecar writes retry transient OSErrors with exponential
+backoff + jitter (``resilience.retry``); restore walks a FALLBACK
+chain — the sidecar-named directory, then any other committed ``name.*``
+directories newest-epoch-first, then the same for ``best`` — so a
+truncated orbax dir, a missing sidecar, or a sidecar pointing at a
+deleted dir degrades to an older checkpoint instead of crashing the
+run. Which checkpoint actually restored is logged, recorded in
+``last_restore`` (surfaced into the run manifest by main.py), and
+emitted through ``on_event`` as a ``restore``/``restore_fallback``
+sink record.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
+import re
 import shutil
-from typing import Any
+from typing import Any, Callable
 
 import jax
 import orbax.checkpoint as ocp
 
+from gnot_tpu.resilience.retry import RetryPolicy, retry_io
+
+logger = logging.getLogger(__name__)
+
 
 class Checkpointer:
-    def __init__(self, directory: str, extra_meta: dict | None = None):
+    def __init__(
+        self,
+        directory: str,
+        extra_meta: dict | None = None,
+        *,
+        fault_injector=None,
+        retry_policy: RetryPolicy | None = None,
+        on_event: Callable[..., None] | None = None,
+    ):
         """``extra_meta`` is provenance recorded in every sidecar —
         notably the RESOLVED model numerics (gelu flavor, attention
         mode, dtype). The masked-mode default gelu changed erf->tanh in
@@ -45,6 +71,15 @@ class Checkpointer:
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.extra_meta = dict(extra_meta or {})
+        # Resilience wiring: the injector's ckpt_io budget fires at each
+        # I/O attempt (inside the retry loop, so injected transients are
+        # retried like real ones); on_event routes retry/fallback events
+        # to the metrics sink (trainer-owned); last_restore records which
+        # checkpoint a restore ACTUALLY used, for the run manifest.
+        self.fault_injector = fault_injector
+        self.retry_policy = retry_policy or RetryPolicy()
+        self.on_event = on_event
+        self.last_restore: dict | None = None
         self._ckptr = ocp.StandardCheckpointer()
         # Saves kicked off but whose meta is not yet committed:
         # (name, meta dict, committed dir basename).
@@ -55,6 +90,29 @@ class Checkpointer:
         # after a flush) can return a stale dir and desynchronize the
         # collective orbax save targets.
         self._published: dict[str, str] = {}
+
+    # -- hardened I/O ------------------------------------------------------
+
+    def _io(self, op: str, fn):
+        """Run one checkpoint-I/O operation under the retry policy.
+        The fault injector (when armed) fires INSIDE the retried
+        attempt, so injected transient errors exercise the same
+        backoff path real ones do."""
+
+        def attempt():
+            if self.fault_injector is not None:
+                self.fault_injector.maybe_io_error(op)
+            return fn()
+
+        def note(attempt_n: int, exc: BaseException) -> None:
+            if self.on_event is not None:
+                self.on_event(
+                    event="io_retry", op=op, attempt=attempt_n, error=str(exc)
+                )
+
+        return retry_io(
+            attempt, policy=self.retry_policy, describe=op, on_retry=note
+        )
 
     # -- commit protocol ---------------------------------------------------
 
@@ -69,10 +127,22 @@ class Checkpointer:
             if jax.process_index() != 0:
                 continue
             meta_path = os.path.join(self.directory, f"{name}.json")
-            tmp = f"{meta_path}.tmp"
-            with open(tmp, "w") as f:
-                json.dump(meta, f)
-            os.replace(tmp, meta_path)
+
+            def write_sidecar():
+                tmp = f"{meta_path}.tmp"
+                with open(tmp, "w") as f:
+                    json.dump(meta, f)
+                os.replace(tmp, meta_path)
+
+            self._io(f"sidecar:{name}", write_sidecar)
+            if self.fault_injector is not None:
+                # corrupt_ckpt@EPOCH fires once the checkpoint is fully
+                # committed and published — the torn-write shape the
+                # restore fallback walk must survive.
+                self.fault_injector.post_save(
+                    name, os.path.join(self.directory, dirname),
+                    int(meta.get("epoch", -1)),
+                )
             for d in os.listdir(self.directory):
                 full = os.path.join(self.directory, d)
                 # d == name: a pre-upgrade unsuffixed checkpoint dir.
@@ -90,6 +160,16 @@ class Checkpointer:
         the background — training overlaps the checkpoint write."""
         self._ckptr.wait_until_finished()
         self._flush_pending()
+        # Copy the state before the async kickoff: the caller's buffers
+        # get DONATED by the next train step while the background write
+        # is still reading them (on CPU the writer sees zero-copy views
+        # of the XLA buffers), which silently corrupts the checkpoint —
+        # or the heap. The copy is device-side and async (no host
+        # sync); its buffers are never donated, so the writer owns
+        # stable data for as long as it needs.
+        import jax.numpy as jnp
+
+        state = jax.tree.map(jnp.copy, state)
         dirname = f"{name}.{epoch}"
         # Resume-replay can revisit an epoch whose directory the
         # published sidecar already names; force=True would delete that
@@ -109,7 +189,15 @@ class Checkpointer:
         while dirname == published:
             tick += 1
             dirname = f"{name}.{epoch}r{tick}"
-        self._ckptr.save(os.path.join(self.directory, dirname), state, force=True)
+        # Retry the KICKOFF (directory creation, async-save scheduling)
+        # against transient filesystem errors; the async commit itself
+        # is orbax's, surfacing at the next wait().
+        self._io(
+            f"save:{name}",
+            lambda: self._ckptr.save(
+                os.path.join(self.directory, dirname), state, force=True
+            ),
+        )
         meta = {"epoch": epoch, "best_metric": best_metric, "dir": dirname}
         meta.update(self.extra_meta)
         self._pending.append((name, meta, dirname))
@@ -128,17 +216,190 @@ class Checkpointer:
 
     # -- restore -----------------------------------------------------------
 
-    def _restore(self, name: str, target: Any):
-        self.wait()
+    #: Committed checkpoint directories: ``<name>.<epoch>`` plus the
+    #: resume-replay uniquifier (``latest.3``, ``latest.3r1``, ...).
+    _DIR_RE = re.compile(r"^(?P<name>[a-z]+)\.(?P<epoch>\d+)(?:r(?P<tick>\d+))?$")
+
+    def _read_sidecar(self, name: str) -> dict | None:
         meta_path = os.path.join(self.directory, f"{name}.json")
-        if not os.path.exists(meta_path):
+        try:
+            with open(meta_path) as f:
+                return json.load(f)
+        except FileNotFoundError:
             return None
-        with open(meta_path) as f:
-            meta = json.load(f)
-        # Older checkpoints used an unsuffixed directory and no "dir" key.
-        path = os.path.join(self.directory, meta.get("dir", name))
-        if not os.path.isdir(path):
+        except (OSError, json.JSONDecodeError) as exc:
+            # A torn/unreadable sidecar is itself a corruption shape:
+            # fall through to the on-disk directory scan.
+            logger.warning("unreadable sidecar %s (%s); scanning dirs", meta_path, exc)
             return None
+
+    def _candidates(self, name: str) -> list[tuple[str, dict, str]]:
+        """Restore candidates for ``name``, in trust order: the
+        sidecar-named directory (authoritative — a newer UNPUBLISHED dir
+        on disk may be a torn commit), then every other committed
+        ``name.*`` directory newest-epoch-first (their sidecar was lost:
+        epoch comes from the dirname, best_metric degrades to +inf so
+        the next eval re-establishes it). Returns (path, meta, via)."""
+        cands: list[tuple[str, dict, str]] = []
+        meta = self._read_sidecar(name)
+        sidecar_dir = None
+        if meta is not None:
+            # Older checkpoints used an unsuffixed dir and no "dir" key.
+            sidecar_dir = meta.get("dir", name)
+            cands.append(
+                (os.path.join(self.directory, sidecar_dir), meta, "sidecar")
+            )
+        try:
+            entries = os.listdir(self.directory)
+        except OSError:
+            entries = []
+        scanned = []
+        for d in entries:
+            m = self._DIR_RE.match(d)
+            if (
+                m is None
+                or m.group("name") != name
+                or d == sidecar_dir
+                or not os.path.isdir(os.path.join(self.directory, d))
+            ):
+                continue
+            scanned.append((int(m.group("epoch")), int(m.group("tick") or 0), d))
+        for epoch, _, d in sorted(scanned, reverse=True):
+            cands.append(
+                (
+                    os.path.join(self.directory, d),
+                    {"epoch": epoch, "best_metric": float("inf")},
+                    "scan",
+                )
+            )
+        return cands
+
+    def _restore(self, name: str, target: Any, *, requested: str | None = None):
+        """Walk the candidate chain; the first directory orbax can
+        restore (under the transient-error retry policy) wins. Records
+        WHICH checkpoint restored in ``last_restore`` / the log / the
+        event stream — the silent-fallback hazard this hardening
+        exists to remove. ``requested`` names the checkpoint the CALLER
+        asked for when this walk is already a fallback (restore_latest
+        walking on to 'best'), so exactly ONE restore/restore_fallback
+        event describes the whole restore. Returns (state, epoch,
+        best_metric) or None when no candidate is restorable."""
+        requested = requested or name
+        self.wait()
+        multiproc = jax.process_count() > 1
+        tried: list[str] = []
+        for path, meta, via in self._candidates(name):
+            dirname = os.path.basename(path)
+            if not os.path.isdir(path):
+                # Sidecar pointing at a deleted dir (the crash-window
+                # shape inverted): fall through to the scan candidates.
+                tried.append(f"{dirname} (missing directory)")
+                continue
+            if multiproc:
+                # The sharded restore is a cross-process COLLECTIVE:
+                # hosts attempting different candidates (per-host
+                # transient I/O desynchronizing the walk) would hang
+                # the pod in the collective, not error. Agree on the
+                # candidate first; divergence fails loudly instead.
+                from gnot_tpu.parallel import multihost
+
+                if not multihost.all_agree(f"{name}:{dirname}"):
+                    raise RuntimeError(
+                        f"checkpoint restore walk diverged across hosts "
+                        f"(this host chose {dirname!r} for {name!r}); "
+                        "per-host I/O failures left hosts seeing "
+                        "different candidates — refusing the collective "
+                        "restore that would hang the pod"
+                    )
+            layout_conflict = via == "sidecar" and self._warn_numerics(name, meta)
+            state, failure = None, None
+            try:
+                state = self._io(
+                    f"restore:{name}",
+                    lambda p=path: self._ckptr.restore(p, target),
+                )
+            except Exception as exc:  # noqa: BLE001 — any restore failure
+                if layout_conflict:
+                    # A flat/tree layout mismatch is the RUN's config,
+                    # not storage corruption: every candidate shares the
+                    # layout, so walking on would only bury the actionable
+                    # error the warning above just named.
+                    raise
+                failure = exc
+            if multiproc:
+                # Outcome agreement (collective): if ANY host failed
+                # this candidate, every host discards it and walks on
+                # together — a lone success would leave that host
+                # returning while the rest re-enter collectives.
+                from gnot_tpu.parallel import multihost
+
+                if multihost.sync_flag(failure is not None):
+                    failure = failure or RuntimeError(
+                        "another host failed to restore this candidate"
+                    )
+            if failure is not None:
+                tried.append(f"{dirname} ({type(failure).__name__}: {failure})")
+                logger.warning(
+                    "restore of %s checkpoint %s failed (%s); trying next candidate",
+                    name, dirname, failure,
+                )
+                continue
+            # Copy before returning: restored arrays can be backed by
+            # checkpoint-file buffers (zero-copy reads), and the trainer
+            # DONATES its state to the compiled step — donating a
+            # file-backed buffer corrupts the heap. The copy is device-
+            # side and async; the copies are plain XLA buffers, safe to
+            # donate.
+            import jax.numpy as jnp
+
+            state = jax.tree.map(jnp.copy, state)
+            fallback = via != "sidecar" or bool(tried) or requested != name
+            self.last_restore = {
+                "requested": requested,
+                "name": name,
+                "dir": dirname,
+                "epoch": int(meta["epoch"]),
+                "best_metric": float(meta["best_metric"]),
+                "fallback": fallback,
+                "skipped": tried,
+            }
+            if jax.process_index() == 0:
+                print(
+                    f"Restored '{name}' checkpoint from {dirname} "
+                    f"(epoch {int(meta['epoch'])})"
+                    + (f" after skipping: {'; '.join(tried)}" if tried else "")
+                )
+            if self.on_event is not None:
+                self.on_event(
+                    event="restore_fallback" if fallback else "restore",
+                    **self.last_restore,
+                )
+            return state, int(meta["epoch"]), float(meta["best_metric"])
+        if multiproc:
+            # Exhaustion agreement: a host that ran out of candidates
+            # while another still walks would leave that one hanging in
+            # the candidate-agreement collective above.
+            from gnot_tpu.parallel import multihost
+
+            if not multihost.all_agree(f"{name}:<exhausted>"):
+                raise RuntimeError(
+                    f"checkpoint restore walk diverged across hosts: this "
+                    f"host exhausted every '{name}' candidate while others "
+                    "still see one — refusing the collective restore that "
+                    "would hang the pod"
+                )
+        if tried and jax.process_index() == 0:
+            print(
+                f"warning: no restorable '{name}' checkpoint "
+                f"(tried: {'; '.join(tried)})"
+            )
+        return None
+
+    def _warn_numerics(self, name: str, meta: dict) -> bool:
+        """Provenance checks against a sidecar's recorded numerics;
+        returns True when a state-LAYOUT conflict (flat vs tree) was
+        detected — the one mismatch that makes the orbax restore itself
+        fail, which the caller must not paper over with fallbacks."""
         mismatch = {
             k: (meta[k], v)
             for k, v in self.extra_meta.items()
@@ -190,13 +451,27 @@ class Checkpointer:
                     "default, pass --gelu erf to restore its "
                     "training-time activation"
                 )
-        state = self._ckptr.restore(path, target)
-        return state, int(meta["epoch"]), float(meta["best_metric"])
+        return layout_mismatch is not None
 
     def restore_latest(self, target: Any):
         """Returns (state, epoch, best_metric) or None. Prefers the
-        periodic ``latest`` checkpoint, falls back to ``best``."""
-        return self._restore("latest", target) or self._restore("best", target)
+        periodic ``latest`` checkpoint (walking its fallback chain),
+        then falls back to ``best`` — LOUDLY: which checkpoint actually
+        restored is printed, recorded in ``last_restore`` (the manifest
+        field), and emitted as a ``restore_fallback`` event, because a
+        run silently restarting from ``best`` instead of ``latest``
+        replays epochs the operator thinks are done."""
+        out = self._restore("latest", target)
+        if out is not None:
+            return out
+        out = self._restore("best", target, requested="latest")
+        if out is not None and jax.process_index() == 0:
+            print(
+                "note: no restorable 'latest' checkpoint — resumed "
+                f"from 'best' ({self.last_restore['dir']}, epoch "
+                f"{self.last_restore['epoch']})"
+            )
+        return out
 
     def restore_best(self, target: Any):
         return self._restore("best", target)

@@ -642,6 +642,22 @@ class Trainer:
         self._recompiles = None
         self.metrics_sink = metrics_sink
         self.checkpointer = checkpointer
+        # Resilience pieces (resilience/): the fault injector parses
+        # train.inject_fault (plus the stop_after_epoch alias) at
+        # construction so a bad spec fails HERE, not mid-run; the
+        # recovery supervisor is built in fit() when train.recovery.
+        from gnot_tpu.resilience.faults import FaultInjector
+
+        self._faults = FaultInjector.from_config(config.train)
+        self._supervisor = None
+        if self.checkpointer is not None:
+            # One injector instance end to end: the ckpt_io error budget
+            # is shared between trainer- and checkpointer-side hooks, and
+            # recovery/restore/retry events flow into the same sink.
+            if self._faults is not None and self.checkpointer.fault_injector is None:
+                self.checkpointer.fault_injector = self._faults
+            if self.checkpointer.on_event is None and metrics_sink is not None:
+                self.checkpointer.on_event = metrics_sink.log
         self.multi_train_step = None
         self.multi_eval_step = None
         self._tail_eval_step = None
@@ -1054,13 +1070,28 @@ class Trainer:
 
     def _handle_nonfinite_loss(self, step, epoch, loss, batch) -> None:
         """NaN watchdog (fires from TelemetryBuffer.drain on the first
-        non-finite loss): localize via a checkify re-run of the
+        non-finite loss). With the recovery supervisor active
+        (``train.recovery``) this raises the typed NonFiniteLossError
+        the fit harness catches to roll back; otherwise it is the
+        original hard abort: localize via a checkify re-run of the
         offending batch, record the event, and stop the run — training
-        past a NaN only burns chips. Multi-process runs skip the
+        past a NaN only burns chips."""
+        if self._supervisor is not None:
+            from gnot_tpu.resilience.supervisor import NonFiniteLossError
+
+            raise NonFiniteLossError(
+                f"non-finite train loss at epoch {epoch}, step {step}",
+                step=step, epoch=epoch, batch=batch,
+            )
+        self._abort_nonfinite(step, epoch, loss, batch)
+
+    def _abort_nonfinite(self, step, epoch, loss, batch) -> None:
+        """The hard abort (the recovery ladder's last rung, and the
+        only rung when recovery is off). Multi-process runs skip the
         localization re-run (only process 0 would enter it: a one-host
         collective would hang the job before the error surfaces)."""
         detail = None
-        if jax.process_count() == 1:
+        if batch is not None and jax.process_count() == 1:
             from gnot_tpu.obs import health
 
             detail = health.localize_nan(
@@ -1079,7 +1110,7 @@ class Trainer:
                 if detail
                 else " (checkify re-run did not reproduce — the bad "
                      "value predates this step's forward)"
-                if jax.process_count() == 1
+                if batch is not None and jax.process_count() == 1
                 else ""
             )
         )
@@ -1110,209 +1141,447 @@ class Trainer:
                 # drain window of padded batches per host for nothing.
                 keep_batches=jax.process_count() == 1,
             )
+        import contextlib
+
+        from gnot_tpu.resilience.preemption import PreemptionHandler
+        from gnot_tpu.resilience.supervisor import (
+            PreemptionRequested,
+            RecoverySupervisor,
+            RestoreEscalation,
+        )
+
+        self._supervisor = (
+            RecoverySupervisor(
+                snapshot_every=cfg.train.snapshot_every,
+                max_rollbacks=cfg.train.max_rollbacks,
+            )
+            if cfg.train.recovery
+            else None
+        )
+        preempt_cm = (
+            PreemptionHandler(sync_every=cfg.train.preempt_sync_every)
+            if cfg.train.graceful_preempt
+            else contextlib.nullcontext()
+        )
         # Trace the second executed epoch (warm jit caches), or the only
         # one if the run has a single epoch.
         trace_at = min(self.start_epoch + 1, cfg.train.epochs - 1)
-        for epoch in range(self.start_epoch, cfg.train.epochs):
-            # Shuffle order is a function of (seed, epoch): resumed runs
-            # replay the continuous run's batch order exactly.
-            self.train_loader.set_epoch(epoch)
-            t0 = time.perf_counter()
-            losses, points = [], 0
-            k_dis = cfg.train.steps_per_dispatch
-
-            def run_single(batch):
-                lr = self.lr_fn(self.host_step, epoch)
-                # The telemetry step returns (state, (loss, telem));
-                # the plain step (state, loss) — one call site, the
-                # unpack is the only difference.
-                self.state, out = self.train_step(
-                    self.state,
-                    self._device_batch(batch),
-                    jnp.asarray(lr, jnp.float32),
-                )
-                loss, telem = out if self._telemetry is not None else (out, None)
-                self.host_step += 1
-                losses.append(loss)
-                if self._telemetry is not None:
-                    # Device arrays only — the buffer syncs at drains.
-                    self._telemetry.append(
-                        steps=[self.host_step], epoch=epoch, lrs=[lr],
-                        loss=loss, telem=telem, batches=[batch],
-                    )
-                if cfg.train.debug_checks and not np.isfinite(
-                    float(np.asarray(loss))
+        with preempt_cm as preempt:
+            epoch = self.start_epoch
+            while epoch < cfg.train.epochs:
+                try:
+                    self._fit_epoch(epoch, trace_at, preempt)
+                except PreemptionRequested as stop:
+                    self._preempt_save(stop)
+                    break
+                except RestoreEscalation as esc:
+                    epoch = self._escalate_restore(esc)
+                    continue
+                if self._faults is not None and self._faults.stop_after_epoch(
+                    epoch
                 ):
-                    # Deterministic guard (jax_debug_nans does not
-                    # reliably fire on warm jit paths); the
-                    # sync-per-step cost is the debug-build trade.
-                    raise FloatingPointError(
-                        f"non-finite train loss at epoch {epoch}, "
-                        f"step {self.host_step}"
-                    )
-                if (
-                    self._telemetry is None
-                    and self.metrics_sink is not None
-                    and cfg.train.log_every
-                    and self.host_step % cfg.train.log_every == 0
-                ):
-                    # float(loss) syncs; per-step logging is opt-in
-                    # and meant for coarse cadences. (With telemetry on
-                    # the buffer writes richer step records instead,
-                    # without the per-step sync.)
-                    self.metrics_sink.log(
-                        step=self.host_step,
-                        epoch=epoch,
-                        loss=float(np.asarray(loss)),
-                        lr=lr,
-                    )
-
-            def run_group(group):
-                # One dispatch for len(group) steps: stacked batches +
-                # per-step LRs scanned on device (make_multi_train_step).
-                lrs = [
-                    self.lr_fn(self.host_step + i, epoch)
-                    for i in range(len(group))
-                ]
-                self.state, out = self.multi_train_step(
-                    self.state,
-                    self._device_batch(stack_batches(group), stacked=True),
-                    jnp.asarray(lrs, dtype=jnp.float32),
-                )
-                loss_k, telem_k = (
-                    out if self._telemetry is not None else (out, None)
-                )
-                start = self.host_step
-                self.host_step += len(group)
-                losses.append(loss_k)
-                if self._telemetry is not None:
-                    # One stacked entry for the K scanned steps; the
-                    # drain unstacks after the (single) fetch.
-                    self._telemetry.append(
-                        steps=list(range(start + 1, start + len(group) + 1)),
-                        epoch=epoch, lrs=lrs, loss=loss_k, telem=telem_k,
-                        batches=group,
-                    )
-                if cfg.train.debug_checks and not np.all(
-                    np.isfinite(np.asarray(loss_k))
-                ):
-                    raise FloatingPointError(
-                        f"non-finite train loss at epoch {epoch}, "
-                        f"steps {start + 1}..{self.host_step}"
-                    )
-                if (
-                    self._telemetry is None
-                    and self.metrics_sink is not None
-                    and cfg.train.log_every
-                ):
-                    host_lk = None
-                    for i in range(len(group)):
-                        s = start + i + 1
-                        if s % cfg.train.log_every == 0:
-                            if host_lk is None:
-                                host_lk = np.asarray(loss_k)  # one sync
-                            self.metrics_sink.log(
-                                step=s,
-                                epoch=epoch,
-                                loss=float(host_lk[i]),
-                                lr=lrs[i],
-                            )
-
-            with profiling.trace_epoch(
-                cfg.train.profile_dir, epoch, trace_at=trace_at
-            ):
-                with profiling.annotate("train_epoch"):
-                    # The SAME grouping iterator evaluate() uses
-                    # (all-singles at k=1).
-                    for kind, item in group_batches(self.train_loader, k_dis):
-                        if kind == "group":
-                            points += sum(b.n_real_points for b in item)
-                            run_group(item)
-                        else:
-                            points += item.n_real_points
-                            run_single(item)
-                if self._telemetry is not None:
-                    # Flush the partial window BEFORE eval: the NaN
-                    # watchdog must fire before eval wastes a pass on a
-                    # dead run, and the epoch boundary is a sync point
-                    # anyway (train_loss fetch below).
-                    self._telemetry.drain()
-                train_loss = float(
-                    np.mean(
-                        np.concatenate(
-                            [np.atleast_1d(np.asarray(l)) for l in losses]
-                        )
-                    )
-                ) if losses else float("nan")
-                dt = time.perf_counter() - t0
-                # Reference's exact console line (main.py:105).
-                print(f"Epoch {epoch}, Loss: {train_loss}")
-
-                with profiling.annotate("eval_epoch"):
-                    res = self.evaluate()
-            print(f"Epoch {epoch}, Test Metric: {res}")
-            print("-----------------------------------")
-
-            if self._recompiles is not None:
-                # First check baselines the warm-up compiles; later
-                # positive deltas are recompiles (shape leaks).
-                deltas = self._recompiles.check()
-                if deltas:
-                    import logging
-
-                    logging.getLogger(__name__).warning(
-                        "recompilation detected during epoch %d: %s "
-                        "(shape leak? check bucketing and static args)",
-                        epoch, deltas,
-                    )
-                    if self.metrics_sink is not None:
-                        self.metrics_sink.log(
-                            event="recompile", epoch=epoch,
-                            **{f"compiles/{k}": v for k, v in deltas.items()},
-                        )
-            if self._telemetry is not None and jax.process_count() > 1:
-                # Straggler gauge — COLLECTIVE, so every process calls
-                # it; only process 0 (the sink owner) writes.
-                from gnot_tpu.parallel import multihost
-
-                per_host = multihost.per_host_gauge(
-                    dt / max(1, len(self.train_loader))
-                )
-                if self.metrics_sink is not None:
-                    self.metrics_sink.log(
-                        event="host_skew", epoch=epoch,
-                        step_time_per_host=per_host,
-                        skew_s=float(per_host.max() - per_host.min()),
-                    )
-
-            if self.metrics_sink is not None:
-                self.metrics_sink.log(
-                    epoch=epoch,
-                    train_loss=train_loss,
-                    test_metric=res,  # sink serializes non-finite as null
-                    lr=self.lr_fn(self.host_step, epoch),
-                    points_per_sec=points / dt,
-                    epoch_seconds=dt,
-                )
-            if res < self.best_metric:
-                self.best_metric = res
-                if self.checkpointer is not None:
-                    self.checkpointer.save_best(self.state, epoch, self.best_metric)
-            if self.checkpointer is not None and (
-                cfg.train.checkpoint_every
-                and (epoch + 1) % cfg.train.checkpoint_every == 0
-            ):
-                self.checkpointer.save_latest(self.state, epoch + 1, self.best_metric)
-            if (
-                cfg.train.stop_after_epoch
-                and epoch + 1 >= cfg.train.stop_after_epoch
-            ):
-                # Simulated preemption (fault injection): exit the loop
-                # cleanly; the final wait() below commits in-flight saves.
-                print(f"Stopping after epoch {epoch} (--stop_after_epoch)")
-                break
+                    # Simulated preemption (fault injection): exit the
+                    # loop cleanly; the final wait() below commits
+                    # in-flight saves.
+                    print(f"Stopping after epoch {epoch} (--stop_after_epoch)")
+                    break
+                epoch += 1
 
         if self.checkpointer is not None:
             self.checkpointer.wait()  # flush in-flight async saves
         print(f"\nBest Test Metric: {self.best_metric}")
         return self.best_metric
+
+    def _fit_epoch(self, epoch: int, trace_at: int, preempt) -> None:
+        """One epoch — dispatch loop (under the recovery harness),
+        eval, health checks, epoch record, checkpoint saves."""
+        cfg = self.config
+        # Shuffle order is a function of (seed, epoch): resumed runs
+        # replay the continuous run's batch order exactly.
+        self.train_loader.set_epoch(epoch)
+        t0 = time.perf_counter()
+        losses, points = [], 0
+        k_dis = cfg.train.steps_per_dispatch
+
+        def run_single(batch):
+            if self._faults is not None:
+                self._faults.maybe_sigterm(self.host_step + 1)
+                batch = self._faults.poison_batch(batch, self.host_step + 1)
+            lr = self.lr_fn(self.host_step, epoch)
+            # The telemetry step returns (state, (loss, telem));
+            # the plain step (state, loss) — one call site, the
+            # unpack is the only difference.
+            self.state, out = self.train_step(
+                self.state,
+                self._device_batch(batch),
+                jnp.asarray(lr, jnp.float32),
+            )
+            loss, telem = out if self._telemetry is not None else (out, None)
+            self.host_step += 1
+            losses.append(loss)
+            if self._telemetry is not None:
+                # Device arrays only — the buffer syncs at drains.
+                self._telemetry.append(
+                    steps=[self.host_step], epoch=epoch, lrs=[lr],
+                    loss=loss, telem=telem, batches=[batch],
+                )
+            if cfg.train.debug_checks and not np.isfinite(
+                float(np.asarray(loss))
+            ):
+                from gnot_tpu.resilience.supervisor import NonFiniteLossError
+
+                # Deterministic guard (jax_debug_nans does not
+                # reliably fire on warm jit paths); the
+                # sync-per-step cost is the debug-build trade.
+                # NonFiniteLossError IS a FloatingPointError, so
+                # non-recovery callers see the original behavior;
+                # with recovery on, the harness catches it.
+                raise NonFiniteLossError(
+                    f"non-finite train loss at epoch {epoch}, "
+                    f"step {self.host_step}",
+                    step=self.host_step, epoch=epoch, batch=batch,
+                )
+            if (
+                self._telemetry is None
+                and self.metrics_sink is not None
+                and cfg.train.log_every
+                and self.host_step % cfg.train.log_every == 0
+            ):
+                # float(loss) syncs; per-step logging is opt-in
+                # and meant for coarse cadences. (With telemetry on
+                # the buffer writes richer step records instead,
+                # without the per-step sync.)
+                self.metrics_sink.log(
+                    step=self.host_step,
+                    epoch=epoch,
+                    loss=float(np.asarray(loss)),
+                    lr=lr,
+                )
+
+        def run_group(group):
+            if self._faults is not None:
+                for i in range(len(group)):
+                    self._faults.maybe_sigterm(self.host_step + 1 + i)
+                group = [
+                    self._faults.poison_batch(b, self.host_step + 1 + i)
+                    for i, b in enumerate(group)
+                ]
+            # One dispatch for len(group) steps: stacked batches +
+            # per-step LRs scanned on device (make_multi_train_step).
+            lrs = [
+                self.lr_fn(self.host_step + i, epoch)
+                for i in range(len(group))
+            ]
+            self.state, out = self.multi_train_step(
+                self.state,
+                self._device_batch(stack_batches(group), stacked=True),
+                jnp.asarray(lrs, dtype=jnp.float32),
+            )
+            loss_k, telem_k = (
+                out if self._telemetry is not None else (out, None)
+            )
+            start = self.host_step
+            self.host_step += len(group)
+            losses.append(loss_k)
+            if self._telemetry is not None:
+                # One stacked entry for the K scanned steps; the
+                # drain unstacks after the (single) fetch.
+                self._telemetry.append(
+                    steps=list(range(start + 1, start + len(group) + 1)),
+                    epoch=epoch, lrs=lrs, loss=loss_k, telem=telem_k,
+                    batches=group,
+                )
+            if cfg.train.debug_checks and not np.all(
+                np.isfinite(np.asarray(loss_k))
+            ):
+                from gnot_tpu.resilience.supervisor import NonFiniteLossError
+
+                bad = int(
+                    np.argmax(~np.isfinite(np.atleast_1d(np.asarray(loss_k))))
+                )
+                raise NonFiniteLossError(
+                    f"non-finite train loss at epoch {epoch}, "
+                    f"steps {start + 1}..{self.host_step}",
+                    step=start + bad + 1, epoch=epoch, batch=group[bad],
+                )
+            if (
+                self._telemetry is None
+                and self.metrics_sink is not None
+                and cfg.train.log_every
+            ):
+                host_lk = None
+                for i in range(len(group)):
+                    s = start + i + 1
+                    if s % cfg.train.log_every == 0:
+                        if host_lk is None:
+                            host_lk = np.asarray(loss_k)  # one sync
+                        self.metrics_sink.log(
+                            step=s,
+                            epoch=epoch,
+                            loss=float(host_lk[i]),
+                            lr=lrs[i],
+                        )
+
+        from gnot_tpu.resilience.supervisor import (
+            NonFiniteLossError,
+            PreemptionRequested,
+        )
+
+        sup = self._supervisor
+        multiproc = jax.process_count() > 1
+        quarantine: set[int] = set()
+        resume_at = 0
+
+        with profiling.trace_epoch(
+            cfg.train.profile_dir, epoch, trace_at=trace_at
+        ):
+            with profiling.annotate("train_epoch"):
+                if sup is not None:
+                    sup.begin_epoch(self.state, host_step=self.host_step)
+                while True:  # recovery attempts; single pass normally
+                    # Re-pin the shuffle epoch EVERY attempt: __iter__
+                    # advances the loader's epoch counter, so without
+                    # this a rollback replay would shuffle with
+                    # (seed, epoch+1) and the ordinal-based resume/
+                    # quarantine skips would hit the wrong batches.
+                    self.train_loader.set_epoch(epoch)
+                    ordinal = -1
+                    try:
+                        # The SAME grouping iterator evaluate() uses
+                        # (all-singles at k=1). Re-iterating after a
+                        # rollback replays the epoch's deterministic
+                        # (seed, epoch) order; already-done and
+                        # quarantined dispatches are skipped.
+                        for ordinal, (kind, item) in enumerate(
+                            group_batches(self.train_loader, k_dis)
+                        ):
+                            if ordinal < resume_at or ordinal in quarantine:
+                                continue
+                            start_step = self.host_step
+                            if kind == "group":
+                                points += sum(b.n_real_points for b in item)
+                                run_group(item)
+                            else:
+                                points += item.n_real_points
+                                run_single(item)
+                            if sup is not None:
+                                sup.after_dispatch(
+                                    self.state, ordinal=ordinal,
+                                    start_step=start_step,
+                                    end_step=self.host_step,
+                                    losses=losses, points=points,
+                                    epoch=epoch,
+                                )
+                            if preempt is not None and preempt.should_stop(
+                                multiprocess=multiproc
+                            ):
+                                raise PreemptionRequested(
+                                    epoch, self.host_step
+                                )
+                        if self._telemetry is not None:
+                            # Flush the partial window BEFORE eval:
+                            # the NaN watchdog must fire before eval
+                            # wastes a pass on a dead run, and the
+                            # epoch boundary is a sync point anyway
+                            # (train_loss fetch below).
+                            self._telemetry.drain()
+                        if sup is not None:
+                            # Epoch-end check: a NaN in the final
+                            # partial snapshot window must not
+                            # reach eval/checkpointing.
+                            sup.check_losses(losses, epoch=epoch)
+                        break
+                    except NonFiniteLossError as err:
+                        if sup is None:
+                            raise
+                        bad = (
+                            err.ordinal
+                            if err.ordinal is not None
+                            else sup.ordinal_for_step(err.step)
+                        )
+                        if bad is None:
+                            bad = ordinal  # the dispatch in flight
+                        action = sup.plan(err)
+                        if action == "restore":
+                            from gnot_tpu.resilience.supervisor import (
+                                RestoreEscalation,
+                            )
+
+                            raise RestoreEscalation(err)
+                        if action == "abort":
+                            self._abort_nonfinite(
+                                err.step, err.epoch, None, err.batch
+                            )
+                        if self._telemetry is not None:
+                            # Buffered records from rolled-back
+                            # steps are bogus, and the NaN inside
+                            # them must not re-fire the watchdog.
+                            self._telemetry.discard()
+                        snap = sup.rollback()
+                        self.state = snap.state
+                        self.host_step = snap.host_step
+                        del losses[snap.n_losses :]
+                        points = snap.points
+                        quarantine.add(bad)
+                        resume_at = snap.ordinal
+                        print(
+                            f"Recovery: non-finite loss at step "
+                            f"{err.step} — rolled back to step "
+                            f"{snap.host_step}, quarantined dispatch "
+                            f"{bad} ({sup.rollbacks_used}/"
+                            f"{sup.max_rollbacks} rollbacks used)"
+                        )
+                        if self.metrics_sink is not None:
+                            self.metrics_sink.log(
+                                event="rollback", epoch=epoch,
+                                step=err.step, to_step=snap.host_step,
+                                rollbacks_used=sup.rollbacks_used,
+                            )
+                            self.metrics_sink.log(
+                                event="batch_quarantined", epoch=epoch,
+                                step=err.step, ordinal=bad,
+                            )
+            train_loss = float(
+                np.mean(
+                    np.concatenate(
+                        [np.atleast_1d(np.asarray(l)) for l in losses]
+                    )
+                )
+            ) if losses else float("nan")
+            dt = time.perf_counter() - t0
+            # Reference's exact console line (main.py:105).
+            print(f"Epoch {epoch}, Loss: {train_loss}")
+
+            with profiling.annotate("eval_epoch"):
+                res = self.evaluate()
+        print(f"Epoch {epoch}, Test Metric: {res}")
+        print("-----------------------------------")
+
+        if self._recompiles is not None:
+            # First check baselines the warm-up compiles; later
+            # positive deltas are recompiles (shape leaks).
+            deltas = self._recompiles.check()
+            if deltas:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "recompilation detected during epoch %d: %s "
+                    "(shape leak? check bucketing and static args)",
+                    epoch, deltas,
+                )
+                if self.metrics_sink is not None:
+                    self.metrics_sink.log(
+                        event="recompile", epoch=epoch,
+                        **{f"compiles/{k}": v for k, v in deltas.items()},
+                    )
+        if self._telemetry is not None and jax.process_count() > 1:
+            # Straggler gauge — COLLECTIVE, so every process calls
+            # it; only process 0 (the sink owner) writes.
+            from gnot_tpu.parallel import multihost
+
+            per_host = multihost.per_host_gauge(
+                dt / max(1, len(self.train_loader))
+            )
+            if self.metrics_sink is not None:
+                self.metrics_sink.log(
+                    event="host_skew", epoch=epoch,
+                    step_time_per_host=per_host,
+                    skew_s=float(per_host.max() - per_host.min()),
+                )
+
+        if self.metrics_sink is not None:
+            self.metrics_sink.log(
+                epoch=epoch,
+                train_loss=train_loss,
+                test_metric=res,  # sink serializes non-finite as null
+                lr=self.lr_fn(self.host_step, epoch),
+                points_per_sec=points / dt,
+                epoch_seconds=dt,
+            )
+        if res < self.best_metric:
+            self.best_metric = res
+            if self.checkpointer is not None:
+                self.checkpointer.save_best(self.state, epoch, self.best_metric)
+        if self.checkpointer is not None and (
+            cfg.train.checkpoint_every
+            and (epoch + 1) % cfg.train.checkpoint_every == 0
+        ):
+            self.checkpointer.save_latest(self.state, epoch + 1, self.best_metric)
+
+    def _preempt_save(self, stop) -> None:
+        """Graceful-preemption exit: save ``latest`` at the CURRENT
+        epoch (resume replays the partial epoch on top of the saved
+        params — at-least-once epoch semantics, docs/robustness.md),
+        flush the sink, leave the run resume-ready."""
+        print(
+            f"Preemption: stopping at epoch {stop.epoch}, step {stop.step}"
+            + (
+                " — saving 'latest' and exiting resume-ready"
+                if self.checkpointer is not None
+                else " (no --checkpoint_dir: exiting without a save)"
+            )
+        )
+        state = self.state
+        if self._telemetry is not None:
+            try:
+                self._telemetry.drain()
+            except FloatingPointError:
+                # The final drain surfaced a NaN buried in the un-drained
+                # window: the live state is poisoned. Save the last-good
+                # snapshot instead (recovery on), or nothing — a 'latest'
+                # full of NaNs would strand the resume either way.
+                state = (
+                    self._supervisor.last_good_state()
+                    if self._supervisor is not None
+                    else None
+                )
+                print(
+                    "Preemption: non-finite loss in the final telemetry "
+                    "window — "
+                    + (
+                        "saving the last-good recovery snapshot instead "
+                        "of the poisoned live state"
+                        if state is not None
+                        else "NOT saving 'latest' (live state is poisoned "
+                             "and no recovery snapshot exists)"
+                    )
+                )
+        if self.checkpointer is not None and state is not None:
+            self.checkpointer.save_latest(state, stop.epoch, self.best_metric)
+            self.checkpointer.wait()
+        if self.metrics_sink is not None:
+            self.metrics_sink.log(
+                event="preempt_save", epoch=stop.epoch, step=stop.step,
+                resumable=self.checkpointer is not None and state is not None,
+            )
+            self.metrics_sink.flush()
+
+    def _escalate_restore(self, esc) -> int:
+        """Recovery ladder rung 2: the rollback budget is spent (or no
+        clean snapshot exists) — restore the newest restorable
+        checkpoint and re-enter the epoch loop at its epoch. No
+        checkpointer / nothing restorable falls through to the hard
+        abort (rung 3). Returns the epoch to continue from."""
+        err = esc.cause
+        if self._telemetry is not None:
+            self._telemetry.discard()
+        restored = (
+            self.checkpointer.restore_latest(self.state)
+            if self.checkpointer is not None
+            else None
+        )
+        if restored is None:
+            self._abort_nonfinite(err.step, err.epoch, None, err.batch)
+        self.state, epoch, self.best_metric = restored
+        self.host_step = int(self.state.step)
+        print(
+            f"Recovery: rollback budget exhausted — restored checkpoint "
+            f"(epoch {epoch}); continuing"
+        )
+        if self.metrics_sink is not None:
+            self.metrics_sink.log(
+                event="recovery_restore", epoch=err.epoch, step=err.step,
+                restored_epoch=epoch,
+                restored_from=(self.checkpointer.last_restore or {}).get("dir"),
+            )
+        return epoch

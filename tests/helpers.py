@@ -3,6 +3,29 @@
 import numpy as np
 
 
+def skip_if_pipe_tp_unsupported(mesh_cfg) -> None:
+    """Skip composed pipe x TP mesh tests on jax 0.4.x: its XLA rejects
+    the pipeline's manual shard_map ``pipe`` axis composing with a
+    GSPMD-auto ``model`` axis — every program compiles to
+    "UNIMPLEMENTED: PartitionId instruction is not supported for SPMD
+    partitioning". An upstream XLA limitation of the 0.4.37 toolchain
+    (the pinned jax ~= 0.9 compiles these fine); skipping keeps tier-1
+    signal clean without hiding real regressions on either axis alone."""
+    import jax
+    import pytest
+
+    if (
+        jax.__version__.startswith("0.4.")
+        and getattr(mesh_cfg, "model", 1) > 1
+        and getattr(mesh_cfg, "pipe", 1) > 1
+    ):
+        pytest.skip(
+            "jax 0.4.x XLA cannot compose the manual shard_map pipe axis "
+            "with a GSPMD model axis ('PartitionId not supported for "
+            "SPMD' — upstream limitation, fixed in newer jax/XLA)"
+        )
+
+
 def assert_epoch_lines_close(out_a: str, out_b: str, rtol: float) -> None:
     """Compare two runs' reference-format console outputs line by line:
     same Epoch-line structure, numeric values equal to ``rtol``. The

@@ -83,6 +83,9 @@ def assert_params_match(single_params, pipe_params, n_layers, **tol):
     ],
 )
 def test_pipelined_step_matches_single_device(mesh_cfg, n_layers, micro):
+    from helpers import skip_if_pipe_tp_unsupported
+
+    skip_if_pipe_tp_unsupported(mesh_cfg)
     mc = dataclasses.replace(SMALL, n_attn_layers=n_layers)
     model = GNOT(mc)
     optim = OptimConfig()
@@ -145,6 +148,9 @@ def test_pipelined_forward_masked_ragged():
     ids=["dp-pipe", "dp-tp-pipe"],
 )
 def test_pipeline_eval_step_matches(mesh_cfg):
+    from helpers import skip_if_pipe_tp_unsupported
+
+    skip_if_pipe_tp_unsupported(mesh_cfg)
     model = GNOT(SMALL)
     optim = OptimConfig()
     batch = make_batch()

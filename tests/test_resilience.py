@@ -1,0 +1,534 @@
+"""Chaos suite: every injected fault class either completes training or
+exits resume-ready, and resumed runs land within tolerance of an
+uninterrupted run (ISSUE 2 acceptance criteria; docs/robustness.md).
+
+Fast faults run unmarked in tier-1; long multi-fault scenarios carry
+``-m slow``. Fault specs drive everything (``train.inject_fault``) so
+the tests exercise the same mechanism operators use.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gnot_tpu import make_config
+from gnot_tpu.config import ModelConfig
+from gnot_tpu.data import datasets
+from gnot_tpu.resilience.faults import (
+    FaultInjector,
+    FaultSpec,
+    corrupt_checkpoint,
+    dangle_sidecar,
+    parse_fault_spec,
+)
+from gnot_tpu.resilience.retry import RetryPolicy, retry_io
+from gnot_tpu.train.checkpoint import Checkpointer
+from gnot_tpu.train.trainer import Trainer
+from gnot_tpu.utils.metrics import MetricsSink
+
+
+def tiny_setup(epochs=3, n_train=16, n_test=8, **over):
+    cfg = make_config(**{
+        "data.n_train": n_train, "data.n_test": n_test,
+        "data.synthetic": "darcy2d", "train.epochs": epochs, **over,
+    })
+    train, test = datasets.load(cfg.data)
+    mc = ModelConfig(
+        n_attn_layers=1, n_attn_hidden_dim=16, n_mlp_num_layers=1,
+        n_mlp_hidden_dim=16, n_input_hidden_dim=16, n_expert=2, n_head=2,
+        **datasets.infer_model_dims(train),
+    )
+    return cfg, mc, train, test
+
+
+def read_events(path):
+    recs = [json.loads(l) for l in open(path)]
+    return [r for r in recs if r.get("event")]
+
+
+@pytest.fixture(scope="module")
+def clean_best():
+    """Best metric of the uninterrupted 3-epoch reference run — the
+    tolerance anchor every fault scenario compares against."""
+    cfg, mc, train, test = tiny_setup()
+    return Trainer(cfg, mc, train, test).fit()
+
+
+# --- spec parsing / plumbing ----------------------------------------------
+
+
+def test_parse_fault_spec():
+    assert parse_fault_spec("") == []
+    assert parse_fault_spec("nan_grad@3, ckpt_io@2") == [
+        FaultSpec("nan_grad", 3), FaultSpec("ckpt_io", 2),
+    ]
+    for bad in ("nan_grad", "nan_grad@x", "typo@3", "nan_grad@0"):
+        with pytest.raises(ValueError, match="fault spec"):
+            parse_fault_spec(bad)
+
+
+def test_stop_after_epoch_is_injector_alias():
+    """--stop_after_epoch and stop_epoch@N are ONE mechanism: the
+    legacy flag maps into the injection plan."""
+    cfg = make_config(**{"train.stop_after_epoch": 2})
+    inj = FaultInjector.from_config(cfg.train)
+    assert inj is not None
+    assert inj.stop_after_epoch(1) and not inj.stop_after_epoch(0)
+    # The spec form behaves identically.
+    inj2 = FaultInjector.from_config(
+        make_config(**{"train.inject_fault": "stop_epoch@2"}).train
+    )
+    assert inj2.stop_after_epoch(1) and not inj2.stop_after_epoch(0)
+
+
+def test_bad_fault_spec_fails_at_construction():
+    cfg, mc, train, test = tiny_setup(**{"train.inject_fault": "nope@1"})
+    with pytest.raises(ValueError, match="fault spec"):
+        Trainer(cfg, mc, train, test)
+
+
+def test_retry_io_backoff_and_final_raise():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    assert (
+        retry_io(flaky, policy=RetryPolicy(attempts=4, base_delay_s=0.0),
+                 sleep=lambda s: None)
+        == "ok"
+    )
+    assert len(calls) == 3
+
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(OSError, match="down"):
+        retry_io(always, policy=RetryPolicy(attempts=2, base_delay_s=0.0),
+                 sleep=lambda s: None)
+    # Non-transient errors pass straight through (no retry).
+    def corrupt():
+        calls.append("c")
+        raise ValueError("bad bytes")
+
+    calls.clear()
+    with pytest.raises(ValueError):
+        retry_io(corrupt, policy=RetryPolicy(attempts=4, base_delay_s=0.0),
+                 sleep=lambda s: None)
+    assert calls == ["c"]
+    # Permanent filesystem answers (missing path, permission denied)
+    # are OSErrors but NOT transient: no retry, immediate raise.
+    def missing():
+        calls.append("m")
+        raise FileNotFoundError("no such file")
+
+    calls.clear()
+    with pytest.raises(FileNotFoundError):
+        retry_io(missing, policy=RetryPolicy(attempts=4, base_delay_s=0.0),
+                 sleep=lambda s: None)
+    assert calls == ["m"]
+
+
+# --- NaN / bad-sample recovery --------------------------------------------
+
+
+@pytest.mark.parametrize("fault", ["nan_grad@5", "bad_sample@5"])
+def test_nonfinite_fault_recovers_within_tolerance(
+    tmp_path, clean_best, fault
+):
+    """One poisoned step mid-run: the supervisor rolls back to the
+    last-good snapshot, quarantines the dispatch, and training
+    completes with a finite best metric within tolerance of the clean
+    run (one skipped batch of trajectory drift)."""
+    mp = str(tmp_path / "m.jsonl")
+    cfg, mc, train, test = tiny_setup(**{
+        "train.inject_fault": fault, "train.recovery": True,
+        "train.snapshot_every": 2, "train.metrics_path": mp,
+    })
+    with MetricsSink(mp) as sink:
+        best = Trainer(cfg, mc, train, test, metrics_sink=sink).fit()
+    kinds = [e["event"] for e in read_events(mp)]
+    assert "rollback" in kinds and "batch_quarantined" in kinds
+    assert np.isfinite(best)
+    np.testing.assert_allclose(best, clean_best, rtol=0.1)
+
+
+def test_rollback_replay_pins_shuffle_order(tmp_path):
+    """Content-poisoned sample + shuffle ON: recovery must replay the
+    SAME epoch order (the loader's epoch counter advances per
+    iteration, so the replay has to re-pin it). With the correct
+    replay, quarantining the bad batch's ordinal actually skips the
+    bad sample and each epoch costs ONE rollback; a wrong-order replay
+    re-dispatches the bad sample, burns the budget, and aborts."""
+    train = datasets.synth_darcy2d(16, seed=0)
+    train[5].y[:] = np.nan  # a genuinely bad record, found by content
+    test = datasets.synth_darcy2d(4, seed=1)
+    cfg = make_config(**{
+        "data.n_train": 16, "data.n_test": 4, "train.epochs": 2,
+        "train.recovery": True, "train.snapshot_every": 1,
+        "train.max_rollbacks": 2,  # one per epoch, none to waste
+    })
+    mc = ModelConfig(
+        n_attn_layers=1, n_attn_hidden_dim=16, n_mlp_num_layers=1,
+        n_mlp_hidden_dim=16, n_input_hidden_dim=16, n_expert=2, n_head=2,
+        **datasets.infer_model_dims(train),
+    )
+    assert cfg.data.shuffle_train  # the property under test needs shuffle
+    best = Trainer(cfg, mc, train, test).fit()
+    assert np.isfinite(best)
+
+
+def test_recovery_off_keeps_hard_abort(tmp_path):
+    """Without --recovery the first NaN still kills the run (the
+    fail-fast default is unchanged)."""
+    cfg, mc, train, test = tiny_setup(**{
+        "train.inject_fault": "nan_grad@2", "train.debug_checks": True,
+    })
+    with pytest.raises(FloatingPointError, match="non-finite"):
+        Trainer(cfg, mc, train, test).fit()
+
+
+def test_recovery_escalates_to_checkpoint_restore(tmp_path):
+    """Rollback budget 0: the ladder's second rung restores the latest
+    checkpoint and continues (the injected fault fires once, so the
+    replay is clean)."""
+    mp = str(tmp_path / "m.jsonl")
+    ck = str(tmp_path / "ckpt")
+    cfg, mc, train, test = tiny_setup(**{
+        "train.inject_fault": "nan_grad@6", "train.recovery": True,
+        "train.max_rollbacks": 0, "train.snapshot_every": 2,
+        "train.checkpoint_dir": ck, "train.checkpoint_every": 1,
+        "train.metrics_path": mp,
+    })
+    with MetricsSink(mp) as sink:
+        best = Trainer(
+            cfg, mc, train, test, metrics_sink=sink,
+            checkpointer=Checkpointer(ck),
+        ).fit()
+    kinds = [e["event"] for e in read_events(mp)]
+    assert "recovery_restore" in kinds
+    assert np.isfinite(best)
+
+
+def test_recovery_exhausted_aborts_with_report(tmp_path):
+    """No rollback budget, no checkpointer: the ladder bottoms out in
+    the original hard abort (FloatingPointError + non_finite_loss
+    event)."""
+    mp = str(tmp_path / "m.jsonl")
+    cfg, mc, train, test = tiny_setup(**{
+        "train.inject_fault": "nan_grad@2", "train.recovery": True,
+        "train.max_rollbacks": 0, "train.snapshot_every": 1,
+        "train.metrics_path": mp,
+    })
+    with MetricsSink(mp) as sink:
+        with pytest.raises(FloatingPointError, match="non-finite"):
+            Trainer(cfg, mc, train, test, metrics_sink=sink).fit()
+    assert any(e["event"] == "non_finite_loss" for e in read_events(mp))
+
+
+# --- graceful preemption --------------------------------------------------
+
+
+def test_sigterm_midepoch_saves_and_resumes(tmp_path, clean_best):
+    """A real SIGTERM mid-epoch stops at the step boundary, saves
+    ``latest``, logs preempt_save, and the --resume run reaches a best
+    metric within tolerance of the uninterrupted run."""
+    mp = str(tmp_path / "m.jsonl")
+    ck = str(tmp_path / "ckpt")
+    cfg, mc, train, test = tiny_setup(**{
+        "train.inject_fault": "sigterm@6", "train.checkpoint_dir": ck,
+        "train.metrics_path": mp,
+    })
+    with MetricsSink(mp) as sink:
+        Trainer(
+            cfg, mc, train, test, metrics_sink=sink,
+            checkpointer=Checkpointer(ck),
+        ).fit()
+    events = read_events(mp)
+    assert any(
+        e["event"] == "preempt_save" and e["resumable"] for e in events
+    )
+    cfg2 = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, resume=True, inject_fault="")
+    )
+    t2 = Trainer(cfg2, mc, train, test, checkpointer=Checkpointer(ck))
+    best = t2.fit()
+    assert np.isfinite(best)
+    np.testing.assert_allclose(best, clean_best, rtol=0.1)
+
+
+def test_preemption_handler_flag_and_restore():
+    """The handler context installs/restores handlers and the stop flag
+    reaches should_stop (single-process path, no collective)."""
+    import signal
+
+    from gnot_tpu.resilience.preemption import PreemptionHandler
+
+    before = signal.getsignal(signal.SIGTERM)
+    with PreemptionHandler() as h:
+        assert not h.should_stop()
+        h.request_stop()
+        assert h.should_stop()
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+# --- checkpoint corruption / I/O ------------------------------------------
+
+
+def _fitted_checkpoint(tmp_path, epochs=2):
+    ck = str(tmp_path / "ckpt")
+    cfg, mc, train, test = tiny_setup(
+        epochs=epochs, n_train=8, n_test=4,
+        **{"train.checkpoint_dir": ck, "train.checkpoint_every": 1},
+    )
+    t = Trainer(cfg, mc, train, test, checkpointer=Checkpointer(ck))
+    t.fit()
+    return ck, cfg, mc, train, test, t
+
+
+def _resumed(ck, cfg, mc, train, test, **ck_kwargs):
+    cfg2 = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, resume=True)
+    )
+    c = Checkpointer(ck, **ck_kwargs)
+    t = Trainer(cfg2, mc, train, test, checkpointer=c)
+    t.initialize()
+    return c, t
+
+
+def test_truncated_latest_dir_falls_back_to_best(tmp_path):
+    ck, cfg, mc, train, test, _ = _fitted_checkpoint(tmp_path)
+    meta = json.load(open(os.path.join(ck, "latest.json")))
+    corrupt_checkpoint(os.path.join(ck, meta["dir"]), mode="truncate")
+    c, t = _resumed(
+        ck, cfg, mc, train, test,
+        retry_policy=RetryPolicy(attempts=2, base_delay_s=0.0),
+    )
+    assert c.last_restore is not None and c.last_restore["fallback"]
+    assert c.last_restore["name"] == "best"
+    assert t.start_epoch == c.last_restore["epoch"]  # resumed from best
+
+
+def test_dangling_sidecar_falls_back(tmp_path):
+    """Sidecar names a directory that no longer exists — the walk must
+    skip it, not crash or silently restart from scratch."""
+    ck, cfg, mc, train, test, _ = _fitted_checkpoint(tmp_path)
+    dangle_sidecar(ck, "latest")
+    c, t = _resumed(ck, cfg, mc, train, test)
+    assert c.last_restore is not None and c.last_restore["fallback"]
+    assert t.start_epoch == c.last_restore["epoch"]
+
+
+def test_missing_sidecar_scans_dirs(tmp_path):
+    """Both sidecars deleted (crash before first publish): the on-disk
+    directory scan still restores the newest committed checkpoint,
+    with best_metric degraded to +inf (re-established by eval)."""
+    ck, cfg, mc, train, test, _ = _fitted_checkpoint(tmp_path)
+    os.remove(os.path.join(ck, "latest.json"))
+    os.remove(os.path.join(ck, "best.json"))
+    c, t = _resumed(ck, cfg, mc, train, test)
+    assert c.last_restore is not None
+    assert c.last_restore["dir"].startswith("latest.")
+    assert c.last_restore["best_metric"] == float("inf")
+    assert t.start_epoch >= 1
+
+
+def test_everything_corrupt_restores_nothing(tmp_path):
+    """All candidates unrestorable → restore_latest returns None (the
+    trainer then starts fresh) — never an unhandled exception."""
+    ck, cfg, mc, train, test, _ = _fitted_checkpoint(tmp_path)
+    for d in os.listdir(ck):
+        full = os.path.join(ck, d)
+        if os.path.isdir(full):
+            corrupt_checkpoint(full, mode="remove")
+    c, t = _resumed(
+        ck, cfg, mc, train, test,
+        retry_policy=RetryPolicy(attempts=2, base_delay_s=0.0),
+    )
+    assert c.last_restore is None
+    assert t.start_epoch == 0
+
+
+def test_transient_ckpt_io_errors_retried(tmp_path):
+    """ckpt_io@2: two injected transient failures during saves are
+    retried with backoff; the run completes and the checkpoint is
+    restorable; io_retry events land in the sink."""
+    mp = str(tmp_path / "m.jsonl")
+    ck = str(tmp_path / "ckpt")
+    cfg, mc, train, test = tiny_setup(
+        epochs=2, n_train=8, n_test=4,
+        **{
+            "train.inject_fault": "ckpt_io@2", "train.checkpoint_dir": ck,
+            "train.checkpoint_every": 1, "train.metrics_path": mp,
+        },
+    )
+    with MetricsSink(mp) as sink:
+        t = Trainer(
+            cfg, mc, train, test, metrics_sink=sink,
+            checkpointer=Checkpointer(
+                ck, retry_policy=RetryPolicy(attempts=4, base_delay_s=0.0)
+            ),
+        )
+        best = t.fit()
+    assert np.isfinite(best)
+    assert sum(e["event"] == "io_retry" for e in read_events(mp)) == 2
+    assert Checkpointer(ck).restore_latest(t.state) is not None
+
+
+def test_corrupt_ckpt_injection_then_resume_falls_back(tmp_path):
+    """corrupt_ckpt@2 truncates the committed epoch-2 'latest' after
+    publish; the --resume run walks to a restorable candidate and
+    still resumes (restore_fallback event)."""
+    mp = str(tmp_path / "m.jsonl")
+    ck = str(tmp_path / "ckpt")
+    cfg, mc, train, test = tiny_setup(
+        epochs=2, n_train=8, n_test=4,
+        **{
+            "train.inject_fault": "corrupt_ckpt@2",
+            "train.checkpoint_dir": ck, "train.checkpoint_every": 1,
+        },
+    )
+    t = Trainer(cfg, mc, train, test, checkpointer=Checkpointer(ck))
+    t.fit()
+    with MetricsSink(mp) as sink:
+        cfg2 = dataclasses.replace(
+            cfg,
+            train=dataclasses.replace(
+                cfg.train, resume=True, inject_fault="", metrics_path=mp
+            ),
+        )
+        c = Checkpointer(
+            ck, retry_policy=RetryPolicy(attempts=2, base_delay_s=0.0)
+        )
+        t2 = Trainer(
+            cfg2, mc, train, test, metrics_sink=sink, checkpointer=c
+        )
+        t2.initialize()
+    assert c.last_restore is not None and c.last_restore["fallback"]
+    assert any(e["event"] == "restore_fallback" for e in read_events(mp))
+
+
+def test_async_save_not_corrupted_by_donated_buffers(tmp_path):
+    """Regression: the async orbax write used to read zero-copy views
+    of state buffers the NEXT train step donates, so any checkpoint
+    overlapped by further training held garbage (silently — or a heap
+    abort). The save must snapshot: a 'latest' written mid-run and then
+    overlapped by training restores the state AS OF THE SAVE."""
+    import jax
+    import jax.numpy as jnp
+
+    ck = str(tmp_path / "ckpt")
+    cfg, mc, train, test = tiny_setup(epochs=1, n_train=8, n_test=4)
+    t = Trainer(cfg, mc, train, test)
+    t.initialize()
+    batch = next(iter(t.train_loader))
+    lr = jnp.asarray(1e-3, jnp.float32)
+    t.state, _ = t.train_step(t.state, batch, lr)
+    # True host copies (np.array copies; device_get could alias).
+    ref = [np.array(x) for x in jax.tree.leaves(jax.device_get(t.state.params))]
+    c = Checkpointer(ck)
+    c.save_latest(t.state, 1, 0.5)  # async kickoff
+    for _ in range(3):  # overlap the write with donating steps
+        t.state, _ = t.train_step(t.state, batch, lr)
+    c.wait()
+    restored = Checkpointer(ck).restore_latest(t.state)
+    assert restored is not None
+    for a, b in zip(ref, jax.tree.leaves(restored[0].params)):
+        np.testing.assert_array_equal(a, np.asarray(b))
+
+
+# --- stop_epoch alias end to end ------------------------------------------
+
+
+def test_stop_epoch_fault_then_resume_matches_continuous(capsys):
+    """The injection-framework form of the preemption fault: a run
+    stopped by stop_epoch@1 and resumed replays the continuous run's
+    remaining epochs exactly (seeded shuffle + checkpointed state)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as d:
+        ck = os.path.join(d, "ckpt")
+        cont_cfg, mc, train, test = tiny_setup(
+            epochs=2, n_train=8, n_test=4,
+            **{"train.checkpoint_dir": os.path.join(d, "cont"),
+               "train.checkpoint_every": 1},
+        )
+        Trainer(
+            cont_cfg, mc, train, test,
+            checkpointer=Checkpointer(cont_cfg.train.checkpoint_dir),
+        ).fit()
+        cont_out = capsys.readouterr().out
+
+        int_cfg = dataclasses.replace(
+            cont_cfg,
+            train=dataclasses.replace(
+                cont_cfg.train, checkpoint_dir=ck, inject_fault="stop_epoch@1"
+            ),
+        )
+        Trainer(int_cfg, mc, train, test, checkpointer=Checkpointer(ck)).fit()
+        capsys.readouterr()
+        res_cfg = dataclasses.replace(
+            int_cfg,
+            train=dataclasses.replace(
+                int_cfg.train, resume=True, inject_fault=""
+            ),
+        )
+        Trainer(res_cfg, mc, train, test, checkpointer=Checkpointer(ck)).fit()
+        res_out = capsys.readouterr().out
+
+    cont = dict(
+        l.split(", Loss: ")
+        for l in cont_out.splitlines()
+        if ", Loss: " in l
+    )
+    res = dict(
+        l.split(", Loss: ")
+        for l in res_out.splitlines()
+        if ", Loss: " in l
+    )
+    assert set(res) == {"Epoch 1"}
+    np.testing.assert_allclose(
+        float(res["Epoch 1"]), float(cont["Epoch 1"]), rtol=1e-5
+    )
+
+
+# --- long scenarios (tier-2) ----------------------------------------------
+
+
+@pytest.mark.slow
+def test_multi_fault_chaos_run(tmp_path, clean_best):
+    """Everything at once: a bad sample, a NaN step, two flaky
+    checkpoint writes and a SIGTERM — the run survives the first three,
+    exits resume-ready on the SIGTERM, and the resumed run lands within
+    tolerance of the clean run."""
+    mp = str(tmp_path / "m.jsonl")
+    ck = str(tmp_path / "ckpt")
+    cfg, mc, train, test = tiny_setup(**{
+        "train.inject_fault": (
+            "bad_sample@2,nan_grad@6,ckpt_io@2,sigterm@10"
+        ),
+        "train.recovery": True, "train.snapshot_every": 2,
+        "train.checkpoint_dir": ck, "train.checkpoint_every": 1,
+        "train.metrics_path": mp,
+    })
+    with MetricsSink(mp) as sink:
+        Trainer(
+            cfg, mc, train, test, metrics_sink=sink,
+            checkpointer=Checkpointer(
+                ck, retry_policy=RetryPolicy(attempts=4, base_delay_s=0.0)
+            ),
+        ).fit()
+    kinds = [e["event"] for e in read_events(mp)]
+    assert "rollback" in kinds and "preempt_save" in kinds
+    cfg2 = dataclasses.replace(
+        cfg, train=dataclasses.replace(cfg.train, resume=True, inject_fault="")
+    )
+    best = Trainer(cfg2, mc, train, test, checkpointer=Checkpointer(ck)).fit()
+    assert np.isfinite(best)
+    np.testing.assert_allclose(best, clean_best, rtol=0.15)
