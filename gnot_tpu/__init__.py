@@ -6,7 +6,7 @@ attention as MXU einsums, geometry-gated soft-MoE FFNs as batched GEMMs,
 sharded training over a device mesh, Orbax checkpointing.
 """
 
-from gnot_tpu.config import Config, DataConfig, MeshConfig, ModelConfig, OptimConfig, TrainConfig, make_config
+from gnot_tpu.config import Config, DataConfig, MeshConfig, ModelConfig, OptimConfig, ServeConfig, TrainConfig, make_config
 from gnot_tpu.data.batch import Loader, MeshBatch, MeshSample, collate
 from gnot_tpu.models.gnot import GNOT
 
@@ -18,6 +18,7 @@ __all__ = [
     "MeshConfig",
     "ModelConfig",
     "OptimConfig",
+    "ServeConfig",
     "TrainConfig",
     "make_config",
     "Loader",

@@ -290,12 +290,64 @@ class TrainConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Inference-serving policies (gnot_tpu/serve/, docs/serving.md).
+
+    Used by the ``--serve`` entrypoint and library users of
+    ``serve.InferenceServer``; training ignores this section."""
+
+    # Dynamic batching: a bucket's queue flushes at max_batch requests
+    # or when its oldest request has waited max_wait_ms — the
+    # latency/utilization dial. Every dispatch is padded to max_batch
+    # rows, so each bucket compiles exactly one program.
+    max_batch: int = 4
+    max_wait_ms: float = 10.0
+    # Bounded-queue admission: at most queue_limit requests in the
+    # system; beyond it, submissions fast-fail ("shed_queue_full")
+    # instead of growing a backlog that then misses every deadline.
+    queue_limit: int = 64
+    # Default per-request deadline (ms; 0 = none). Expired requests are
+    # shed BEFORE dispatch, and the same budget clamps downstream
+    # retries (resilience.retry deadline).
+    deadline_ms: float = 0.0
+    # Circuit breaker: trips open after `breaker_threshold` consecutive
+    # dispatch failures (non-finite outputs / device errors); while
+    # open, requests get instant reject-with-reason responses. After
+    # breaker_cooldown_s one half-open trial decides recovery.
+    breaker_threshold: int = 3
+    breaker_cooldown_s: float = 1.0
+    # Graceful-drain budget: how long drain() waits for in-flight
+    # requests before force-resolving the stragglers.
+    drain_timeout_s: float = 30.0
+    # Serve-side deterministic fault injection (resilience/faults.py):
+    # slow_request@N, nan_output@N, reload_corrupt@N. "" = none.
+    inject_fault: str = ""
+
+    def __post_init__(self) -> None:
+        if self.max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.max_wait_ms < 0:
+            raise ValueError(
+                f"max_wait_ms must be >= 0, got {self.max_wait_ms}"
+            )
+        if self.queue_limit < 1:
+            raise ValueError(
+                f"queue_limit must be >= 1, got {self.queue_limit}"
+            )
+        if self.breaker_threshold < 1:
+            raise ValueError(
+                f"breaker_threshold must be >= 1, got {self.breaker_threshold}"
+            )
+
+
+@dataclasses.dataclass(frozen=True)
 class Config:
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     optim: OptimConfig = dataclasses.field(default_factory=OptimConfig)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     mesh: MeshConfig = dataclasses.field(default_factory=MeshConfig)
     train: TrainConfig = dataclasses.field(default_factory=TrainConfig)
+    serve: ServeConfig = dataclasses.field(default_factory=ServeConfig)
 
 
 def _apply_overrides(cfg: Any, overrides: dict[str, Any]) -> Any:

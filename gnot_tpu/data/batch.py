@@ -103,6 +103,60 @@ def bucket_length(n: int, *, min_size: int = 64) -> int:
     return size
 
 
+def validate_samples(
+    samples: Sequence[MeshSample],
+    *,
+    pad_nodes: int = 0,
+    pad_funcs: int = 0,
+    check_finite: bool = True,
+) -> None:
+    """Reject malformed inference inputs with the offending sample index.
+
+    Two failure classes, both raised as ValueError naming ``sample i``:
+
+    * oversize meshes/functions against FIXED pad lengths (an unseen
+      longer mesh cannot be packed into pads captured from the training
+      data — fail with the limit, not a cryptic broadcast error from
+      the packer);
+    * non-finite coords / input-function values / theta / targets (a
+      NaN query poisons the whole padded batch it rides in — under
+      linear attention every sample attends through shared normalization
+      Grams, so one bad request can corrupt its batchmates' outputs
+      and, serving-side, trip the circuit breaker).
+
+    The one validation gate shared by ``Trainer.predict`` and the
+    serving ``InferenceEngine``.
+    """
+    for i, s in enumerate(samples):
+        if pad_nodes and s.coords.shape[0] > pad_nodes:
+            raise ValueError(
+                f"sample {i} has {s.coords.shape[0]} mesh points but the "
+                f"fixed pad length is {pad_nodes} (set from the training "
+                "data); rebuild with larger pad_nodes"
+            )
+        if pad_funcs:
+            for j, f in enumerate(s.funcs):
+                if f.shape[0] > pad_funcs:
+                    raise ValueError(
+                        f"sample {i} input function {j} has {f.shape[0]} "
+                        f"points but the fixed pad length is {pad_funcs}; "
+                        "rebuild with larger pad_funcs"
+                    )
+        if not check_finite:
+            continue
+        if not np.all(np.isfinite(s.coords)):
+            raise ValueError(f"sample {i} has non-finite mesh coordinates")
+        if not np.all(np.isfinite(np.asarray(s.theta, dtype=np.float64))):
+            raise ValueError(f"sample {i} has non-finite theta parameters")
+        if s.y is not None and not np.all(np.isfinite(s.y)):
+            raise ValueError(f"sample {i} has non-finite target values")
+        for j, f in enumerate(s.funcs):
+            if not np.all(np.isfinite(f)):
+                raise ValueError(
+                    f"sample {i} input function {j} has non-finite values"
+                )
+
+
 def fixed_pad_lengths(
     samples: Sequence[MeshSample], *, bucket: bool = True
 ) -> tuple[int, int]:

@@ -135,6 +135,62 @@ def build_parser() -> argparse.ArgumentParser:
         "--eval_only", action="store_true",
         help="restore the best checkpoint and evaluate (no training)"
     )
+    # Inference serving (gnot_tpu/serve/, docs/serving.md).
+    p.add_argument(
+        "--serve", action="store_true",
+        help="serving mode (no training): restore the best checkpoint "
+             "(when --checkpoint_dir is set; fresh weights otherwise), "
+             "start the fault-tolerant InferenceServer (dynamic bucketed "
+             "batching, admission control, deadlines, circuit breaker, "
+             "graceful SIGTERM drain, hot reload), and drive the test "
+             "set through it as a request stream; serve events + "
+             "serve_summary flow to --metrics_path"
+    )
+    p.add_argument(
+        "--serve_max_batch", type=int, default=4,
+        help="serving: requests per dispatch; each bucket's queue "
+             "flushes at this size (dispatches are padded to it, so one "
+             "compiled program per bucket)"
+    )
+    p.add_argument(
+        "--serve_max_wait_ms", type=float, default=10.0,
+        help="serving: max ms a request waits for batchmates before a "
+             "partial flush (the latency/utilization dial)"
+    )
+    p.add_argument(
+        "--serve_queue_limit", type=int, default=64,
+        help="serving: bounded-queue admission limit; beyond it "
+             "submissions fast-fail (load shedding) instead of growing "
+             "a backlog"
+    )
+    p.add_argument(
+        "--serve_deadline_ms", type=float, default=0.0,
+        help="serving: default per-request deadline (0 = none); expired "
+             "requests are shed before dispatch"
+    )
+    p.add_argument(
+        "--serve_breaker_threshold", type=int, default=3,
+        help="serving: consecutive dispatch failures (NaN outputs / "
+             "device errors) that trip the circuit breaker open"
+    )
+    p.add_argument(
+        "--serve_breaker_cooldown_s", type=float, default=1.0,
+        help="serving: seconds the tripped breaker rejects before one "
+             "half-open trial dispatch decides recovery"
+    )
+    p.add_argument(
+        "--serve_inject_fault", type=str, default="",
+        help="serving-side deterministic fault injection "
+             "(docs/serving.md): comma-separated kind@N — "
+             "slow_request@admission, nan_output@dispatch, "
+             "reload_corrupt@reload"
+    )
+    p.add_argument(
+        "--serve_reload_every", type=int, default=0,
+        help="serving demo traffic: hot-reload the checkpoint after "
+             "every N requests (0 = never) — exercises the atomic "
+             "weight swap under load"
+    )
     p.add_argument("--checkpoint_every", type=int, default=0)
     p.add_argument(
         "--stop_after_epoch", type=int, default=0,
@@ -273,6 +329,13 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "train.steps_per_dispatch": args.steps_per_dispatch,
             "train.seed": args.seed,
             "train.distributed": args.distributed,
+            "serve.max_batch": args.serve_max_batch,
+            "serve.max_wait_ms": args.serve_max_wait_ms,
+            "serve.queue_limit": args.serve_queue_limit,
+            "serve.deadline_ms": args.serve_deadline_ms,
+            "serve.breaker_threshold": args.serve_breaker_threshold,
+            "serve.breaker_cooldown_s": args.serve_breaker_cooldown_s,
+            "serve.inject_fault": args.serve_inject_fault,
             "mesh.data": args.mesh_data,
             "mesh.seq": args.mesh_seq,
             "mesh.model": args.mesh_model,
@@ -533,7 +596,11 @@ def main(argv=None) -> float:
                 argv=list(argv) if argv is not None else sys.argv[1:],
                 extra={
                     "metrics_path": cfg.train.metrics_path,
-                    "kind": "eval" if args.eval_only else "train",
+                    "kind": (
+                        "serve"
+                        if args.serve
+                        else "eval" if args.eval_only else "train"
+                    ),
                     # Which checkpoint (if any) this run resumed from —
                     # including fallback provenance (checkpoint.py).
                     "restore": (
@@ -549,7 +616,14 @@ def main(argv=None) -> float:
             # BEFORE any heavy init: a run that crashes compiling or
             # restoring still leaves its provenance on disk.
             write_run_manifest()
-        if args.eval_only:
+        if args.serve:
+            result = _run_serve(
+                args, cfg, trainer, full_test_samples, sink, checkpointer
+            )
+            if manifests_on and checkpointer is not None:
+                # Record which checkpoint serving actually restored.
+                write_run_manifest()
+        elif args.eval_only:
             result = trainer.evaluate_from_checkpoint()
             if manifests_on and checkpointer is not None:
                 # Record which 'best' checkpoint the eval actually
@@ -590,6 +664,82 @@ def main(argv=None) -> float:
             if jax.process_index() == 0:
                 _write_predictions(full_test_samples, preds, args.predict_out)
     return result
+
+
+def _run_serve(args, cfg, trainer, samples, sink, checkpointer) -> float:
+    """``--serve``: restore weights, start the fault-tolerant
+    InferenceServer, drive the test set through it as a request stream
+    (the in-process demo/smoke traffic — a network transport would sit
+    in front of ``server.submit``), drain gracefully, and report. A
+    SIGTERM mid-stream stops admission and drains in-flight requests
+    (resilience.preemption). Returns the completed-request fraction."""
+    import jax
+
+    from gnot_tpu.resilience.faults import FaultInjector
+    from gnot_tpu.resilience.preemption import PreemptionHandler
+    from gnot_tpu.serve import CheckpointReloader, InferenceServer
+
+    if jax.process_count() > 1:
+        raise ValueError(
+            "--serve is single-process (the request-serving layer does "
+            "not compose with multi-host SPMD; single-process meshes "
+            "are fine)"
+        )
+    trainer.initialize()
+    if checkpointer is not None:
+        restored = checkpointer.restore_best(
+            trainer.state
+        ) or checkpointer.restore_latest(trainer.state)
+        if restored is not None:
+            trainer.state = restored[0]
+        else:
+            print("note: no restorable checkpoint — serving fresh weights")
+    sc = cfg.serve
+    engine = trainer.inference_engine()
+    # Serving-startup discipline (docs/serving.md): precompile one
+    # program per bucket the traffic will hit — a cold XLA compile
+    # landing under a tight deadline would shed everything behind it.
+    engine.warmup(samples, rows=sc.max_batch)
+    with PreemptionHandler() as preempt:
+        server = InferenceServer(
+            engine,
+            max_batch=sc.max_batch,
+            max_wait_ms=sc.max_wait_ms,
+            queue_limit=sc.queue_limit,
+            default_deadline_ms=sc.deadline_ms,
+            breaker_threshold=sc.breaker_threshold,
+            breaker_cooldown_s=sc.breaker_cooldown_s,
+            sink=sink,
+            reload_fn=(
+                CheckpointReloader(checkpointer, trainer.state)
+                if checkpointer is not None
+                else None
+            ),
+            faults=FaultInjector.from_spec(sc.inject_fault),
+            preempt=preempt,
+        ).start()
+        futures = []
+        for i, s in enumerate(samples):
+            if preempt.triggered:
+                break
+            futures.append(server.submit(s))
+            if (
+                args.serve_reload_every
+                and checkpointer is not None
+                and (i + 1) % args.serve_reload_every == 0
+            ):
+                server.reload(deadline_ms=sc.deadline_ms)
+        for f in futures:
+            f.result(timeout=sc.drain_timeout_s)
+        summary = server.drain(sc.drain_timeout_s)
+    print(
+        f"Serve: {summary['completed']}/{summary['requests']} ok, "
+        f"shed={summary['shed']}, breaker_trips={summary['breaker_trips']}, "
+        f"reloads={summary['reloads']}, "
+        f"p50={summary['latency_p50_ms']}ms p99={summary['latency_p99_ms']}ms, "
+        f"compiled_shapes={summary['compiled_shapes']}"
+    )
+    return summary["completed"] / max(1, summary["requests"])
 
 
 def _write_predictions(samples, preds, path: str) -> None:

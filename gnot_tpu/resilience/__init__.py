@@ -10,8 +10,11 @@ becomes a sink event — docs/robustness.md and docs/observability.md):
   ``train.inject_fault`` config spec (``--inject_fault``): NaN
   gradients or bad data samples at step k, SIGTERM at step k,
   transient checkpoint-I/O errors, corrupted/truncated checkpoint
-  directories, clean stop after epoch N. Every recovery path is
-  thereby testable on CPU (tests/test_resilience.py, the chaos suite).
+  directories, clean stop after epoch N — plus the serve-side kinds
+  (``serve.inject_fault``: slow requests, NaN outputs, reload-racing
+  corruption) consumed by ``gnot_tpu/serve`` (docs/serving.md). Every
+  recovery path is thereby testable on CPU (tests/test_resilience.py
+  and tests/test_serve.py, the chaos suites).
 * ``supervisor`` — the recovery ladder wired into ``Trainer.fit``: a
   rolling last-good on-device snapshot every ``train.snapshot_every``
   steps; a watchdog-detected non-finite loss rolls back to it,
@@ -30,6 +33,7 @@ from gnot_tpu.resilience.faults import (  # noqa: F401
     FaultSpec,
     InjectedIOError,
     corrupt_checkpoint,
+    corrupt_published,
     parse_fault_spec,
 )
 from gnot_tpu.resilience.preemption import PreemptionHandler  # noqa: F401

@@ -21,8 +21,23 @@ is a comma-separated list of ``kind@arg`` entries:
   ``--stop_after_epoch`` fault, now one mechanism with the rest; the
   flag remains as an alias).
 
+Serve-side kinds (``serve.inject_fault``, consumed by
+``gnot_tpu/serve/`` — docs/serving.md):
+
+* ``slow_request@N`` — stall the dispatch carrying the Nth admitted
+  request until that request's deadline has passed (a straggling
+  device / head-of-line blocking), so deadline shedding is exercised
+  deterministically.
+* ``nan_output@N`` — poison the outputs of the Nth serving dispatch
+  with NaN (sick chip / corrupted weights), the circuit breaker's
+  trip condition.
+* ``reload_corrupt@N`` — truncate the published ``latest`` checkpoint
+  directory immediately before the Nth hot reload reads it, so the
+  reload must survive via the restore fallback chain.
+
 Steps are 1-indexed global update counts (the trainer's ``host_step``
-after the dispatch), matching the step numbers in metrics records.
+after the dispatch), matching the step numbers in metrics records;
+serve ordinals are 1-indexed admission/dispatch/reload counts.
 Step- and epoch-keyed faults fire once; ``ckpt_io`` decrements its
 budget per injected error.
 """
@@ -40,7 +55,18 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-KINDS = ("nan_grad", "bad_sample", "sigterm", "ckpt_io", "corrupt_ckpt", "stop_epoch")
+KINDS = (
+    "nan_grad",
+    "bad_sample",
+    "sigterm",
+    "ckpt_io",
+    "corrupt_ckpt",
+    "stop_epoch",
+    # serve-side (gnot_tpu/serve/, docs/serving.md)
+    "slow_request",
+    "nan_output",
+    "reload_corrupt",
+)
 
 
 class InjectedIOError(OSError):
@@ -97,6 +123,14 @@ class FaultInjector:
             specs.append(FaultSpec("stop_epoch", stop))
         return cls(specs) if specs else None
 
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultInjector | None":
+        """Build straight from a ``kind@N,...`` spec string (the serving
+        engine's entry point — no TrainConfig in the loop). Returns
+        None when the spec is empty."""
+        specs = parse_fault_spec(spec or "")
+        return cls(specs) if specs else None
+
     def _take(self, kind: str, at: int) -> bool:
         """True exactly once per (kind, at) armed in the plan."""
         key = (kind, at)
@@ -137,6 +171,45 @@ class FaultInjector:
         return any(
             s.kind == "stop_epoch" and epoch + 1 >= s.at for s in self.specs
         )
+
+    # -- serving hooks (gnot_tpu/serve/) -----------------------------------
+
+    def maybe_slow_request(self, ordinal: int) -> bool:
+        """True once when the ``ordinal``-th admitted request has a
+        ``slow_request`` fault armed: the server stalls that request's
+        dispatch past its deadline (deterministic deadline shedding)."""
+        if self._take("slow_request", ordinal):
+            logger.warning(
+                "fault injection: slow request at admission #%d", ordinal
+            )
+            return True
+        return False
+
+    def maybe_nan_output(self, dispatch: int) -> bool:
+        """True once when the ``dispatch``-th serving forward has a
+        ``nan_output`` fault armed: the server poisons that dispatch's
+        outputs with NaN (the circuit breaker's trip condition)."""
+        if self._take("nan_output", dispatch):
+            logger.warning(
+                "fault injection: NaN outputs on serving dispatch #%d",
+                dispatch,
+            )
+            return True
+        return False
+
+    def maybe_reload_corrupt(self, reload_ordinal: int, directory: str) -> bool:
+        """``reload_corrupt@N``: before the Nth hot reload restores,
+        truncate the published ``latest`` checkpoint under
+        ``directory`` (torn write racing the reload) — the reload must
+        survive via the restore fallback chain."""
+        if not self._take("reload_corrupt", reload_ordinal):
+            return False
+        logger.warning(
+            "fault injection: corrupting published 'latest' under %s "
+            "before reload #%d", directory, reload_ordinal,
+        )
+        corrupt_published(directory, "latest")
+        return True
 
     # -- checkpoint hooks --------------------------------------------------
 
@@ -194,6 +267,23 @@ def corrupt_checkpoint(path: str, *, mode: str = "truncate") -> None:
     if survivors:
         with open(survivors[0], "wb") as fh:
             fh.write(b"\0")
+
+
+def corrupt_published(directory: str, name: str = "latest") -> None:
+    """Truncate the checkpoint directory the ``<name>.json`` sidecar
+    currently names (the serve-side ``reload_corrupt`` shape: a torn
+    write landing between a save and the reload that reads it). No-op
+    when no sidecar/directory exists — the reload then simply walks its
+    normal fallback chain."""
+    meta_path = os.path.join(directory, f"{name}.json")
+    try:
+        with open(meta_path) as f:
+            target = json.load(f).get("dir", name)
+    except (OSError, json.JSONDecodeError):
+        return
+    full = os.path.join(directory, target)
+    if os.path.isdir(full):
+        corrupt_checkpoint(full, mode="truncate")
 
 
 def dangle_sidecar(directory: str, name: str) -> None:

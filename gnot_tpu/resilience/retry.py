@@ -62,12 +62,23 @@ def retry_io(
     describe: str = "io",
     on_retry: Callable[[int, BaseException], None] | None = None,
     sleep: Callable[[float], None] = time.sleep,
+    deadline: float | None = None,
+    clock: Callable[[], float] = time.monotonic,
 ):
     """Call ``fn()``; on a transient error, back off and retry up to
     ``policy.attempts`` total tries. The final failure re-raises the
     LAST error (the one a human debugs). ``on_retry(attempt, exc)``
     fires before each sleep — the trainer routes it to the sink as an
-    ``io_retry`` event so flaky storage is visible, not silent."""
+    ``io_retry`` event so flaky storage is visible, not silent.
+
+    ``deadline`` is an ABSOLUTE ``clock()`` time bounding the whole
+    retry loop: backoff sleeps are clamped to the remaining budget and
+    a retry is never attempted past it, so a caller with its own
+    deadline (a serving request, a hot reload mid-traffic) can wrap
+    flaky I/O without the retries outliving it. A deadline already
+    expired when the next retry would start re-raises the last error
+    immediately.
+    """
     policy = policy or RetryPolicy()
     delays = list(policy.delays())
     for attempt in range(policy.attempts):
@@ -78,10 +89,21 @@ def retry_io(
                 raise
             if attempt >= policy.attempts - 1:
                 raise
+            delay = delays[attempt]
+            if deadline is not None:
+                remaining = deadline - clock()
+                if remaining <= 0:
+                    logger.warning(
+                        "%s error and the caller's deadline has passed "
+                        "(attempt %d/%d): %s — giving up without retrying",
+                        describe, attempt + 1, policy.attempts, exc,
+                    )
+                    raise
+                delay = min(delay, remaining)
             logger.warning(
                 "transient %s error (attempt %d/%d): %s — retrying",
                 describe, attempt + 1, policy.attempts, exc,
             )
             if on_retry is not None:
                 on_retry(attempt + 1, exc)
-            sleep(delays[attempt])
+            sleep(delay)

@@ -1,0 +1,38 @@
+"""Fault-tolerant inference serving (ROADMAP north star: "serves heavy
+traffic from millions of users" — the request-level path the offline
+``Trainer.predict`` never was).
+
+Four pieces (docs/serving.md):
+
+* ``engine`` — ``InferenceEngine``: validation, bucketed static-shape
+  collate, jitted forward, atomic weight swap — extracted from
+  ``Trainer.predict`` so train and serve share one forward path.
+* ``batcher`` — per-bucket dynamic batching (flush on ``max_batch`` or
+  ``max_wait_ms``); no batch ever spans two buckets, so the compiled-
+  program count stays O(log L_max) under any request mix.
+* ``policies`` — request deadlines (expired requests shed before
+  dispatch), bounded-queue admission with fast-fail load shedding, and
+  a circuit breaker tripping on non-finite outputs / device errors.
+* ``server`` — ``InferenceServer``: the worker loop composing the
+  above, graceful SIGTERM drain (resilience.preemption), hot
+  checkpoint reload via the ``Checkpointer`` fallback chain, and
+  ``queue_depth``/``shed``/``breaker_*``/``reload``/``serve_summary``
+  events through the ordinary ``MetricsSink``.
+
+Chaos-tested on CPU via the serve-side fault kinds in
+``resilience.faults`` (``slow_request@N``, ``nan_output@N``,
+``reload_corrupt@N``) — tests/test_serve.py.
+"""
+
+from gnot_tpu.serve.batcher import Batcher  # noqa: F401
+from gnot_tpu.serve.engine import InferenceEngine  # noqa: F401
+from gnot_tpu.serve.policies import (  # noqa: F401
+    AdmissionController,
+    CircuitBreaker,
+    Deadline,
+)
+from gnot_tpu.serve.server import (  # noqa: F401
+    CheckpointReloader,
+    InferenceServer,
+    ServeResult,
+)

@@ -1,0 +1,244 @@
+"""InferenceEngine: THE forward path for inference, offline and serving.
+
+Extracted from ``Trainer.predict``'s internals (validation, bucketed
+static-shape collate, jitted forward, unpad slicing) so train-time
+prediction and request serving share ONE code path — a divergence here
+would mean "the model you validated is not the model you serve".
+
+Two entry points:
+
+* ``predict(samples)`` — the offline, all-at-once path with the exact
+  semantics ``Trainer.predict`` always had (multi-batch loader with
+  prefetch, mesh group padding, multi-process slice assembly).
+* ``infer(samples, pad_nodes=, pad_funcs=, rows=)`` — ONE dispatch at
+  one fully static shape, the serving hot path. The server's batcher
+  guarantees every dispatch lands on a bucket boundary and the sample
+  count is padded to a fixed row count, so the engine compiles at most
+  one program per bucket: the O(log L) compiled-program bound of
+  ``data/batch.py`` holds under any request mix (``compiled_shapes``
+  counts the distinct signatures actually seen — the serving SLO the
+  chaos suite asserts).
+
+Params are swapped atomically under a lock (``swap_params``) — the hot
+checkpoint reload path; a dispatch reads the reference once, so
+in-flight requests always see one consistent weight set.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Sequence
+
+import jax
+import numpy as np
+
+from gnot_tpu.data.batch import (
+    Loader,
+    MeshSample,
+    bucket_length,
+    collate,
+    validate_samples,
+)
+
+
+class InferenceEngine:
+    """Validated, bucketed, statically-shaped batched forward.
+
+    ``forward(params, batch) -> [B, L, out]`` is the jitted forward; the
+    default wraps ``apply_batch`` (the same forward invocation training
+    uses). ``device_put`` places a host batch for the step (the
+    trainer's mesh sharding hook; identity when absent). ``n_proc`` /
+    ``p_idx`` / ``group_pad`` carry the multi-process predict()
+    discipline (see Trainer.predict's docstring) — serving runs are
+    single-process and leave them at defaults.
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        batch_size: int,
+        bucket: bool = True,
+        pad_nodes: int = 0,
+        pad_funcs: int = 0,
+        forward: Callable | None = None,
+        device_put: Callable | None = None,
+        group_pad: bool = False,
+        n_proc: int = 1,
+        p_idx: int = 0,
+    ):
+        self.model = model
+        self.batch_size = batch_size
+        self.bucket = bucket
+        self.pad_nodes = pad_nodes
+        self.pad_funcs = pad_funcs
+        self._device_put = device_put or (lambda b: b)
+        if forward is None:
+            from gnot_tpu.train.trainer import apply_batch
+
+            forward = jax.jit(lambda p, b: apply_batch(model, p, b))
+        self._forward = forward
+        self.group_pad = group_pad
+        self.n_proc = n_proc
+        self.p_idx = p_idx
+        self._params = params
+        self._lock = threading.Lock()
+        # Distinct (B, L, Lf) dispatch signatures — a host-side proxy
+        # for the number of XLA programs this engine forced. The chaos
+        # suite bounds it by the bucket count.
+        self._shapes: set[tuple] = set()
+
+    # -- params ------------------------------------------------------------
+
+    def swap_params(self, params) -> None:
+        """Atomically publish a new weight set (hot reload). In-flight
+        dispatches keep the reference they already read; the next
+        dispatch sees the new one. No request is ever dropped or served
+        a half-swapped tree."""
+        with self._lock:
+            self._params = params
+
+    @property
+    def params(self):
+        with self._lock:
+            return self._params
+
+    # -- validation / bucketing --------------------------------------------
+
+    def validate(self, samples: Sequence[MeshSample]) -> None:
+        """Reject oversize (vs fixed pads) and non-finite inputs with
+        the offending sample index (data.batch.validate_samples)."""
+        validate_samples(
+            samples, pad_nodes=self.pad_nodes, pad_funcs=self.pad_funcs
+        )
+
+    def bucket_key(self, sample: MeshSample) -> tuple[int, int]:
+        """The static pad-shape this sample's dispatch must use:
+        ``(pad_nodes, pad_funcs)``. Fixed trainer pads win (distributed
+        training captured dataset-wide maxima); otherwise the bucketed
+        (or exact, bucket=False) lengths. The batcher keys its queues
+        on this, so no batch ever mixes two buckets."""
+        n = sample.coords.shape[0]
+        f = max((fn.shape[0] for fn in sample.funcs), default=0)
+        if self.pad_nodes:
+            pn = self.pad_nodes
+        else:
+            pn = bucket_length(n) if self.bucket else n
+        if self.pad_funcs:
+            pf = self.pad_funcs
+        elif f:
+            pf = bucket_length(f) if self.bucket else f
+        else:
+            pf = 0
+        return pn, pf
+
+    @property
+    def compiled_shapes(self) -> int:
+        """Distinct dispatch shapes seen so far (compiled-program
+        bound proxy; one XLA program per entry)."""
+        return len(self._shapes)
+
+    # -- the serving hot path ----------------------------------------------
+
+    def infer(
+        self,
+        samples: Sequence[MeshSample],
+        *,
+        pad_nodes: int,
+        pad_funcs: int,
+        rows: int | None = None,
+    ) -> list[np.ndarray]:
+        """ONE dispatch at the fully static shape ``(rows, pad_nodes,
+        pad_funcs)``: short batches are padded to ``rows`` with repeats
+        of the last sample (dropped on return), so a bucket compiles
+        exactly one program no matter how full its flushes run.
+        Returns per-sample UNPADDED outputs ``[n_i, out]``. Callers
+        (the server) validate and bucket upstream."""
+        reqs = list(samples)
+        if not reqs:
+            return []
+        rows = rows or self.batch_size
+        if len(reqs) > rows:
+            raise ValueError(
+                f"infer() got {len(reqs)} samples for a {rows}-row dispatch"
+            )
+        batch = collate(
+            reqs + [reqs[-1]] * (rows - len(reqs)),
+            bucket=False,
+            pad_nodes=pad_nodes,
+            pad_funcs=pad_funcs,
+        )
+        self._note_shape(batch)
+        params = self.params  # one consistent weight set per dispatch
+        out = np.asarray(self._forward(params, self._device_put(batch)))
+        return [out[i, : s.coords.shape[0]] for i, s in enumerate(reqs)]
+
+    def _note_shape(self, batch) -> None:
+        self._shapes.add(
+            tuple(np.shape(l) for l in jax.tree.leaves(batch))
+        )
+
+    def warmup(
+        self, samples: Sequence[MeshSample], *, rows: int | None = None
+    ) -> int:
+        """Precompile one program per bucket present in ``samples``
+        (one real dispatch each, outputs discarded). Serving startup
+        calls this with representative traffic so the first live
+        request of a bucket pays milliseconds, not an XLA compile —
+        without it, a compile landing under tight deadlines sheds every
+        request queued behind it. Returns the number of buckets
+        warmed."""
+        seen: set[tuple[int, int]] = set()
+        for s in samples:
+            key = self.bucket_key(s)
+            if key in seen:
+                continue
+            seen.add(key)
+            self.infer([s], pad_nodes=key[0], pad_funcs=key[1], rows=rows)
+        return len(seen)
+
+    # -- the offline path (Trainer.predict semantics) ----------------------
+
+    def predict(self, samples: Sequence[MeshSample]) -> list[np.ndarray]:
+        """Per-sample unpadded model outputs ``[n_i, out_dim]`` for an
+        arbitrary sample list — the offline inference path
+        ``Trainer.predict`` delegates to (see its docstring for the
+        mesh / multi-process contract)."""
+        samples = list(samples)
+        self.validate(samples)
+        n_real = len(samples)
+        bs = self.batch_size
+        # One dispatch covers `group` sample rows: the global batch
+        # concatenates every host's bs-row slice in process order, so
+        # global row r of dispatch i is samples[i*group + r].
+        group = bs * self.n_proc if self.group_pad else bs
+        if self.group_pad and n_real % group:
+            samples = samples + [samples[-1]] * (group - n_real % group)
+        if self.n_proc > 1:
+            loader_samples = []
+            for i in range(0, len(samples), group):
+                loader_samples.extend(
+                    samples[i + self.p_idx * bs : i + (self.p_idx + 1) * bs]
+                )
+        else:
+            loader_samples = samples
+        loader = Loader(
+            loader_samples,
+            bs,
+            bucket=self.bucket,
+            pad_nodes=self.pad_nodes,
+            pad_funcs=self.pad_funcs,
+        )
+        params = self.params
+        outs: list[np.ndarray] = []
+        for bi, batch in enumerate(loader):
+            # Multi-process: device_put assembles the global batch from
+            # the per-host slices; the forward runs sharded and returns
+            # the replicated [group, L, out] prediction.
+            self._note_shape(batch)
+            out = np.asarray(self._forward(params, self._device_put(batch)))
+            for j in range(out.shape[0]):
+                idx = bi * group + j
+                outs.append(out[j, : samples[idx].coords.shape[0]])
+        return outs[:n_real]

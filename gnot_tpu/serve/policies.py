@@ -1,0 +1,143 @@
+"""Serving robustness policies as first-class, individually-tested
+objects: request deadlines, bounded-queue admission control, and a
+circuit breaker.
+
+Each is deterministic given an injectable ``clock`` (tests pass a fake
+monotonic clock; production uses ``time.monotonic``), holds no thread
+of its own, and decides ONE thing — the server composes them. The
+policy semantics are documented in docs/serving.md.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Callable
+
+
+@dataclasses.dataclass(frozen=True)
+class Deadline:
+    """An absolute monotonic expiry. Requests carry one (or None);
+    expired requests are shed BEFORE dispatch — compiled-forward time
+    is never spent on an answer nobody is waiting for — and the same
+    absolute time bounds downstream retries (resilience.retry)."""
+
+    at: float  # absolute clock() time
+
+    def expired(self, now: float) -> bool:
+        return now >= self.at
+
+    def remaining_s(self, now: float) -> float:
+        return max(0.0, self.at - now)
+
+
+class AdmissionController:
+    """Bounded-queue admission: at most ``limit`` requests in the
+    system (queued + batched + in dispatch). ``try_admit`` is the fast
+    path — a full queue fast-fails the caller in O(1) instead of
+    letting an overload storm grow an unbounded backlog whose every
+    entry then misses its deadline (shed at the door, not at the
+    dispatcher)."""
+
+    def __init__(self, limit: int):
+        if limit < 1:
+            raise ValueError(f"admission limit must be >= 1, got {limit}")
+        self.limit = limit
+        self._n = 0
+        self._lock = threading.Lock()
+
+    @property
+    def depth(self) -> int:
+        return self._n
+
+    def try_admit(self) -> bool:
+        with self._lock:
+            if self._n >= self.limit:
+                return False
+            self._n += 1
+            return True
+
+    def release(self) -> None:
+        """One admitted request left the system (completed or shed)."""
+        with self._lock:
+            if self._n <= 0:
+                raise RuntimeError("release() without a matching admit")
+            self._n -= 1
+
+
+class CircuitBreaker:
+    """Trips open after ``threshold`` consecutive dispatch failures
+    (non-finite outputs, device errors); while open, requests are
+    rejected instantly with a reason instead of queueing behind a sick
+    backend until they time out. After ``cooldown_s`` one trial
+    dispatch is allowed (half-open): success closes the breaker,
+    failure re-opens it for another cooldown.
+
+    States: ``closed`` (serving), ``open`` (rejecting),
+    ``half_open`` (one trial in flight). Thread-safe; the server emits
+    ``breaker_open`` / ``breaker_close`` events on transitions.
+    """
+
+    def __init__(
+        self,
+        *,
+        threshold: int = 3,
+        cooldown_s: float = 1.0,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        if threshold < 1:
+            raise ValueError(f"breaker threshold must be >= 1, got {threshold}")
+        self.threshold = threshold
+        self.cooldown_s = cooldown_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._failures = 0
+        self._opened_at = 0.0
+        self.trips = 0  # lifetime open transitions (serve_summary)
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    def allow(self) -> bool:
+        """May a dispatch proceed right now? Open -> False until the
+        cooldown elapses, then one half-open trial is admitted."""
+        with self._lock:
+            if self._state == "closed":
+                return True
+            if self._state == "open":
+                if self._clock() - self._opened_at >= self.cooldown_s:
+                    self._state = "half_open"
+                    return True
+                return False
+            # half_open: one trial at a time; further dispatches wait.
+            return False
+
+    def record_success(self) -> bool:
+        """Returns True when this success CLOSED a previously-open
+        breaker (the recovery transition, worth an event)."""
+        with self._lock:
+            recovered = self._state == "half_open"
+            self._state = "closed"
+            self._failures = 0
+            return recovered
+
+    def record_failure(self) -> bool:
+        """Returns True when this failure TRIPPED the breaker open
+        (threshold reached, or a half-open trial failed)."""
+        with self._lock:
+            self._failures += 1
+            should_open = (
+                self._state == "half_open"
+                or self._failures >= self.threshold
+            )
+            if should_open and self._state != "open":
+                self._state = "open"
+                self._opened_at = self._clock()
+                self.trips += 1
+                return True
+            if should_open:  # already open (counting extra failures)
+                self._opened_at = self._clock()
+            return False

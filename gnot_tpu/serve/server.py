@@ -1,0 +1,527 @@
+"""Fault-tolerant inference serving loop.
+
+``InferenceServer`` composes the pieces: requests enter through
+bounded-queue admission (``policies.AdmissionController``), wait in the
+per-bucket ``Batcher``, and are dispatched by one worker thread through
+the ``InferenceEngine`` at static bucket shapes. Robustness policies
+are applied in a fixed order at each dispatch:
+
+1. **deadline shed** — expired requests leave BEFORE dispatch;
+2. **circuit breaker** — open → instant "reject with reason" responses
+   (never a hang behind a sick backend); trips on repeated non-finite
+   outputs or device errors, recovers through a half-open trial;
+3. **forward** — one static-shape dispatch per bucket;
+4. **output finiteness** — non-finite outputs fail their requests and
+   feed the breaker (a sick chip must not serve NaNs as answers).
+
+Hot checkpoint reload (``reload()``) restores on the CALLER's thread —
+the worker keeps serving the old weights throughout — then publishes
+atomically via ``engine.swap_params``; the restore rides the
+``Checkpointer`` fallback chain with a deadline-clamped retry budget,
+so a corrupted ``latest`` degrades to an older checkpoint instead of
+killing the serving process. Graceful drain (``drain()``, or SIGTERM
+via ``resilience.preemption.PreemptionHandler``) stops admission,
+completes every in-flight request, and emits a ``serve_summary`` event.
+
+Every decision is observable: ``queue_depth`` / ``shed`` /
+``breaker_open`` / ``breaker_close`` / ``reload`` / ``serve_summary``
+events flow through the ordinary ``MetricsSink`` (schema in
+docs/serving.md), so serving runs leave the same JSONL/manifest trail
+training runs do.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable
+
+import numpy as np
+
+from gnot_tpu.data.batch import MeshSample
+from gnot_tpu.serve.batcher import Batcher
+from gnot_tpu.serve.engine import InferenceEngine
+from gnot_tpu.serve.policies import (
+    AdmissionController,
+    CircuitBreaker,
+    Deadline,
+)
+
+#: Terminal reasons a request can resolve with. "ok" carries an output;
+#: everything else is a degraded reject-with-reason response.
+REASONS = (
+    "ok",
+    "shed_deadline",
+    "shed_queue_full",
+    "rejected_breaker_open",
+    "rejected_invalid",
+    "rejected_draining",
+    "error_nan_output",
+    "error_dispatch",
+)
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """What a request's Future resolves to — ALWAYS, on every path; a
+    request is never left hanging."""
+
+    ok: bool
+    reason: str  # one of REASONS
+    output: np.ndarray | None = None  # [n_i, out_dim] when ok
+    detail: str = ""
+    latency_ms: float = 0.0
+
+
+@dataclasses.dataclass
+class _Request:
+    sample: MeshSample
+    future: Future
+    ordinal: int  # 1-indexed admission count (fault-injection key)
+    submitted: float
+    deadline: Deadline | None
+
+
+class InferenceServer:
+    """One worker thread draining a bounded request queue through the
+    engine. ``submit()`` is thread-safe and non-blocking (admission
+    fast-fails); results arrive via ``concurrent.futures.Future``.
+
+    ``reload_fn() -> (params, info) | None`` is the hot-reload source
+    (``CheckpointReloader`` wraps a ``Checkpointer``); ``faults`` is a
+    ``resilience.faults.FaultInjector`` with serve-side kinds armed.
+    ``preempt`` is a ``PreemptionHandler`` whose triggered flag the
+    worker polls — SIGTERM therefore drains gracefully instead of
+    killing in-flight requests.
+    """
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        *,
+        max_batch: int = 4,
+        max_wait_ms: float = 10.0,
+        queue_limit: int = 64,
+        default_deadline_ms: float = 0.0,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 1.0,
+        sink=None,
+        reload_fn: Callable | None = None,
+        faults=None,
+        preempt=None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.engine = engine
+        self.sink = sink
+        self.reload_fn = reload_fn
+        self.faults = faults
+        self.preempt = preempt
+        self._clock = clock
+        self.default_deadline_ms = default_deadline_ms
+        self.max_batch = max_batch
+        self.admission = AdmissionController(queue_limit)
+        self.breaker = CircuitBreaker(
+            threshold=breaker_threshold,
+            cooldown_s=breaker_cooldown_s,
+            clock=clock,
+        )
+        self.batcher = Batcher(
+            max_batch=max_batch,
+            max_wait_ms=max_wait_ms,
+            key_fn=lambda r: engine.bucket_key(r.sample),
+        )
+        self._inbound: queue.Queue = queue.Queue()
+        self._lock = threading.Lock()  # counters + admission ordinal
+        self._worker: threading.Thread | None = None
+        self._draining = threading.Event()
+        self._drained = threading.Event()
+        # Counters for serve_summary.
+        self._submitted = 0
+        self._admitted = 0
+        self._completed = 0
+        self._shed: dict[str, int] = {}
+        self._dispatches = 0
+        self._reloads = 0
+        self._latencies_ms: list[float] = []
+
+    # -- client side -------------------------------------------------------
+
+    def start(self) -> "InferenceServer":
+        if self._worker is not None:
+            raise RuntimeError("server already started")
+        self._worker = threading.Thread(
+            target=self._run, name="gnot-serve-worker", daemon=True
+        )
+        self._worker.start()
+        return self
+
+    def submit(
+        self, sample: MeshSample, *, deadline_ms: float | None = None
+    ) -> Future:
+        """Admit one request. Fast-fails (resolved Future, degraded
+        reason) on: draining, full queue (load shedding at the door),
+        or invalid input (non-finite / oversize — validated HERE so a
+        poison sample is rejected with its index named instead of
+        NaN-ing a whole batch of innocent neighbors)."""
+        fut: Future = Future()
+        now = self._clock()
+        with self._lock:
+            self._submitted += 1
+        if self._draining.is_set():
+            return self._resolve_now(fut, "rejected_draining", now)
+        try:
+            self.engine.validate([sample])
+        except ValueError as err:
+            self._event("shed", reason="rejected_invalid", detail=str(err))
+            return self._resolve_now(
+                fut, "rejected_invalid", now, detail=str(err)
+            )
+        if not self.admission.try_admit():
+            self._count_shed("shed_queue_full")
+            self._event(
+                "shed",
+                reason="shed_queue_full",
+                depth=self.admission.depth,
+                limit=self.admission.limit,
+            )
+            fut.set_result(
+                ServeResult(ok=False, reason="shed_queue_full")
+            )
+            return fut
+        # An explicit per-request 0 means "no deadline", same as the
+        # config convention (ServeConfig.deadline_ms).
+        ms = deadline_ms if deadline_ms is not None else self.default_deadline_ms
+        ms = ms or None
+        # Enqueue under the SAME lock drain() sets the flag under: a
+        # put serialized before the flag flips is visible to the
+        # worker's final sweep, and a submit serialized after it is
+        # rejected here — no request can ever strand in the queue with
+        # nothing left to consume it.
+        raced_shutdown = False
+        with self._lock:
+            if self._draining.is_set():
+                raced_shutdown = True
+            else:
+                self._admitted += 1
+                req = _Request(
+                    sample=sample,
+                    future=fut,
+                    ordinal=self._admitted,
+                    submitted=now,
+                    deadline=(
+                        Deadline(now + ms / 1e3) if ms is not None else None
+                    ),
+                )
+                self._inbound.put(req)
+        if raced_shutdown:
+            self.admission.release()
+            return self._resolve_now(fut, "rejected_draining", now)
+        return fut
+
+    def reload(self, *, deadline_ms: float = 0.0) -> bool:
+        """Hot-swap weights from the reload source (synchronous, on the
+        CALLER's thread — the worker keeps serving old weights
+        meanwhile). Atomic publish via ``engine.swap_params``; a failed
+        or exhausted restore leaves the old weights serving and returns
+        False. Emits a ``reload`` event either way."""
+        if self.reload_fn is None:
+            raise RuntimeError("no reload source configured")
+        with self._lock:
+            self._reloads += 1
+            ordinal = self._reloads
+        t0 = self._clock()
+        if self.faults is not None and hasattr(self.reload_fn, "directory"):
+            self.faults.maybe_reload_corrupt(ordinal, self.reload_fn.directory)
+        info: dict = {}
+        params = None
+        try:
+            out = self.reload_fn(deadline_ms=deadline_ms or None)
+            if out is not None:
+                params, info = out
+        except Exception as err:  # noqa: BLE001 — serving must outlive reloads
+            info = {"error": f"{type(err).__name__}: {err}"}
+        ok = params is not None
+        if ok:
+            self.engine.swap_params(params)
+        self._event(
+            "reload",
+            ok=ok,
+            reload=ordinal,
+            duration_ms=(self._clock() - t0) * 1e3,
+            **info,
+        )
+        return ok
+
+    def drain(self, timeout_s: float = 30.0) -> dict:
+        """Graceful shutdown: stop admitting, flush every queued
+        request through dispatch (deadline shedding still applies),
+        join the worker, emit ``serve_summary``. Returns the summary
+        dict. Idempotent."""
+        with self._lock:  # serialized against submit()'s enqueue
+            self._draining.set()
+        if self._worker is not None:
+            self._inbound.put(None)  # wake the worker
+            self._worker.join(timeout=timeout_s)
+            if self._worker.is_alive():
+                # A dispatch is stuck past the drain budget (wedged
+                # device, runaway compile). The worker still owns the
+                # batcher/queue — sweeping them from here would race it
+                # (double-finish, concurrent Batcher mutation); report
+                # and return what we have instead.
+                self._event("drain_timeout", timeout_s=timeout_s)
+                return self._summary(emit=not self._drained.is_set())
+        # The worker has exited (or never ran): resolve anything still
+        # queued or batched — a request must NEVER be left hanging.
+        try:
+            while True:
+                item = self._inbound.get_nowait()
+                if item is not None:
+                    self._finish(
+                        item, ServeResult(ok=False, reason="rejected_draining")
+                    )
+                    self._count_shed("rejected_draining")
+        except queue.Empty:
+            pass
+        for r in list(self.batcher.requests()):
+            self._finish(
+                r, ServeResult(ok=False, reason="rejected_draining")
+            )
+            self._count_shed("rejected_draining")
+        if not self._drained.is_set():
+            self._drained.set()
+            return self._summary(emit=True)
+        return self._summary(emit=False)
+
+    # -- worker side -------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            now = self._clock()
+            if self.preempt is not None and self.preempt.triggered:
+                self._draining.set()
+            draining = self._draining.is_set()
+            if draining:
+                timeout = 0.0
+            else:
+                # Cap the idle block at 100 ms so the preemption flag
+                # (SIGTERM) is polled even when no flush is due.
+                timeout = self.batcher.next_flush_in(now)
+                timeout = 0.1 if timeout is None else min(timeout, 0.1)
+            try:
+                item = self._inbound.get(timeout=timeout)
+                if item is not None:
+                    self.batcher.add(item, self._clock())
+            except queue.Empty:
+                pass
+            # Absorb the rest of the burst WITHOUT blocking, then fall
+            # through to the flush check every iteration — a sustained
+            # storm must not starve dispatch behind an always-non-empty
+            # inbound queue.
+            try:
+                while True:
+                    item = self._inbound.get_nowait()
+                    if item is not None:
+                        self.batcher.add(item, self._clock())
+            except queue.Empty:
+                pass
+            now = self._clock()
+            for key, reqs in self.batcher.pop_ready(
+                now, flush_all=self._draining.is_set()
+            ):
+                self._dispatch(key, reqs)
+            if (
+                self._draining.is_set()
+                and len(self.batcher) == 0
+                and self._inbound.empty()
+            ):
+                return
+
+    def _dispatch(self, key, reqs: list[_Request]) -> None:
+        pn, pf = key
+        # Injected straggler: stall until the victim's deadline passes
+        # (deterministic head-of-line blocking — docs/serving.md).
+        if self.faults is not None:
+            for r in reqs:
+                if self.faults.maybe_slow_request(r.ordinal):
+                    stall = (
+                        r.deadline.remaining_s(self._clock()) + 1e-3
+                        if r.deadline is not None
+                        else 0.01
+                    )
+                    time.sleep(stall)
+        now = self._clock()
+        live: list[_Request] = []
+        for r in reqs:
+            if r.deadline is not None and r.deadline.expired(now):
+                self._finish(r, ServeResult(ok=False, reason="shed_deadline"))
+                self._count_shed("shed_deadline")
+                self._event(
+                    "shed", reason="shed_deadline", ordinal=r.ordinal,
+                    waited_ms=(now - r.submitted) * 1e3,
+                )
+            else:
+                live.append(r)
+        if not live:
+            return
+        if not self.breaker.allow():
+            for r in live:
+                self._finish(
+                    r,
+                    ServeResult(
+                        ok=False,
+                        reason="rejected_breaker_open",
+                        detail="circuit breaker open (backend unhealthy)",
+                    ),
+                )
+            self._count_shed("rejected_breaker_open", n=len(live))
+            self._event(
+                "shed", reason="rejected_breaker_open", n=len(live)
+            )
+            return
+        with self._lock:
+            self._dispatches += 1
+            dispatch = self._dispatches
+        self._event(
+            "queue_depth",
+            depth=self.admission.depth,
+            batched=len(self.batcher),
+            dispatch=dispatch,
+            bucket_nodes=pn,
+            bucket_funcs=pf,
+            n=len(live),
+        )
+        try:
+            outs = self.engine.infer(
+                [r.sample for r in live],
+                pad_nodes=pn,
+                pad_funcs=pf,
+                rows=self.max_batch,
+            )
+        except Exception as err:  # noqa: BLE001 — device errors feed the breaker
+            self._fail_dispatch(
+                live, "error_dispatch", f"{type(err).__name__}: {err}"
+            )
+            return
+        if self.faults is not None and self.faults.maybe_nan_output(dispatch):
+            outs = [np.full_like(o, np.nan) for o in outs]
+        bad = [
+            i for i, o in enumerate(outs) if not np.all(np.isfinite(o))
+        ]
+        if bad:
+            self._fail_dispatch(
+                live,
+                "error_nan_output",
+                f"non-finite outputs for {len(bad)}/{len(live)} "
+                f"requests in dispatch {dispatch}",
+            )
+            return
+        if self.breaker.record_success():
+            self._event("breaker_close", state="closed")
+        done = self._clock()
+        for r, o in zip(live, outs):
+            lat = (done - r.submitted) * 1e3
+            self._latencies_ms.append(lat)
+            self._finish(
+                r,
+                ServeResult(ok=True, reason="ok", output=o, latency_ms=lat),
+            )
+            with self._lock:
+                self._completed += 1
+
+    def _fail_dispatch(self, reqs, reason: str, detail: str) -> None:
+        """A whole-dispatch failure: every rider gets a degraded
+        response NOW (no hang, no retry queue growth) and the breaker
+        counts one failure."""
+        for r in reqs:
+            self._finish(r, ServeResult(ok=False, reason=reason, detail=detail))
+        self._count_shed(reason, n=len(reqs))
+        if self.breaker.record_failure():
+            self._event(
+                "breaker_open",
+                state="open",
+                reason=reason,
+                detail=detail,
+                trips=self.breaker.trips,
+            )
+
+    # -- bookkeeping -------------------------------------------------------
+
+    def _finish(self, req: _Request, result: ServeResult) -> None:
+        self.admission.release()
+        if not req.future.done():
+            req.future.set_result(result)
+
+    def _resolve_now(
+        self, fut: Future, reason: str, now: float, *, detail: str = ""
+    ) -> Future:
+        self._count_shed(reason)
+        fut.set_result(ServeResult(ok=False, reason=reason, detail=detail))
+        return fut
+
+    def _count_shed(self, reason: str, n: int = 1) -> None:
+        with self._lock:
+            self._shed[reason] = self._shed.get(reason, 0) + n
+
+    def _event(self, event: str, **fields) -> None:
+        if self.sink is not None:
+            self.sink.log(event=event, **fields)
+
+    def _summary(self, *, emit: bool) -> dict:
+        lat = np.asarray(self._latencies_ms, dtype=np.float64)
+        summary = {
+            "requests": self._submitted,
+            "admitted": self._admitted,
+            "completed": self._completed,
+            "shed": dict(self._shed),
+            "dispatches": self._dispatches,
+            "reloads": self._reloads,
+            "breaker_trips": self.breaker.trips,
+            "compiled_shapes": self.engine.compiled_shapes,
+            "latency_p50_ms": float(np.percentile(lat, 50)) if lat.size else None,
+            "latency_p99_ms": float(np.percentile(lat, 99)) if lat.size else None,
+        }
+        if emit:
+            self._event("serve_summary", **summary)
+            if self.sink is not None:
+                self.sink.flush()
+        return summary
+
+
+class CheckpointReloader:
+    """The hot-reload source wrapping a ``train.checkpoint.Checkpointer``:
+    restores ``latest`` (walking the full fallback chain — a corrupted
+    dir degrades to an older checkpoint, loudly) into a template state
+    and returns its params. The caller's ``deadline_ms`` clamps the
+    restore's retry backoff (resilience.retry), so a reload against
+    flaky storage never stalls serving past its budget.
+
+    ``template`` is a TrainState (or params-bearing pytree) with the
+    target structure — typically the trainer's live state.
+    """
+
+    def __init__(self, checkpointer, template):
+        self.checkpointer = checkpointer
+        self.template = template
+
+    @property
+    def directory(self) -> str:
+        return self.checkpointer.directory
+
+    def __call__(self, *, deadline_ms: float | None = None):
+        deadline = (
+            time.monotonic() + deadline_ms / 1e3
+            if deadline_ms is not None
+            else None
+        )
+        out = self.checkpointer.restore_latest(
+            self.template, deadline=deadline
+        )
+        if out is None:
+            return None
+        state, epoch, best_metric = out
+        info = dict(self.checkpointer.last_restore or {})
+        info.update(epoch=epoch, best_metric=best_metric)
+        return getattr(state, "params", state), info

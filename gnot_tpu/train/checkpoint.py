@@ -93,11 +93,14 @@ class Checkpointer:
 
     # -- hardened I/O ------------------------------------------------------
 
-    def _io(self, op: str, fn):
+    def _io(self, op: str, fn, *, deadline: float | None = None):
         """Run one checkpoint-I/O operation under the retry policy.
         The fault injector (when armed) fires INSIDE the retried
         attempt, so injected transient errors exercise the same
-        backoff path real ones do."""
+        backoff path real ones do. ``deadline`` (absolute
+        ``time.monotonic``) clamps the backoff sleeps so a
+        deadline-bounded caller — a serving hot reload mid-traffic —
+        never has its retries outlive it."""
 
         def attempt():
             if self.fault_injector is not None:
@@ -111,7 +114,8 @@ class Checkpointer:
                 )
 
         return retry_io(
-            attempt, policy=self.retry_policy, describe=op, on_retry=note
+            attempt, policy=self.retry_policy, describe=op, on_retry=note,
+            deadline=deadline,
         )
 
     # -- commit protocol ---------------------------------------------------
@@ -274,7 +278,14 @@ class Checkpointer:
             )
         return cands
 
-    def _restore(self, name: str, target: Any, *, requested: str | None = None):
+    def _restore(
+        self,
+        name: str,
+        target: Any,
+        *,
+        requested: str | None = None,
+        deadline: float | None = None,
+    ):
         """Walk the candidate chain; the first directory orbax can
         restore (under the transient-error retry policy) wins. Records
         WHICH checkpoint restored in ``last_restore`` / the log / the
@@ -282,8 +293,9 @@ class Checkpointer:
         exists to remove. ``requested`` names the checkpoint the CALLER
         asked for when this walk is already a fallback (restore_latest
         walking on to 'best'), so exactly ONE restore/restore_fallback
-        event describes the whole restore. Returns (state, epoch,
-        best_metric) or None when no candidate is restorable."""
+        event describes the whole restore. ``deadline`` bounds each
+        attempt's retry backoff (resilience.retry). Returns (state,
+        epoch, best_metric) or None when no candidate is restorable."""
         requested = requested or name
         self.wait()
         multiproc = jax.process_count() > 1
@@ -317,6 +329,7 @@ class Checkpointer:
                 state = self._io(
                     f"restore:{name}",
                     lambda p=path: self._ckptr.restore(p, target),
+                    deadline=deadline,
                 )
             except Exception as exc:  # noqa: BLE001 — any restore failure
                 if layout_conflict:
@@ -453,18 +466,22 @@ class Checkpointer:
                 )
         return layout_mismatch is not None
 
-    def restore_latest(self, target: Any):
+    def restore_latest(self, target: Any, *, deadline: float | None = None):
         """Returns (state, epoch, best_metric) or None. Prefers the
         periodic ``latest`` checkpoint (walking its fallback chain),
         then falls back to ``best`` — LOUDLY: which checkpoint actually
         restored is printed, recorded in ``last_restore`` (the manifest
         field), and emitted as a ``restore_fallback`` event, because a
         run silently restarting from ``best`` instead of ``latest``
-        replays epochs the operator thinks are done."""
-        out = self._restore("latest", target)
+        replays epochs the operator thinks are done. ``deadline``
+        (absolute ``time.monotonic``) clamps the retry backoff of each
+        I/O attempt — the serving hot-reload path's budget."""
+        out = self._restore("latest", target, deadline=deadline)
         if out is not None:
             return out
-        out = self._restore("best", target, requested="latest")
+        out = self._restore(
+            "best", target, requested="latest", deadline=deadline
+        )
         if out is not None and jax.process_index() == 0:
             print(
                 "note: no restorable 'latest' checkpoint — resumed "
@@ -473,5 +490,5 @@ class Checkpointer:
             )
         return out
 
-    def restore_best(self, target: Any):
-        return self._restore("best", target)
+    def restore_best(self, target: Any, *, deadline: float | None = None):
+        return self._restore("best", target, deadline=deadline)

@@ -663,6 +663,7 @@ class Trainer:
         self._tail_eval_step = None
         self.state: TrainState | None = None
         self._forward = None  # jitted inference fn, built on first predict()
+        self._engine = None  # serve.InferenceEngine, built on first predict()
         self.best_metric = float("inf")
         self.start_epoch = 0
         # Host-side mirror of state.step: reading the device counter every
@@ -937,6 +938,16 @@ class Trainer:
         the tail batch is filled with repeats of the last sample so
         every batch shards evenly; the repeats are dropped on return.
 
+        Inputs are validated up front (``data.batch.validate_samples``):
+        oversize meshes against the trainer's fixed pad lengths AND
+        non-finite coords/theta/targets/input-function values are
+        rejected with the offending sample index — a NaN query must
+        fail loudly, not poison its padded batchmates.
+
+        The mechanics (validation, bucketed collate, forward, unpad
+        slicing) live in ``serve.InferenceEngine`` — the SAME code path
+        the request-serving layer dispatches through (docs/serving.md).
+
         Multi-process runs: the forward runs SHARDED on the mesh —
         params stay in their mesh layout (no host-side
         ``process_allgather``, which would not scale past toy sizes);
@@ -945,9 +956,23 @@ class Trainer:
         samples: each host feeds its contiguous slice of every global
         batch and every process returns the full predictions.
         """
+        return self.inference_engine().predict(samples)
+
+    def inference_engine(self):
+        """The trainer's ``serve.InferenceEngine`` over its CURRENT
+        params: layout-aware jitted forward (flat / stacked / standard,
+        mesh-replicated outputs), the training data's fixed pad
+        lengths, and the mesh batch-placement hook. Built once; params
+        are re-published on every call so post-fit/restore weights are
+        always what serves."""
         multiproc = jax.process_count() > 1
         if self.state is None:
             self.initialize()
+        if multiproc and self.mesh is None:
+            raise ValueError(
+                "multi-process predict() requires the distributed "
+                "trainer (a mesh) — run with --distributed"
+            )
         if self._forward is None:
             model = self.model
             if self._flat:
@@ -979,68 +1004,25 @@ class Trainer:
                 )
             else:
                 self._forward = jax.jit(fwd)
-        forward = self._forward
-        params = self.state.params
+        if self._engine is None:
+            from gnot_tpu.serve.engine import InferenceEngine
 
-        samples = list(samples)
-        n_real = len(samples)
-        bs = self.config.data.batch_size
-        # Fixed pad lengths were captured from the training data; an
-        # unseen longer mesh cannot be packed into them — fail with the
-        # limit instead of a cryptic broadcast error from the packer.
-        pn, pf = self.train_loader.pad_nodes, self.train_loader.pad_funcs
-        for i, s in enumerate(samples):
-            if pn and s.coords.shape[0] > pn:
-                raise ValueError(
-                    f"predict sample {i} has {s.coords.shape[0]} mesh points "
-                    f"but this trainer's fixed pad length is {pn} (set from "
-                    "the training data); rebuild with larger pad_nodes"
-                )
-            if pf:
-                for j, f in enumerate(s.funcs):
-                    if f.shape[0] > pf:
-                        raise ValueError(
-                            f"predict sample {i} input function {j} has "
-                            f"{f.shape[0]} points but the fixed pad length "
-                            f"is {pf}; rebuild with larger pad_funcs"
-                        )
-        nproc = jax.process_count()
-        if multiproc and self.mesh is None:
-            raise ValueError(
-                "multi-process predict() requires the distributed "
-                "trainer (a mesh) — run with --distributed"
+            self._engine = InferenceEngine(
+                self.model,
+                self.state.params,
+                batch_size=self.config.data.batch_size,
+                bucket=self.config.data.bucket,
+                pad_nodes=self.train_loader.pad_nodes,
+                pad_funcs=self.train_loader.pad_funcs,
+                forward=self._forward,
+                device_put=self._device_batch,
+                group_pad=self.mesh is not None,
+                n_proc=jax.process_count(),
+                p_idx=jax.process_index(),
             )
-        # One dispatch covers `group` sample rows: the global batch
-        # concatenates every host's bs-row slice in process order, so
-        # global row r of dispatch i is samples[i*group + r].
-        group = bs * nproc if self.mesh is not None else bs
-        if self.mesh is not None and n_real % group:
-            samples = samples + [samples[-1]] * (group - n_real % group)
-        if multiproc:
-            p_idx = jax.process_index()
-            loader_samples = []
-            for i in range(0, len(samples), group):
-                loader_samples.extend(samples[i + p_idx * bs : i + (p_idx + 1) * bs])
         else:
-            loader_samples = samples
-        loader = Loader(
-            loader_samples,
-            bs,
-            bucket=self.config.data.bucket,
-            pad_nodes=self.train_loader.pad_nodes,
-            pad_funcs=self.train_loader.pad_funcs,
-        )
-        outs: list[np.ndarray] = []
-        for bi, batch in enumerate(loader):
-            # Multi-process: _device_batch assembles the global batch
-            # from the per-host slices; the forward runs sharded and
-            # returns the replicated [group, L, out] prediction.
-            db = self._device_batch(batch)
-            out = np.asarray(forward(params, db))
-            for j in range(out.shape[0]):
-                idx = bi * group + j
-                outs.append(out[j, : samples[idx].coords.shape[0]])
-        return outs[:n_real]
+            self._engine.swap_params(self.state.params)
+        return self._engine
 
     def evaluate_from_checkpoint(self) -> float:
         """Restore the best checkpoint and run eval only — the load path

@@ -52,6 +52,72 @@ def test_bucketing_bounds_recompiles():
         assert bucket_length(n) >= n
 
 
+def test_bucket_length_invariants():
+    """The serving layer's compiled-program bound rests on these
+    (docs/serving.md): monotone (a longer mesh never gets a shorter
+    bucket), idempotent on bucket boundaries (bucketing a bucket is a
+    no-op — so re-bucketing collated data can't drift shapes), and the
+    min_size floor absorbs every tiny mesh into ONE shape."""
+    prev = 0
+    for n in range(1, 5000):
+        b = bucket_length(n)
+        assert b >= n  # never truncates
+        assert b >= prev  # monotone in n
+        prev = b
+    # Idempotent on boundaries: every emitted bucket maps to itself.
+    for n in range(1, 5000, 7):
+        b = bucket_length(n)
+        assert bucket_length(b) == b
+    # min_size floor: everything at-or-below min_size shares one bucket.
+    for n in range(1, 65):
+        assert bucket_length(n) == 64
+    assert bucket_length(1, min_size=16) == 16
+    assert bucket_length(17, min_size=16) == 24  # 16 * 1.5 mantissa step
+    # Bucket count over a full range stays O(log L): ~2 per octave.
+    distinct = {bucket_length(n) for n in range(1, 65537)}
+    import math
+
+    assert len(distinct) <= 2 * (int(math.log2(65536 / 64)) + 1)
+
+
+def test_validate_samples_names_offender():
+    """validate_samples (shared by Trainer.predict and the serving
+    engine) rejects oversize and non-finite inputs naming the sample
+    index and field."""
+    from gnot_tpu.data.batch import validate_samples
+
+    def mk(n=8, m=4):
+        return MeshSample(
+            coords=np.zeros((n, 2), np.float32),
+            y=np.zeros((n, 1), np.float32),
+            theta=np.zeros((1,), np.float32),
+            funcs=(np.zeros((m, 3), np.float32),),
+        )
+
+    good = mk()
+    validate_samples([good, mk()])  # clean inputs pass
+    big = mk(n=32)
+    with pytest.raises(ValueError, match="sample 1.*fixed pad length"):
+        validate_samples([good, big], pad_nodes=16)
+    bigf = mk(m=64)
+    with pytest.raises(ValueError, match="sample 1 input function 0"):
+        validate_samples([good, bigf], pad_nodes=64, pad_funcs=16)
+    for field, poison in (
+        ("coordinates", lambda s: s.coords.__setitem__((0, 0), np.nan)),
+        ("theta", lambda s: s.theta.__setitem__(0, np.inf)),
+        ("target", lambda s: s.y.__setitem__((1, 0), np.nan)),
+        ("input function", lambda s: s.funcs[0].__setitem__((2, 1), np.nan)),
+    ):
+        bad = mk()
+        poison(bad)
+        with pytest.raises(ValueError, match=f"sample 2.*{field}"):
+            validate_samples([good, mk(), bad])
+    # check_finite=False restores the old shape-only behavior.
+    bad = mk()
+    bad.coords[0, 0] = np.nan
+    validate_samples([bad], check_finite=False)
+
+
 def test_pad_rows_noop_when_equal():
     x = np.ones((4, 2), np.float32)
     assert pad_rows(x, 4) is x

@@ -135,6 +135,73 @@ def test_retry_io_backoff_and_final_raise():
     assert calls == ["m"]
 
 
+def test_retry_io_deadline_clamps_sleeps_and_stops():
+    """ISSUE 3 satellite: with a `deadline`, backoff sleeps are clamped
+    to the remaining budget and a retry never starts past it — the
+    retry loop cannot outlive its caller (a serving request, a hot
+    reload)."""
+    clk = [0.0]
+    slept = []
+
+    def sleep(s):
+        slept.append(s)
+        clk[0] += s
+
+    def always():
+        clk[0] += 0.05  # each attempt costs wall time too
+        raise OSError("down")
+
+    # Budget 0.4 s against 10 s base delays: every sleep is clamped to
+    # the remaining budget and the loop gives up at the deadline — with
+    # attempts=6 and no deadline this would sleep minutes.
+    with pytest.raises(OSError, match="down"):
+        retry_io(
+            always,
+            policy=RetryPolicy(attempts=6, base_delay_s=10.0, max_delay_s=10.0),
+            sleep=sleep, deadline=0.4, clock=lambda: clk[0],
+        )
+    assert slept, "should have retried at least once before the deadline"
+    assert all(s <= 0.4 for s in slept)
+    assert clk[0] <= 0.4 + 0.05 + 1e-9  # overshoot bounded by one attempt
+
+    # An already-expired deadline: the first failure is final (no sleep).
+    slept.clear()
+    with pytest.raises(OSError):
+        retry_io(
+            always,
+            policy=RetryPolicy(attempts=6, base_delay_s=10.0),
+            sleep=sleep, deadline=0.0, clock=lambda: clk[0],
+        )
+    assert slept == []
+
+
+def test_checkpoint_restore_honors_deadline(tmp_path):
+    """The checkpoint-restore call sites pass the caller's deadline
+    through to the retry loop: a restore against injected flaky I/O
+    with 30 s backoff completes within the (sub-second) budget instead
+    of sitting through the schedule."""
+    import time as _time
+
+    from gnot_tpu.resilience.faults import FaultInjector
+
+    cfg, mc, train, test = tiny_setup(epochs=1)
+    ck = Checkpointer(str(tmp_path / "ck"))
+    t = Trainer(cfg, mc, train, test, checkpointer=ck)
+    t.initialize()
+    ck.save_latest(t.state, 1, 0.5)
+    ck.wait()
+    flaky = Checkpointer(
+        str(tmp_path / "ck"),
+        fault_injector=FaultInjector.from_spec("ckpt_io@1"),
+        retry_policy=RetryPolicy(attempts=4, base_delay_s=30.0),
+    )
+    t0 = _time.monotonic()
+    out = flaky.restore_latest(t.state, deadline=_time.monotonic() + 0.3)
+    elapsed = _time.monotonic() - t0
+    assert out is not None  # restored once the injected budget drained
+    assert elapsed < 10.0  # NOT the 30-60 s the un-clamped backoff takes
+
+
 # --- NaN / bad-sample recovery --------------------------------------------
 
 

@@ -1,0 +1,534 @@
+"""Chaos suite for the inference-serving subsystem (gnot_tpu/serve/).
+
+ISSUE 3 acceptance: on CPU, with deterministic fault injection, the
+server demonstrates deadline shedding, queue-overflow fast-fail,
+circuit-breaker trip + recovery, graceful drain completing in-flight
+requests, and a hot reload that survives a corrupted checkpoint dir by
+falling back — each asserted via MetricsSink events — with no
+mixed-bucket batches and a compiled-program count bounded by the
+bucket count (O(log L_max)) under a mixed small/large request storm.
+
+Fast scenarios run in tier-1; the long storm carries ``-m slow``.
+"""
+
+import json
+import math
+import os
+import signal
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from gnot_tpu.config import ModelConfig, make_config
+from gnot_tpu.data import datasets
+from gnot_tpu.data.batch import MeshSample, bucket_length, collate
+from gnot_tpu.models.gnot import GNOT
+from gnot_tpu.resilience.faults import FaultInjector
+from gnot_tpu.resilience.preemption import PreemptionHandler
+from gnot_tpu.serve import (
+    AdmissionController,
+    Batcher,
+    CheckpointReloader,
+    CircuitBreaker,
+    InferenceEngine,
+    InferenceServer,
+)
+from gnot_tpu.train.trainer import init_params
+from gnot_tpu.utils.metrics import MetricsSink
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools")
+)
+
+MAX_BATCH = 2  # one compiled (rows, L, Lf) shape shared by every test
+
+
+def read_events(path):
+    return [
+        r
+        for r in (json.loads(l) for l in open(path))
+        if r.get("event")
+    ]
+
+
+@pytest.fixture(scope="module")
+def setup():
+    """Tiny model + params + 64-point Darcy traffic; the shared
+    engine's (2, 64, 64) program compiles once for the whole module."""
+    samples = datasets.synth_darcy2d(12, seed=0, grid_n=8)
+    mc = ModelConfig(
+        n_attn_layers=1, n_attn_hidden_dim=16, n_mlp_num_layers=1,
+        n_mlp_hidden_dim=16, n_input_hidden_dim=16, n_expert=2, n_head=2,
+        **datasets.infer_model_dims(samples),
+    )
+    model = GNOT(mc)
+    params = init_params(model, collate(samples[:4]), 0)
+    engine = InferenceEngine(model, params, batch_size=MAX_BATCH)
+    engine.warmup(samples[:1], rows=MAX_BATCH)
+    return model, params, samples, engine
+
+
+def make_server(setup, tmp_path, **kw):
+    """Server over the module's shared warmed engine (tests that swap
+    weights pass their own via ``engine=``)."""
+    engine = kw.pop("engine", None) or setup[3]
+    sink = MetricsSink(str(tmp_path / "serve.jsonl"))
+    server = InferenceServer(
+        engine,
+        max_batch=MAX_BATCH,
+        max_wait_ms=kw.pop("max_wait_ms", 5.0),
+        sink=sink,
+        **kw,
+    )
+    return server, sink, str(tmp_path / "serve.jsonl")
+
+
+# --- policy objects -------------------------------------------------------
+
+
+def test_admission_controller_bounds_and_releases():
+    adm = AdmissionController(2)
+    assert adm.try_admit() and adm.try_admit()
+    assert not adm.try_admit()  # full -> fast-fail
+    adm.release()
+    assert adm.try_admit()
+    with pytest.raises(ValueError):
+        AdmissionController(0)
+
+
+def test_circuit_breaker_trip_halfopen_recovery():
+    clk = [0.0]
+    cb = CircuitBreaker(threshold=2, cooldown_s=1.0, clock=lambda: clk[0])
+    assert cb.allow() and cb.state == "closed"
+    assert not cb.record_failure()
+    assert cb.record_failure()  # threshold reached -> tripped
+    assert cb.state == "open" and cb.trips == 1
+    assert not cb.allow()  # still cooling
+    clk[0] = 1.5
+    assert cb.allow() and cb.state == "half_open"
+    assert not cb.allow()  # one trial at a time
+    assert cb.record_success()  # trial passed -> recovered
+    assert cb.state == "closed"
+    # A failed trial re-opens immediately.
+    cb.record_failure()
+    cb.record_failure()
+    clk[0] = 3.0
+    assert cb.allow()
+    assert cb.record_failure()  # half-open trial failed
+    assert cb.state == "open" and cb.trips == 3
+
+
+# --- batcher --------------------------------------------------------------
+
+
+def test_batcher_never_mixes_buckets():
+    """THE invariant: a flushed batch holds requests from exactly one
+    bucket, whatever the arrival interleaving."""
+    b = Batcher(max_batch=3, max_wait_ms=50, key_fn=lambda r: r[0])
+    rng = np.random.default_rng(0)
+    keys = [("k", int(k)) for k in rng.integers(0, 4, size=40)]
+    for i, k in enumerate(keys):
+        b.add((k, i), now=0.001 * i)
+    batches = b.pop_ready(1.0, flush_all=True)
+    assert sum(len(reqs) for _, reqs in batches) == 40
+    for key, reqs in batches:
+        assert len(reqs) <= 3
+        assert {r[0] for r in reqs} == {key}
+
+
+def test_batcher_flush_on_size_and_age():
+    b = Batcher(max_batch=2, max_wait_ms=100, key_fn=lambda r: r[0])
+    b.add(("a", 1), now=0.0)
+    assert b.pop_ready(0.01) == []  # neither full nor aged
+    b.add(("a", 2), now=0.02)
+    [(key, reqs)] = b.pop_ready(0.03)  # full -> immediate
+    assert key == "a" and len(reqs) == 2
+    b.add(("b", 3), now=0.0)
+    assert b.pop_ready(0.05) == []
+    assert b.next_flush_in(0.05) == pytest.approx(0.05)
+    [(key, reqs)] = b.pop_ready(0.11)  # aged -> partial flush
+    assert key == "b" and len(reqs) == 1
+    assert len(b) == 0 and b.next_flush_in(0.2) is None
+
+
+# --- engine ---------------------------------------------------------------
+
+
+def test_engine_infer_matches_predict(setup):
+    model, params, samples, _ = setup
+    engine = InferenceEngine(model, params, batch_size=MAX_BATCH)
+    key = engine.bucket_key(samples[0])
+    out_infer = engine.infer(
+        samples[:1], pad_nodes=key[0], pad_funcs=key[1], rows=MAX_BATCH
+    )
+    out_pred = engine.predict(samples[:1])
+    np.testing.assert_allclose(out_infer[0], out_pred[0], rtol=1e-5)
+    assert out_infer[0].shape[0] == samples[0].coords.shape[0]
+
+
+def test_engine_swap_params_changes_outputs(setup):
+    model, params, samples, _ = setup
+    engine = InferenceEngine(model, params, batch_size=MAX_BATCH)
+    key = engine.bucket_key(samples[0])
+    a = engine.infer(samples[:1], pad_nodes=key[0], pad_funcs=key[1])[0]
+    engine.swap_params(jax.tree.map(lambda x: x * 0.0, params))
+    b = engine.infer(samples[:1], pad_nodes=key[0], pad_funcs=key[1])[0]
+    assert not np.allclose(a, b)
+
+
+def test_engine_validates_nonfinite_with_index(setup):
+    model, params, samples, _ = setup
+    engine = InferenceEngine(model, params, batch_size=MAX_BATCH)
+    bad = MeshSample(
+        coords=samples[1].coords.copy(),
+        y=samples[1].y,
+        theta=samples[1].theta,
+        funcs=samples[1].funcs,
+    )
+    bad.coords[3, 0] = np.nan
+    with pytest.raises(ValueError, match="sample 1.*non-finite"):
+        engine.validate([samples[0], bad])
+
+
+def test_trainer_predict_rejects_nonfinite_inputs():
+    """Satellite: Trainer.predict (which the engine is extracted from)
+    rejects non-finite coords/values with the offending sample index —
+    previously only shape/pad mismatches were caught."""
+    from gnot_tpu.train.trainer import Trainer
+
+    train = datasets.synth_darcy2d(4, seed=0, grid_n=4)
+    cfg = make_config(**{
+        "data.n_train": 4, "data.n_test": 0, "train.epochs": 1,
+    })
+    mc = ModelConfig(
+        n_attn_layers=1, n_attn_hidden_dim=16, n_mlp_num_layers=1,
+        n_mlp_hidden_dim=16, n_input_hidden_dim=16, n_expert=2, n_head=2,
+        **datasets.infer_model_dims(train),
+    )
+    trainer = Trainer(cfg, mc, train, [])
+    bad = datasets.synth_darcy2d(3, seed=1, grid_n=4)
+    bad[2].funcs[0][1, -1] = np.inf
+    with pytest.raises(ValueError, match="sample 2.*non-finite"):
+        trainer.predict(bad)
+    bad[2].funcs[0][1, -1] = 1.0
+    bad[1].theta[0] = np.nan
+    with pytest.raises(ValueError, match="sample 1.*non-finite"):
+        trainer.predict(bad)
+
+
+# --- server: the chaos scenarios -----------------------------------------
+
+
+def test_deadline_shedding_via_slow_request(setup, tmp_path):
+    """slow_request@N stalls the victim's dispatch past its deadline:
+    the victim (and its batchmates) shed BEFORE the forward, with a
+    `shed` event naming the reason."""
+    server, sink, path = make_server(
+        setup,
+        tmp_path,
+        # max_wait > deadline: the bucket can only flush on SIZE, so
+        # both requests ride one deterministic dispatch.
+        max_wait_ms=10_000,
+        default_deadline_ms=150.0,
+        faults=FaultInjector.from_spec("slow_request@1"),
+    )
+    _, _, samples, _ = setup
+    server.start()
+    futs = [server.submit(s) for s in samples[:MAX_BATCH]]
+    results = [f.result(timeout=30) for f in futs]
+    summary = server.drain()
+    sink.close()
+    assert all(not r.ok and r.reason == "shed_deadline" for r in results)
+    assert summary["shed"]["shed_deadline"] == MAX_BATCH
+    sheds = [e for e in read_events(path) if e["event"] == "shed"]
+    assert any(e["reason"] == "shed_deadline" for e in sheds)
+    # The forward never ran: no dispatch (queue_depth) event was
+    # emitted for the shed batch.
+    assert not [
+        e for e in read_events(path) if e["event"] == "queue_depth"
+    ]
+
+
+def test_queue_overflow_fast_fails(setup, tmp_path):
+    """Bounded-queue admission: a storm beyond queue_limit fast-fails
+    at submit() (shed_queue_full events), and the admitted remainder
+    still completes."""
+    server, sink, path = make_server(setup, tmp_path, queue_limit=4)
+    _, _, samples, _ = setup
+    # Worker not started yet: the storm piles into admission unserved —
+    # the deterministic "overloaded backend" shape.
+    futs = [server.submit(s) for s in samples[:10]]
+    shed = [f for f in futs if f.done()]
+    assert len(shed) == 6
+    assert all(
+        f.result().reason == "shed_queue_full" for f in shed
+    )
+    server.start()
+    summary = server.drain()
+    sink.close()
+    assert summary["completed"] == 4  # admitted requests all served
+    assert summary["shed"]["shed_queue_full"] == 6
+    events = read_events(path)
+    assert sum(e["reason"] == "shed_queue_full" for e in events
+               if e["event"] == "shed") == 6
+    assert any(e["event"] == "serve_summary" for e in events)
+
+
+def test_breaker_trips_on_nan_outputs_and_recovers(setup, tmp_path):
+    """nan_output@1,2 poisons two dispatches -> breaker opens
+    (breaker_open event), requests get instant reject-with-reason
+    responses, and after the cooldown a half-open trial closes it
+    again (breaker_close event, served request)."""
+    server, sink, path = make_server(
+        setup,
+        tmp_path,
+        breaker_threshold=2,
+        breaker_cooldown_s=0.4,
+        faults=FaultInjector.from_spec("nan_output@1,nan_output@2"),
+    )
+    _, _, samples, _ = setup
+    server.start()
+    # Sequential submit-and-wait: each request is its own dispatch, so
+    # nan_output@1 and @2 burn exactly the two failures the threshold
+    # needs.
+    r1 = [server.submit(s).result(timeout=30) for s in samples[:2]]
+    assert [r.reason for r in r1] == ["error_nan_output"] * 2
+    # Breaker is now open: instant rejection, no dispatch.
+    r2 = server.submit(samples[0]).result(timeout=30)
+    assert r2.reason == "rejected_breaker_open"
+    time.sleep(0.5)  # past the cooldown -> half-open trial allowed
+    r3 = server.submit(samples[1]).result(timeout=30)
+    assert r3.ok and r3.reason == "ok"
+    summary = server.drain()
+    sink.close()
+    assert summary["breaker_trips"] == 1
+    events = read_events(path)
+    assert any(e["event"] == "breaker_open" for e in events)
+    assert any(e["event"] == "breaker_close" for e in events)
+
+
+def test_graceful_drain_completes_inflight(setup, tmp_path):
+    """drain() stops admission, flushes every queued request through a
+    real dispatch, and emits serve_summary with latency percentiles."""
+    server, sink, path = make_server(setup, tmp_path, max_wait_ms=10_000)
+    _, _, samples, _ = setup
+    server.start()
+    futs = [server.submit(s) for s in samples[:5]]
+    # With a 10 s max_wait and 5 requests (odd), at least one bucket
+    # sits partial — only drain's flush_all can complete it.
+    summary = server.drain()
+    results = [f.result(timeout=1) for f in futs]
+    assert all(r.ok for r in results), [r.reason for r in results]
+    assert summary["completed"] == 5
+    # Post-drain submissions are rejected with a reason, never queued.
+    late = server.submit(samples[0]).result(timeout=1)
+    assert late.reason == "rejected_draining"
+    sink.close()
+    events = read_events(path)
+    [summ] = [e for e in events if e["event"] == "serve_summary"]
+    assert summ["completed"] == 5
+    assert summ["latency_p50_ms"] <= summ["latency_p99_ms"]
+
+
+def test_sigterm_drains_gracefully(setup, tmp_path):
+    """SIGTERM (via resilience.preemption.PreemptionHandler) makes the
+    worker drain: in-flight requests complete, nothing hangs."""
+    with PreemptionHandler() as preempt:
+        server, sink, path = make_server(
+            setup, tmp_path, preempt=preempt, max_wait_ms=10_000
+        )
+        _, _, samples, _ = setup
+        server.start()
+        futs = [server.submit(s) for s in samples[:4]]
+        os.kill(os.getpid(), signal.SIGTERM)
+        results = [f.result(timeout=30) for f in futs]
+        assert all(r.ok for r in results)
+        summary = server.drain()
+        sink.close()
+    assert summary["completed"] == 4
+    assert any(
+        e["event"] == "serve_summary" for e in read_events(path)
+    )
+
+
+def test_hot_reload_swaps_weights_without_dropping(setup, tmp_path):
+    """reload() atomically swaps weights from a checkpoint; requests
+    submitted before/after keep resolving, and outputs change to the
+    reloaded weights'."""
+    from gnot_tpu.train.checkpoint import Checkpointer
+
+    model, params, samples, _ = setup
+    ck = Checkpointer(str(tmp_path / "ck"))
+    new_params = jax.tree.map(lambda x: x * 0.5, params)
+    ck.save_latest(new_params, 3, 0.5)
+    ck.wait()
+    engine = InferenceEngine(model, params, batch_size=MAX_BATCH)
+    server, sink, path = make_server(
+        setup,
+        tmp_path,
+        engine=engine,
+        reload_fn=CheckpointReloader(ck, params),
+    )
+    server.start()
+    before = server.submit(samples[0]).result(timeout=30)
+    assert before.ok
+    assert server.reload()
+    after = server.submit(samples[0]).result(timeout=30)
+    assert after.ok
+    assert not np.allclose(before.output, after.output)
+    server.drain()
+    sink.close()
+    events = read_events(path)
+    [rel] = [e for e in events if e["event"] == "reload"]
+    assert rel["ok"] and rel["epoch"] == 3 and not rel["fallback"]
+
+
+def test_hot_reload_survives_corrupt_dir_via_fallback(setup, tmp_path):
+    """reload_corrupt@1 truncates the published 'latest' right before
+    the reload reads it: the restore walks the fallback chain to
+    'best', serving continues, and the reload event records the
+    fallback."""
+    from gnot_tpu.train.checkpoint import Checkpointer
+
+    model, params, samples, _ = setup
+    ck = Checkpointer(str(tmp_path / "ck"))
+    best_params = jax.tree.map(lambda x: x * 0.25, params)
+    ck.save_best(best_params, 1, 0.5)
+    ck.wait()
+    ck.save_latest(jax.tree.map(lambda x: x * 2.0, params), 2, 0.5)
+    ck.wait()
+    engine = InferenceEngine(model, params, batch_size=MAX_BATCH)
+    server, sink, path = make_server(
+        setup,
+        tmp_path,
+        engine=engine,
+        reload_fn=CheckpointReloader(ck, params),
+        faults=FaultInjector.from_spec("reload_corrupt@1"),
+    )
+    server.start()
+    assert server.submit(samples[0]).result(timeout=30).ok
+    assert server.reload()  # survives the corruption via fallback
+    got = server.submit(samples[1]).result(timeout=30)
+    assert got.ok  # in-flight serving never stopped
+    # The engine now serves the BEST weights (the fallback target).
+    leaves_engine = jax.tree.leaves(engine.params)
+    leaves_best = jax.tree.leaves(best_params)
+    np.testing.assert_allclose(
+        np.asarray(leaves_engine[0]), np.asarray(leaves_best[0]), rtol=1e-6
+    )
+    server.drain()
+    sink.close()
+    [rel] = [e for e in read_events(path) if e["event"] == "reload"]
+    assert rel["ok"] and rel["fallback"]
+
+
+def test_reload_failure_keeps_serving_old_weights(setup, tmp_path):
+    """A reload with NOTHING restorable (empty checkpoint dir) fails
+    loudly (event ok=False) but never kills serving."""
+    from gnot_tpu.train.checkpoint import Checkpointer
+
+    model, params, samples, _ = setup
+    ck = Checkpointer(str(tmp_path / "ck_empty"))
+    server, sink, path = make_server(
+        setup, tmp_path, reload_fn=CheckpointReloader(ck, params)
+    )
+    server.start()
+    assert not server.reload()
+    assert server.submit(samples[0]).result(timeout=30).ok
+    server.drain()
+    sink.close()
+    [rel] = [e for e in read_events(path) if e["event"] == "reload"]
+    assert not rel["ok"]
+
+
+# --- mixed-bucket storm + compiled-program bound --------------------------
+
+
+def _storm_asserts(events, engine, traffic):
+    dispatches = [e for e in events if e["event"] == "queue_depth"]
+    assert dispatches, "storm produced no dispatches"
+    expected = {
+        (
+            bucket_length(s.coords.shape[0]),
+            bucket_length(max(f.shape[0] for f in s.funcs)),
+        )
+        for s in traffic
+    }
+    seen = {(e["bucket_nodes"], e["bucket_funcs"]) for e in dispatches}
+    assert seen <= expected  # no dispatch outside a real bucket
+    l_max = max(bucket_length(s.coords.shape[0]) for s in traffic)
+    # O(log L): ~2 bucket boundaries per octave above the 64 floor.
+    bound = 2 * (int(math.log2(l_max / 64)) + 1)
+    assert engine.compiled_shapes <= max(len(expected), bound)
+
+
+def test_mixed_bucket_storm_bounded_compiles(setup, tmp_path):
+    """Mixed Darcy64 / elasticity-sized traffic: every dispatch stays
+    inside one bucket and the engine compiles at most one program per
+    bucket — O(log L_max) programs under O(traffic) requests."""
+    import serve_smoke
+
+    model, params, _, _ = setup
+    traffic = serve_smoke.mixed_traffic(12, seed=1)
+    engine = InferenceEngine(model, params, batch_size=MAX_BATCH)
+    engine.warmup(traffic, rows=MAX_BATCH)
+    server, sink, path = make_server(setup, tmp_path, engine=engine)
+    server.start()
+    futs = [server.submit(s) for s in traffic]
+    results = [f.result(timeout=60) for f in futs]
+    server.drain()
+    sink.close()
+    assert all(r.ok for r in results)
+    _storm_asserts(read_events(path), engine, traffic)
+
+
+def test_serve_smoke_tool(tmp_path):
+    """Tier-1 wiring of tools/serve_smoke.py: the CLI smoke (mixed
+    buckets, one injected straggler, asserted counters) passes."""
+    import serve_smoke
+
+    summary = serve_smoke.run(
+        ["--n", "10", "--metrics_path", str(tmp_path / "smoke.jsonl")]
+    )
+    assert summary["failures"] == []
+    assert summary["shed"].get("shed_deadline", 0) >= 1
+
+
+@pytest.mark.slow
+def test_long_mixed_storm_with_faults(setup, tmp_path):
+    """The long storm: 80 mixed-bucket requests under queue pressure
+    with a straggler AND two NaN dispatches — sheds, trips, recovers,
+    drains; every request resolves; compiled programs stay bounded."""
+    import serve_smoke
+
+    model, params, _, _ = setup
+    traffic = serve_smoke.mixed_traffic(80, seed=2)
+    engine = InferenceEngine(model, params, batch_size=MAX_BATCH)
+    engine.warmup(traffic, rows=MAX_BATCH)
+    server, sink, path = make_server(
+        setup,
+        tmp_path,
+        engine=engine,
+        queue_limit=32,
+        default_deadline_ms=10_000.0,
+        breaker_threshold=2,
+        breaker_cooldown_s=0.05,
+        faults=FaultInjector.from_spec(
+            "slow_request@79,nan_output@3,nan_output@4"
+        ),
+    )
+    server.start()
+    futs = [server.submit(s) for s in traffic]
+    results = [f.result(timeout=120) for f in futs]
+    summary = server.drain()
+    sink.close()
+    assert len(results) == 80  # every request resolved
+    reasons = {r.reason for r in results}
+    assert "ok" in reasons
+    assert summary["completed"] + sum(summary["shed"].values()) == 80
+    events = read_events(path)
+    _storm_asserts(events, engine, traffic)
+    assert summary["breaker_trips"] >= 1
