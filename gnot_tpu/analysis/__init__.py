@@ -1,0 +1,50 @@
+"""graftlint: JAX-aware static analysis for this codebase's invariants.
+
+Three invariant classes here are load-bearing and, before this package,
+were enforced only by comments and reviewer vigilance:
+
+* **buffer-donation safety** — the jitted train steps donate the
+  TrainState (``donate_argnums=(0,)``); reading a donated buffer after
+  the call is use-after-free on device memory (PR 2 fixed a real one
+  that silently corrupted checkpoints). Rule **GL001**.
+* **no host syncs on hot paths** — one ``.item()`` inside a compiled
+  step body turns an async dispatch pipeline into a lock-step crawl;
+  the whole telemetry design exists to avoid it. Rules **GL002**
+  (host sync in compiled code) and **GL003** (recompile hazards).
+* **lock discipline** — the threaded serving layer shares mutable
+  counters between the client, worker, and reload threads; a missed
+  ``with self._lock`` is a data race that only shows up under storm
+  traffic. Rule **GL004**.
+* **registry drift** — event kinds and fault kinds each have a central
+  registry (``obs/events.py``, ``resilience/faults.py::FAULT_KINDS``)
+  and user-facing docs; an emit site or registry entry that drifts from
+  them is an observability hole. Rule **GL005**.
+
+The framework (``core.py``) is pure stdlib ``ast`` — the analysis
+itself never imports the code under test, touches no devices, and
+scans the whole tree in under a second (``tools/lint.py`` stubs the
+package import so the CLI skips the jax import entirely). Rules
+register themselves via
+``@register``; ``run_analysis`` drives them per file plus one
+project-level pass (docs drift). Findings carry ``file:line`` plus a
+fix hint; ``# graftlint: disable=RULE — reason`` suppresses one line.
+
+Usage: ``python tools/lint.py gnot_tpu`` (docs/static_analysis.md).
+"""
+
+from gnot_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    LintConfig,
+    Rule,
+    RULES,
+    load_config,
+    register,
+    run_analysis,
+)
+
+# Importing the rule modules registers them.
+from gnot_tpu.analysis import donation  # noqa: F401
+from gnot_tpu.analysis import hostsync  # noqa: F401
+from gnot_tpu.analysis import locks  # noqa: F401
+from gnot_tpu.analysis import recompile  # noqa: F401
+from gnot_tpu.analysis import registry_drift  # noqa: F401

@@ -1,0 +1,447 @@
+"""graftlint framework: rule registry, per-file driver, suppressions.
+
+Pure stdlib (``ast`` + ``re``) by design — the analysis reads source,
+never imports the code under test, so a broken or device-hungry module
+still lints. Rules subclass :class:`Rule` and register via
+``@register``; each rule sees one :class:`FileContext` per file (parsed
+tree, parent links, raw lines) and may also implement a project-level
+pass (:meth:`Rule.check_project`) for cross-file invariants.
+
+Suppressions (``docs/static_analysis.md``):
+
+* ``# graftlint: disable=GL001`` on the offending line silences that
+  rule there (comma-separate several ids; append ``— reason`` — every
+  committed suppression must carry one).
+* ``# graftlint: disable-file=GL002`` anywhere in a file silences the
+  rule for the whole file.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import fnmatch
+import io
+import os
+import re
+import tokenize
+from typing import Iterable
+
+#: Rule-id grammar: GL + digits (or "all"). The capture is anchored to
+#: id tokens so a trailing justification — with or without a dash —
+#: is never swallowed into the id list.
+_IDS = r"(?:[A-Za-z]+\d+|all|ALL)(?:\s*,\s*(?:[A-Za-z]+\d+|all|ALL))*"
+_SUPPRESS_RE = re.compile(rf"#\s*graftlint:\s*disable=({_IDS})")
+_SUPPRESS_FILE_RE = re.compile(rf"#\s*graftlint:\s*disable-file=({_IDS})")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One rule violation, anchored to ``file:line`` with a fix hint."""
+
+    rule: str  # "GL001"
+    path: str  # repo-relative
+    line: int
+    message: str
+    hint: str = ""
+
+    def format(self) -> str:
+        s = f"{self.path}:{self.line}: {self.rule} {self.message}"
+        if self.hint:
+            s += f" [hint: {self.hint}]"
+        return s
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """Per-run configuration (``[tool.graftlint]`` in pyproject.toml).
+
+    ``enable``/``disable`` select rules by id; ``exclude`` drops files
+    whose repo-relative path matches any glob (or contains it as a
+    substring — ``"native/"`` excludes the whole dir). Rule-specific
+    knobs carry their rule id in the name.
+    """
+
+    enable: list[str] = dataclasses.field(default_factory=list)  # [] = all
+    disable: list[str] = dataclasses.field(default_factory=list)
+    exclude: list[str] = dataclasses.field(default_factory=list)
+    # GL001: terminal attribute/function names known to donate arg 0
+    # (the builders in train/trainer.py, obs/telemetry.py,
+    # parallel/mesh.py and parallel/pipeline.py all donate the state).
+    donate_callables: list[str] = dataclasses.field(
+        default_factory=lambda: ["train_step", "multi_train_step"]
+    )
+    # GL002: builder functions whose NESTED defs are compiled step
+    # bodies (train_step_body's `body` is jitted by every step builder).
+    hot_containers: list[str] = dataclasses.field(
+        default_factory=lambda: ["train_step_body", "eval_step_body"]
+    )
+    # GL005: registry + docs locations (repo-relative).
+    events_registry: str = "gnot_tpu/obs/events.py"
+    faults_registry: str = "gnot_tpu/resilience/faults.py"
+    docs_events: str = "docs/observability.md"
+    docs_faults: str = "docs/robustness.md"
+
+    def rule_enabled(self, rule_id: str) -> bool:
+        if rule_id in self.disable:
+            return False
+        return not self.enable or rule_id in self.enable
+
+    def excludes(self, rel_path: str) -> bool:
+        rel = rel_path.replace(os.sep, "/")
+        return any(
+            fnmatch.fnmatch(rel, pat) or pat in rel for pat in self.exclude
+        )
+
+
+def _parse_toml_value(raw: str):
+    raw = raw.strip()
+    if raw.startswith("[") and raw.endswith("]"):
+        inner = raw[1:-1].strip().rstrip(",")
+        if not inner:
+            return []
+        return [_parse_toml_value(v) for v in _split_toml_list(inner)]
+    if raw.startswith(('"', "'")):
+        return raw[1:-1]
+    if raw in ("true", "false"):
+        return raw == "true"
+    try:
+        return int(raw)
+    except ValueError:
+        return raw
+
+
+def _strip_toml_comment(line: str) -> str:
+    """Drop an inline ``# ...`` comment (quote-aware)."""
+    quote = None
+    for i, ch in enumerate(line):
+        if quote:
+            if ch == quote:
+                quote = None
+        elif ch in "\"'":
+            quote = ch
+        elif ch == "#":
+            return line[:i]
+    return line
+
+
+def _split_toml_list(inner: str) -> list[str]:
+    out, depth, cur, quote = [], 0, "", None
+    for ch in inner:
+        if quote:
+            cur += ch
+            if ch == quote:
+                quote = None
+            continue
+        if ch in "\"'":
+            quote = ch
+            cur += ch
+        elif ch == "[":
+            depth += 1
+            cur += ch
+        elif ch == "]":
+            depth -= 1
+            cur += ch
+        elif ch == "," and depth == 0:
+            out.append(cur)
+            cur = ""
+        else:
+            cur += ch
+    if cur.strip():
+        out.append(cur)
+    return [s.strip() for s in out]
+
+
+def _read_graftlint_section(pyproject_path: str) -> dict:
+    """Parse the ``[tool.graftlint]`` table. Uses tomllib when the
+    interpreter has it; otherwise a minimal hand parser covering the
+    subset this section uses (strings, string arrays, bools, ints —
+    multiline arrays included). The image's python predates tomllib
+    and nothing heavier may be installed, hence the fallback."""
+    try:
+        with open(pyproject_path, "rb") as f:
+            data = f.read().decode("utf-8")
+    except OSError:
+        return {}
+    try:
+        import tomllib  # py >= 3.11
+
+        try:
+            return tomllib.loads(data).get("tool", {}).get("graftlint", {})
+        except tomllib.TOMLDecodeError:
+            pass  # fall through to the lenient hand parser
+    except ImportError:
+        pass
+    out: dict = {}
+    in_section = False
+    pending_key = None
+    pending_val = ""
+    for line in data.splitlines():
+        stripped = _strip_toml_comment(line).strip()
+        if pending_key is not None:
+            pending_val += " " + stripped
+            if stripped.endswith("]"):
+                out[pending_key] = _parse_toml_value(pending_val)
+                pending_key, pending_val = None, ""
+            continue
+        if stripped.startswith("["):
+            in_section = stripped == "[tool.graftlint]"
+            continue
+        if not in_section or not stripped:
+            continue
+        if "=" not in stripped:
+            continue
+        key, _, val = stripped.partition("=")
+        key, val = key.strip(), val.strip()
+        if val.startswith("[") and not val.endswith("]"):
+            pending_key, pending_val = key, val  # multiline array
+            continue
+        out[key] = _parse_toml_value(val)
+    return out
+
+
+def load_config(root: str) -> LintConfig:
+    """LintConfig from ``<root>/pyproject.toml``'s ``[tool.graftlint]``
+    (defaults when the file or section is absent)."""
+    section = _read_graftlint_section(os.path.join(root, "pyproject.toml"))
+    cfg = LintConfig()
+    for field in dataclasses.fields(LintConfig):
+        if field.name in section:
+            setattr(cfg, field.name, section[field.name])
+    return cfg
+
+
+class FileContext:
+    """One parsed file handed to each rule: tree with parent links,
+    raw lines (rules read annotation comments the AST drops), and the
+    per-line suppression map."""
+
+    def __init__(
+        self,
+        root: str,
+        rel_path: str,
+        source: str,
+        config: "LintConfig | None" = None,
+    ):
+        self.root = root
+        self.path = rel_path
+        self.source = source
+        self.config = config or LintConfig()
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=rel_path)
+        self._parents: dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+        self.suppressed: dict[int, set[str]] = {}
+        self.file_suppressed: set[str] = set()
+        # Real COMMENT tokens only — a docstring merely *documenting*
+        # the suppression syntax must not suppress anything.
+        for line_no, comment in self._comments(source):
+            m = _SUPPRESS_RE.search(comment)
+            if m:
+                self.suppressed.setdefault(line_no, set()).update(
+                    r.strip().upper() for r in m.group(1).split(",") if r.strip()
+                )
+            m = _SUPPRESS_FILE_RE.search(comment)
+            if m:
+                self.file_suppressed |= {
+                    r.strip().upper() for r in m.group(1).split(",") if r.strip()
+                }
+
+    @staticmethod
+    def _comments(source: str) -> list[tuple[int, str]]:
+        try:
+            return [
+                (tok.start[0], tok.string)
+                for tok in tokenize.generate_tokens(io.StringIO(source).readline)
+                if tok.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError):
+            # ast.parse succeeded, so this should be unreachable; stay
+            # permissive rather than dropping all suppressions.
+            return []
+
+    def parent(self, node: ast.AST) -> ast.AST | None:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def enclosing_statement(self, node: ast.AST) -> ast.stmt:
+        cur = node
+        while not isinstance(cur, ast.stmt):
+            cur = self._parents[cur]
+        return cur
+
+    def enclosing_function(
+        self, node: ast.AST
+    ) -> ast.FunctionDef | ast.AsyncFunctionDef | None:
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def is_suppressed(self, rule_id: str, line: int) -> bool:
+        if rule_id in self.file_suppressed or "ALL" in self.file_suppressed:
+            return True
+        rules = self.suppressed.get(line, ())
+        return rule_id in rules or "ALL" in rules
+
+
+class ProjectContext:
+    """Cross-file state for project-level checks (GL005 docs drift)."""
+
+    def __init__(self, root: str, config: LintConfig):
+        self.root = root
+        self.config = config
+
+
+class Rule:
+    """Base rule: subclass, set ``id``/``title``, implement
+    ``check_file`` (and optionally ``check_project`` for cross-file
+    invariants — called once, after every file)."""
+
+    id: str = ""
+    title: str = ""
+    hint: str = ""
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        return []
+
+    def check_project(self, project: ProjectContext) -> list[Finding]:
+        return []
+
+
+#: id -> rule class. Populated by the ``@register`` decorator at import
+#: of the rule modules (analysis/__init__ imports them all).
+RULES: dict[str, type[Rule]] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    if not cls.id or cls.id in RULES:
+        raise ValueError(f"bad or duplicate rule id: {cls.id!r}")
+    RULES[cls.id] = cls
+    return cls
+
+
+def iter_python_files(paths: list[str], root: str, config: LintConfig):
+    """Yield repo-relative .py paths under ``paths`` (files or dirs),
+    honoring ``config.exclude``. Deterministic order."""
+    seen = []
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full):
+            seen.append(os.path.relpath(full, root))
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames.sort()
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    seen.append(
+                        os.path.relpath(os.path.join(dirpath, name), root)
+                    )
+    return [rel for rel in seen if not config.excludes(rel)]
+
+
+def run_analysis(
+    paths: list[str],
+    *,
+    root: str,
+    config: LintConfig | None = None,
+) -> tuple[list[Finding], dict]:
+    """Run every enabled rule over every python file under ``paths``.
+
+    Returns ``(findings, stats)`` where stats counts files scanned and
+    suppressions honored. Findings are sorted by (path, line, rule).
+    A file that fails to parse yields a synthetic ``GL000`` finding
+    instead of crashing the run (the lint gate must report, not die).
+    """
+    config = config or load_config(root)
+    rules = [
+        cls() for rid, cls in sorted(RULES.items()) if config.rule_enabled(rid)
+    ]
+    findings: list[Finding] = []
+    n_suppressed = 0
+    files = iter_python_files(paths, root, config)
+    for rel in files:
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as f:
+                ctx = FileContext(root, rel, f.read(), config)
+        except (OSError, SyntaxError, UnicodeDecodeError, ValueError) as err:
+            findings.append(
+                Finding(
+                    rule="GL000",
+                    path=rel,
+                    line=getattr(err, "lineno", 0) or 0,
+                    message=f"could not analyze file: {err}",
+                    hint="fix the syntax error or exclude the file",
+                )
+            )
+            continue
+        for rule in rules:
+            for f in rule.check_file(ctx):
+                if ctx.is_suppressed(f.rule, f.line):
+                    n_suppressed += 1
+                else:
+                    findings.append(f)
+    project = ProjectContext(root, config)
+    for rule in rules:
+        findings.extend(rule.check_project(project))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    stats = {
+        "files": len(files),
+        "rules": [r.id for r in rules],
+        "suppressed": n_suppressed,
+        "findings": len(findings),
+    }
+    return findings, stats
+
+
+# -- shared AST helpers (used by several rules) ----------------------------
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted name of a call target: ``jax.lax.scan`` ->
+    "jax.lax.scan"; unresolvable pieces become ``?``."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{dotted_name(node.value)}.{node.attr}"
+    return "?"
+
+
+def terminal_name(node: ast.AST) -> str:
+    """Final attribute/name of a call target (``self.train_step`` ->
+    "train_step")."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def is_jit_expr(node: ast.AST) -> bool:
+    """``jax.jit`` / ``jit`` as an expression."""
+    return terminal_name(node) == "jit"
+
+
+def jit_call_kwargs(dec: ast.AST) -> dict[str, ast.AST] | None:
+    """If ``dec`` is a jit-producing decorator/call, return its keyword
+    args (possibly empty). Recognized shapes: ``jax.jit``,
+    ``jax.jit(...)``, ``functools.partial(jax.jit, ...)``."""
+    if is_jit_expr(dec):
+        return {}
+    if isinstance(dec, ast.Call):
+        if is_jit_expr(dec.func):
+            return {k.arg: k.value for k in dec.keywords if k.arg}
+        if terminal_name(dec.func) == "partial" and dec.args:
+            if is_jit_expr(dec.args[0]):
+                return {k.arg: k.value for k in dec.keywords if k.arg}
+    return None

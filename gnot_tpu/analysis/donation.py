@@ -1,0 +1,230 @@
+"""GL001 — use-after-donate.
+
+The jitted train steps donate their first argument
+(``donate_argnums=(0,)``): after the call, the TrainState's device
+buffers are XLA's to reuse, and reading them is use-after-free — the
+exact bug PR 2's drive-by fixed, where async orbax saves read donated
+buffers and silently corrupted mid-run checkpoints.
+
+Two detection sources:
+
+* **intra-file** — any function defined with a
+  ``@functools.partial(jax.jit, donate_argnums=...)`` decorator (or
+  bound via ``f = jax.jit(g, donate_argnums=...)``), called later in
+  the same file;
+* **configured** — calls whose terminal name is in
+  ``LintConfig.donate_callables`` (default ``train_step`` /
+  ``multi_train_step`` — the trainer's step attributes, built by
+  donating builders in train/trainer.py, obs/telemetry.py,
+  parallel/mesh.py, parallel/pipeline.py).
+
+A call is SAFE when the donated expression is rebound by the same
+statement (``state, loss = step(state, ...)``) — the canonical
+pattern. Otherwise any later read of that expression in the enclosing
+function before a rebind is flagged; a call inside a loop whose
+donated expression is never rebound in the loop is flagged too (the
+next iteration re-reads the donated buffer).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gnot_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    jit_call_kwargs,
+    register,
+    terminal_name,
+)
+
+
+def _donated_indices(kwargs: dict[str, ast.AST]) -> tuple[int, ...]:
+    node = kwargs.get("donate_argnums")
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        )
+    return ()
+
+
+def _expr_key(node: ast.AST) -> str | None:
+    """Stable identity for a donated argument we can track: a local
+    name ("state") or a self-attribute ("self.state")."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+    ):
+        return f"{node.value.id}.{node.attr}"
+    return None
+
+
+def _matches_key(node: ast.AST, key: str) -> bool:
+    return _expr_key(node) == key
+
+
+def _assigned_keys(stmt: ast.stmt) -> set[str]:
+    """Expression keys (re)bound by this statement's targets."""
+    targets: list[ast.AST] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    elif isinstance(stmt, ast.With):
+        targets = [i.optional_vars for i in stmt.items if i.optional_vars]
+    out: set[str] = set()
+    for t in targets:
+        for node in ast.walk(t):
+            key = _expr_key(node)
+            if key is not None:
+                out.add(key)
+    return out
+
+
+@register
+class UseAfterDonate(Rule):
+    id = "GL001"
+    title = "use-after-donate"
+    hint = (
+        "rebind the donated value in the call statement "
+        "(`state, out = step(state, ...)`) or take a device copy "
+        "(`jnp.copy`) before the donating call"
+    )
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        donating = self._collect_donating(ctx)
+        findings: list[Finding] = []
+        for call in ast.walk(ctx.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            name = terminal_name(call.func)
+            idxs = donating.get(name)
+            if idxs is None:
+                continue
+            for idx in idxs:
+                if idx >= len(call.args):
+                    continue
+                key = _expr_key(call.args[idx])
+                if key is None:
+                    continue  # a fresh expression; nothing to re-read
+                bad_line = self._use_after(ctx, call, key)
+                if bad_line is not None:
+                    findings.append(
+                        Finding(
+                            rule=self.id,
+                            path=ctx.path,
+                            line=bad_line,
+                            message=(
+                                f"`{key}` is read after being donated to "
+                                f"`{name}(...)` (donate_argnums arg {idx}, "
+                                f"call at line {call.lineno}); the donated "
+                                f"device buffers are dead"
+                            ),
+                            hint=self.hint,
+                        )
+                    )
+        return findings
+
+    # -- donating-callable discovery ---------------------------------------
+
+    def _collect_donating(self, ctx: FileContext) -> dict[str, tuple[int, ...]]:
+        donating = {name: (0,) for name in ctx.config.donate_callables}
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    kwargs = jit_call_kwargs(dec)
+                    if kwargs:
+                        idxs = _donated_indices(kwargs)
+                        if idxs:
+                            donating[node.name] = idxs
+            # f = jax.jit(g, donate_argnums=...) / partial(jax.jit, ...)
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                kwargs = jit_call_kwargs(node.value) or (
+                    {k.arg: k.value for k in node.value.keywords if k.arg}
+                    if terminal_name(node.value.func) == "jit"
+                    else None
+                )
+                if kwargs:
+                    idxs = _donated_indices(kwargs)
+                    if idxs:
+                        for t in node.targets:
+                            name = terminal_name(t)
+                            if name:
+                                donating[name] = idxs
+        return donating
+
+    # -- dataflow ----------------------------------------------------------
+
+    def _use_after(
+        self, ctx: FileContext, call: ast.Call, key: str
+    ) -> int | None:
+        """Line of the first read of ``key`` after the donating call
+        and before a rebind, or None when the pattern is safe."""
+        stmt = ctx.enclosing_statement(call)
+        if key in _assigned_keys(stmt):
+            return None  # canonical `x, ... = step(x, ...)` rebind
+        func = ctx.enclosing_function(call)
+        scope: ast.AST = func if func is not None else ctx.tree
+        # Ordered (position, kind, line) events for the key across the
+        # scope; "after" is by source position — a conservative stand-in
+        # for execution order within one function body.
+        events: list[tuple[int, int, str, int]] = []
+        for node in ast.walk(scope):
+            k = None
+            if isinstance(node, (ast.Name, ast.Attribute)) and _matches_key(
+                node, key
+            ):
+                k = "store" if isinstance(node.ctx, ast.Store) else "load"
+            if k is not None:
+                events.append((node.lineno, node.col_offset, k, node.lineno))
+        events.sort()
+        # "After" = strictly past the call expression's END, so reads
+        # inside the (possibly multiline) call itself never count.
+        call_end = (call.end_lineno, call.end_col_offset)
+        after = [e for e in events if (e[0], e[1]) > call_end]
+        for _, _, kind, line in after:
+            if kind == "store":
+                break
+            return line
+        if "." in key and not any(k == "store" for _, _, k, _ in after):
+            # A donated ATTRIBUTE (`self.state`) that this scope never
+            # rebinds: the attribute keeps pointing at freed buffers
+            # for every later reader — including the enclosing method
+            # when the call sits in a nested helper (the scan cannot
+            # see past the def boundary, so the absence of a rebind IS
+            # the finding). A donated plain local with no later use is
+            # just dead and stays unflagged.
+            return call.lineno
+        # Loop case: the call re-executes; if the key is never rebound
+        # anywhere inside the loop, the next iteration reads the
+        # donated buffer through the call's own argument.
+        loop = None
+        for anc in ctx.ancestors(call):
+            if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+                loop = anc
+                break
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+        if loop is not None:
+            for node in ast.walk(loop):
+                if (
+                    isinstance(node, (ast.Name, ast.Attribute))
+                    and _matches_key(node, key)
+                    and isinstance(node.ctx, ast.Store)
+                ):
+                    return None
+            if key in _assigned_keys(loop):
+                return None
+            return call.lineno
+        return None

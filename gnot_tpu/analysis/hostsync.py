@@ -1,0 +1,182 @@
+"""GL002 — host sync inside compiled ("hot") code.
+
+The whole telemetry subsystem exists because one ``.item()`` /
+``float()`` / ``np.asarray`` on a traced value inside the compiled
+step turns the async dispatch pipeline into a blocking transfer per
+step (docs/observability.md "no host syncs on the hot path"). Under
+``jax.jit`` these calls either sync (on concrete values leaked in) or
+crash at trace time — both are bugs the type checker can't see.
+
+A function is **hot** when any of:
+
+* it is decorated with ``jax.jit`` (directly or via
+  ``functools.partial(jax.jit, ...)``);
+* its name (or a lambda) is passed to ``jax.jit(...)`` /
+  ``shard_map(...)`` / ``jax.lax.scan`` / ``jax.lax.map`` in the same
+  file;
+* it is lexically nested inside a builder named in
+  ``LintConfig.hot_containers`` (``train_step_body`` /
+  ``eval_step_body`` — their inner ``body`` defs are jitted by every
+  step builder in the repo);
+* it is nested inside another hot function.
+
+Flagged inside hot code: ``.item()``; ``float/int/bool(x)`` on a
+non-literal; ``np.asarray`` / ``np.array``; ``jax.device_get``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gnot_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    is_jit_expr,
+    jit_call_kwargs,
+    register,
+    terminal_name,
+)
+
+#: Call targets that wrap their first positional argument into compiled
+#: code (terminal name -> requires-lax-prefix?).
+_WRAPPERS = {"jit": False, "shard_map": False, "scan": True, "map": True}
+
+
+def collect_hot_functions(ctx: FileContext) -> set[ast.AST]:
+    """All FunctionDef / Lambda nodes whose bodies execute under a jit
+    trace (see module docstring for the sources)."""
+    hot: set[ast.AST] = set()
+    defs_by_name: dict[str, list[ast.AST]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+            if any(
+                jit_call_kwargs(dec) is not None for dec in node.decorator_list
+            ):
+                hot.add(node)
+    # Names / lambdas handed to jit / shard_map / lax.scan / lax.map.
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        name = terminal_name(node.func)
+        if name not in _WRAPPERS:
+            continue
+        if _WRAPPERS[name] and "lax" not in dotted_name(node.func):
+            continue
+        if name == "jit" and not is_jit_expr(node.func):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Lambda):
+            hot.add(arg)
+        elif isinstance(arg, ast.Name):
+            hot.update(defs_by_name.get(arg.id, ()))
+    # Nested inside hot containers (train_step_body's inner `body`).
+    containers = set(ctx.config.hot_containers)
+    for node in ast.walk(ctx.tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.Lambda))
+            and node not in hot
+        ):
+            for anc in ctx.ancestors(node):
+                if anc in hot or (
+                    isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and anc.name in containers
+                ):
+                    hot.add(node)
+                    break
+    # Transitive: defs nested in newly-hot functions (one extra pass
+    # suffices — ancestors() sees the full chain).
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.Lambda)) and node not in hot:
+            if any(anc in hot for anc in ctx.ancestors(node)):
+                hot.add(node)
+    return hot
+
+
+def _is_literalish(node: ast.AST) -> bool:
+    return isinstance(node, (ast.Constant, ast.JoinedStr)) or (
+        isinstance(node, ast.UnaryOp) and isinstance(node.operand, ast.Constant)
+    )
+
+
+def _sync_violation(call: ast.Call) -> str | None:
+    """Describe the host sync this call performs, or None."""
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr == "item" and not call.args:
+        return "`.item()` forces a device->host transfer"
+    name = terminal_name(func)
+    if (
+        isinstance(func, ast.Name)
+        and name in ("float", "int", "bool")
+        and call.args
+        and not _is_literalish(call.args[0])
+    ):
+        return (
+            f"`{name}(...)` on a traced value blocks on the device "
+            "(or fails at trace time)"
+        )
+    if name in ("asarray", "array") and isinstance(func, ast.Attribute):
+        base = dotted_name(func.value)
+        if base in ("np", "numpy"):
+            return f"`{base}.{name}(...)` materializes the value on host"
+    if name == "device_get":
+        return "`jax.device_get(...)` is a blocking device->host fetch"
+    return None
+
+
+@register
+class HostSyncInHotPath(Rule):
+    id = "GL002"
+    title = "host-sync-in-hot-path"
+    hint = (
+        "keep the math in jnp (device-side) and fetch at a drain "
+        "boundary (TelemetryBuffer-style), or move the conversion "
+        "outside the compiled function"
+    )
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        hot = collect_hot_functions(ctx)
+        if not hot:
+            return []
+        findings: list[Finding] = []
+        for fn in hot:
+            body = fn.body if isinstance(fn.body, list) else [fn.body]
+            for stmt in body:
+                # Nested defs are themselves in `hot` when reachable
+                # hot code; walking them here would double-report.
+                for node in _walk_shallow(stmt):
+                    if isinstance(node, ast.Call):
+                        why = _sync_violation(node)
+                        if why is not None:
+                            findings.append(
+                                Finding(
+                                    rule=self.id,
+                                    path=ctx.path,
+                                    line=node.lineno,
+                                    message=(
+                                        f"host sync inside compiled code "
+                                        f"({_fn_label(fn)}): {why}"
+                                    ),
+                                    hint=self.hint,
+                                )
+                            )
+        uniq = {(f.path, f.line, f.message): f for f in findings}
+        return list(uniq.values())
+
+
+def _walk_shallow(node: ast.AST):
+    """Yield ``node`` and descendants WITHOUT descending into nested
+    function/lambda bodies (those are analyzed as their own hot fns)."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield from _walk_shallow(child)
+
+
+def _fn_label(fn: ast.AST) -> str:
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return f"function `{fn.name}`"
+    return "jitted lambda"

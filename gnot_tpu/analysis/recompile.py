@@ -1,0 +1,179 @@
+"""GL003 — recompile hazards.
+
+Two shapes of "compiles O(traffic) programs instead of O(1)":
+
+* ``jax.jit`` / ``shard_map`` / ``jax.pmap`` invoked inside a loop
+  body — every iteration builds a NEW wrapper whose trace cache is
+  thrown away, so every call compiles. The repo's discipline is
+  build-once (all step builders run at initialize(); the serving
+  engine compiles one program per bucket). A jit in a loop silently
+  breaks the O(log L_max) compiled-program bound the chaos suite
+  asserts.
+* a jitted function whose **static** argument has a non-hashable
+  default (list/dict/set): jit hashes static args to key the trace
+  cache, so the first call with the default raises — or, with a
+  converted-to-tuple workaround upstream, churns the cache when the
+  caller rebuilds the default per call.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gnot_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    dotted_name,
+    is_jit_expr,
+    jit_call_kwargs,
+    register,
+    terminal_name,
+)
+
+_COMPILING = ("jit", "pmap", "shard_map")
+_MUTABLE_DEFAULTS = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp)
+
+
+def _is_compiling_call(node: ast.Call) -> bool:
+    name = terminal_name(node.func)
+    if name not in _COMPILING:
+        return False
+    if name == "jit":
+        return is_jit_expr(node.func)
+    if name == "pmap":
+        return "jax" in dotted_name(node.func) or isinstance(
+            node.func, ast.Name
+        )
+    return True  # shard_map (ops.collectives or jax.experimental)
+
+
+def _static_indices(kwargs: dict[str, ast.AST]) -> tuple[int, ...]:
+    node = kwargs.get("static_argnums")
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        )
+    return ()
+
+
+def _static_names(kwargs: dict[str, ast.AST]) -> tuple[str, ...]:
+    node = kwargs.get("static_argnames")
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        )
+    return ()
+
+
+@register
+class RecompileHazard(Rule):
+    id = "GL003"
+    title = "recompile-hazard"
+    hint = (
+        "hoist the jit/shard_map wrapper out of the loop (build once, "
+        "call many); make static-arg defaults hashable (tuple, not "
+        "list)"
+    )
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        findings: list[Finding] = []
+        findings.extend(self._jit_in_loop(ctx))
+        findings.extend(self._mutable_static_defaults(ctx))
+        return findings
+
+    def _jit_in_loop(self, ctx: FileContext) -> list[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and _is_compiling_call(node)):
+                continue
+            # Loop ancestry within the same function scope only: a def
+            # built inside a loop is a builder the loop calls once each
+            # — still suspect, but crossing the def boundary would flag
+            # every factory; the in-scope case is the unambiguous bug.
+            for anc in ctx.ancestors(node):
+                if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                    break
+                if isinstance(anc, (ast.For, ast.While, ast.AsyncFor)):
+                    out.append(
+                        Finding(
+                            rule=self.id,
+                            path=ctx.path,
+                            line=node.lineno,
+                            message=(
+                                f"`{dotted_name(node.func)}(...)` invoked "
+                                f"inside a loop (line {anc.lineno}): every "
+                                f"iteration re-traces and re-compiles"
+                            ),
+                            hint=self.hint,
+                        )
+                    )
+                    break
+        return out
+
+    def _mutable_static_defaults(self, ctx: FileContext) -> list[Finding]:
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                kwargs = jit_call_kwargs(dec)
+                if kwargs is None:
+                    continue
+                idxs = _static_indices(kwargs)
+                names = _static_names(kwargs)
+                if not idxs and not names:
+                    continue
+                args = node.args
+                params = args.posonlyargs + args.args
+                # Defaults right-align onto the positional params.
+                offset = len(params) - len(args.defaults)
+                for i, default in enumerate(args.defaults):
+                    p = params[offset + i]
+                    if (
+                        (offset + i) in idxs or p.arg in names
+                    ) and isinstance(default, _MUTABLE_DEFAULTS):
+                        out.append(
+                            Finding(
+                                rule=self.id,
+                                path=ctx.path,
+                                line=default.lineno,
+                                message=(
+                                    f"static arg `{p.arg}` of jitted "
+                                    f"`{node.name}` has a non-hashable "
+                                    f"default: jit cannot cache-key it"
+                                ),
+                                hint=self.hint,
+                            )
+                        )
+                for i, default in enumerate(args.kw_defaults):
+                    if default is None:
+                        continue
+                    p = args.kwonlyargs[i]
+                    if p.arg in names and isinstance(
+                        default, _MUTABLE_DEFAULTS
+                    ):
+                        out.append(
+                            Finding(
+                                rule=self.id,
+                                path=ctx.path,
+                                line=default.lineno,
+                                message=(
+                                    f"static arg `{p.arg}` of jitted "
+                                    f"`{node.name}` has a non-hashable "
+                                    f"default: jit cannot cache-key it"
+                                ),
+                                hint=self.hint,
+                            )
+                        )
+        return out

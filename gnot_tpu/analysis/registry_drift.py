@@ -1,0 +1,257 @@
+"""GL005 — event/fault registry drift.
+
+Two central registries exist so the observability surface cannot rot
+silently:
+
+* ``gnot_tpu/obs/events.py`` — every event kind a ``MetricsSink``
+  record may carry (name, required payload fields, emitting module);
+* ``gnot_tpu/resilience/faults.py::FAULT_KINDS`` — every injectable
+  fault kind.
+
+The rule enforces, per file: every event kind passed to
+``sink.log(event=...)`` / ``self._event(...)`` / ``on_event(event=...)``
+resolves to a registry entry (string literals and ``events.<CONST>``
+references both). Project-wide: every registry entry appears in the
+user-facing docs (``docs/observability.md`` for events,
+``docs/robustness.md`` for fault kinds) — the docs are part of the
+contract, so adding a kind without documenting it fails tier-1.
+
+Registries are read by AST, not import: the linter must not pay a
+jax/numpy import to check a string table.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from gnot_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    ProjectContext,
+    Rule,
+    register,
+    terminal_name,
+)
+
+
+def _parse_string_constants(tree: ast.AST) -> dict[str, str]:
+    """Top-level ``NAME = "value"`` string assignments."""
+    out: dict[str, str] = {}
+    for node in tree.body if isinstance(tree, ast.Module) else []:
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = node.value.value
+    return out
+
+
+def _parse_registry(path: str) -> tuple[dict[str, int], dict[str, str]]:
+    """``(kinds, constants)`` from a registry module's source:
+    ``kinds`` maps each registered kind to its declaration line —
+    EVENTS dict keys, or FAULT_KINDS/KINDS tuple entries — and
+    ``constants`` maps module-level constant names to kind strings."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return {}, {}
+    kinds: dict[str, int] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            names = {t.id for t in node.targets if isinstance(t, ast.Name)}
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            names = {node.target.id}
+        else:
+            continue
+        if node.value is None:
+            continue
+        if "EVENTS" in names and isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    kinds[k.value] = k.lineno
+        if names & {"FAULT_KINDS", "KINDS"} and isinstance(
+            node.value, (ast.Tuple, ast.List)
+        ):
+            for e in node.value.elts:
+                if isinstance(e, ast.Constant) and isinstance(e.value, str):
+                    kinds[e.value] = e.lineno
+    return kinds, _parse_string_constants(tree)
+
+
+class _EmitSite:
+    __slots__ = ("kind", "line")
+
+    def __init__(self, kind: str, line: int):
+        self.kind = kind
+        self.line = line
+
+
+def _emitted_kinds(
+    ctx: FileContext, constants: dict[str, str]
+) -> list[_EmitSite]:
+    """Event kinds this file passes to a sink: ``*.log(event=X)``,
+    ``*._event(X, ...)``, ``*.on_event(event=X)``. ``X`` may be a
+    string literal, an ``events.<CONST>`` attribute, or a bare
+    imported constant name; dynamic values (locals, parameters) are
+    skipped — they are checked at their own literal origin."""
+    sites: list[_EmitSite] = []
+
+    def resolve(node: ast.AST) -> str | None:
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        name = None
+        if isinstance(node, ast.Attribute):
+            name = node.attr
+        elif isinstance(node, ast.Name):
+            name = node.id
+        if name is not None and name in constants:
+            return constants[name]
+        return None
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        attr = terminal_name(node.func)
+        expr: ast.AST | None = None
+        if attr in ("log", "on_event"):
+            for kw in node.keywords:
+                if kw.arg == "event":
+                    expr = kw.value
+        elif attr == "_event" and node.args:
+            expr = node.args[0]
+        if expr is None:
+            continue
+        kind = resolve(expr)
+        if kind is not None:
+            sites.append(_EmitSite(kind, expr.lineno))
+    return sites
+
+
+@register
+class RegistryDrift(Rule):
+    id = "GL005"
+    title = "registry-drift"
+    hint = (
+        "add the kind to gnot_tpu/obs/events.py (events) or "
+        "resilience/faults.py::FAULT_KINDS (faults), and document it "
+        "in docs/observability.md / docs/robustness.md"
+    )
+
+    def __init__(self) -> None:
+        self._event_kinds: dict[str, dict[str, int]] = {}
+        self._constants: dict[str, dict[str, str]] = {}
+
+    def _registry(self, root: str, cfg) -> tuple[dict[str, int], dict[str, str]]:
+        key = root
+        if key not in self._event_kinds:
+            kinds, constants = _parse_registry(
+                os.path.join(root, cfg.events_registry)
+            )
+            self._event_kinds[key] = kinds
+            self._constants[key] = constants
+        return self._event_kinds[key], self._constants[key]
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        kinds, constants = self._registry(ctx.root, ctx.config)
+        if not kinds:
+            # No registry in this tree (fixture sandboxes): the
+            # project-level pass reports the missing registry instead.
+            return []
+        findings = []
+        for site in _emitted_kinds(ctx, constants):
+            if site.kind not in kinds:
+                findings.append(
+                    Finding(
+                        rule=self.id,
+                        path=ctx.path,
+                        line=site.line,
+                        message=(
+                            f"event kind {site.kind!r} is not in the "
+                            f"central registry ({ctx.config.events_registry})"
+                        ),
+                        hint=self.hint,
+                    )
+                )
+        return findings
+
+    def check_project(self, project: ProjectContext) -> list[Finding]:
+        cfg = project.config
+        findings: list[Finding] = []
+        ev_path = os.path.join(project.root, cfg.events_registry)
+        if not os.path.exists(ev_path):
+            return []  # fixture sandboxes carry no registry
+        kinds, _ = self._registry(project.root, cfg)
+        if not kinds:
+            # The registry EXISTS but EVENTS did not parse as a literal
+            # dict: the per-file emit checks were all vacuous this run.
+            # That must be a loud finding, not a silent rule shutdown.
+            return [
+                Finding(
+                    rule=self.id,
+                    path=cfg.events_registry,
+                    line=1,
+                    message=(
+                        "EVENTS is not parseable as a literal dict of "
+                        "string keys — GL005 cannot check emit sites "
+                        "against it"
+                    ),
+                    hint="keep EVENTS a literal {str: EventSpec} dict",
+                )
+            ]
+        findings.extend(
+            self._docs_coverage(
+                project.root, cfg.events_registry, kinds, cfg.docs_events
+            )
+        )
+        fault_kinds, _ = _parse_registry(
+            os.path.join(project.root, cfg.faults_registry)
+        )
+        findings.extend(
+            self._docs_coverage(
+                project.root, cfg.faults_registry, fault_kinds, cfg.docs_faults
+            )
+        )
+        return findings
+
+    def _docs_coverage(
+        self, root: str, reg_rel: str, kinds: dict[str, int], doc_rel: str
+    ) -> list[Finding]:
+        doc_path = os.path.join(root, doc_rel)
+        try:
+            with open(doc_path, encoding="utf-8") as f:
+                doc = f.read()
+        except OSError:
+            return [
+                Finding(
+                    rule=self.id,
+                    path=reg_rel,
+                    line=1,
+                    message=f"registry documented in missing file {doc_rel}",
+                    hint=self.hint,
+                )
+            ]
+        return [
+            Finding(
+                rule=self.id,
+                path=reg_rel,
+                line=line,
+                message=(
+                    f"registry entry {kind!r} is not documented in "
+                    f"{doc_rel}"
+                ),
+                hint=self.hint,
+            )
+            for kind, line in sorted(kinds.items(), key=lambda kv: kv[1])
+            # "Documented" = appears as a code token: `kind` exactly, or
+            # `kind@...` (the fault-spec form). A bare prose mention
+            # ("reloads are retried") must NOT count.
+            if not re.search(rf"`{re.escape(kind)}[`@]", doc)
+        ]

@@ -1,0 +1,209 @@
+"""THE central registry of MetricsSink event kinds.
+
+Every ``event="..."`` record any module writes through the sink must be
+declared here — name, required payload fields, emitting module — and
+every entry here must be documented in docs/observability.md (serve
+events also in docs/serving.md). The graftlint rule **GL005**
+(``gnot_tpu/analysis/registry_drift.py``) enforces both directions in
+tier-1, and ``tests/test_obs.py`` validates emitted payloads against
+the specs, so a new event kind cannot ship undeclared, undocumented,
+or under-populated.
+
+Emit sites reference the module-level constants (``events.ROLLBACK``),
+never fresh string literals — one rename touches one file. The module
+is stdlib-only by design: the linter AST-parses it and the registry
+must never pull jax into a bare ``tools/lint.py`` run.
+
+The fault-kind counterpart lives in
+``gnot_tpu/resilience/faults.py::FAULT_KINDS`` (documented in
+docs/robustness.md, same GL005 enforcement).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# -- kind constants (the only spellings emit sites may use) ----------------
+
+SLOW_STEP = "slow_step"
+RECOMPILE = "recompile"
+NON_FINITE_LOSS = "non_finite_loss"
+HOST_SKEW = "host_skew"
+ROLLBACK = "rollback"
+BATCH_QUARANTINED = "batch_quarantined"
+RECOVERY_RESTORE = "recovery_restore"
+PREEMPT_SAVE = "preempt_save"
+RESTORE = "restore"
+RESTORE_FALLBACK = "restore_fallback"
+IO_RETRY = "io_retry"
+QUEUE_DEPTH = "queue_depth"
+SHED = "shed"
+BREAKER_OPEN = "breaker_open"
+BREAKER_CLOSE = "breaker_close"
+DRAIN_TIMEOUT = "drain_timeout"
+RELOAD = "reload"
+SERVE_SUMMARY = "serve_summary"
+
+
+@dataclasses.dataclass(frozen=True)
+class EventSpec:
+    """One event kind: the payload keys every record MUST carry (extra
+    keys are always allowed — ``shed`` attaches per-reason detail, the
+    ``recompile`` event dynamic ``compiles/<fn>`` counters), the module
+    that emits it, and the one-line description the docs table renders.
+    """
+
+    fields: tuple[str, ...]
+    module: str
+    doc: str
+
+
+#: kind -> spec. Keys are string literals ON PURPOSE: graftlint's GL005
+#: reads this dict via ``ast`` without importing the package.
+EVENTS: dict[str, EventSpec] = {
+    "slow_step": EventSpec(
+        fields=("step", "epoch", "step_time_s", "median_s", "slowdown"),
+        module="gnot_tpu/obs/telemetry.py",
+        doc="dispatch interval exceeded 3x the rolling median",
+    ),
+    "recompile": EventSpec(
+        fields=("epoch",),
+        module="gnot_tpu/train/trainer.py",
+        doc="a jitted step re-traced mid-run (shape leak); "
+        "`compiles/<fn>` carry the per-fn deltas",
+    ),
+    "non_finite_loss": EventSpec(
+        fields=("step", "epoch", "loss", "detail"),
+        module="gnot_tpu/train/trainer.py",
+        doc="NaN watchdog abort; `detail` is the checkify localization",
+    ),
+    "host_skew": EventSpec(
+        fields=("epoch", "step_time_per_host", "skew_s"),
+        module="gnot_tpu/train/trainer.py",
+        doc="per-host epoch step-time gauge (multi-process runs)",
+    ),
+    "rollback": EventSpec(
+        fields=("epoch", "step", "to_step", "rollbacks_used"),
+        module="gnot_tpu/train/trainer.py",
+        doc="recovery rolled back to the last-good snapshot",
+    ),
+    "batch_quarantined": EventSpec(
+        fields=("epoch", "step", "ordinal"),
+        module="gnot_tpu/train/trainer.py",
+        doc="the offending dispatch is skipped on replay",
+    ),
+    "recovery_restore": EventSpec(
+        fields=("epoch", "step", "restored_epoch", "restored_from"),
+        module="gnot_tpu/train/trainer.py",
+        doc="rollback budget exhausted; restored from checkpoint",
+    ),
+    "preempt_save": EventSpec(
+        fields=("epoch", "step", "resumable"),
+        module="gnot_tpu/train/trainer.py",
+        doc="graceful SIGTERM/SIGINT stop saved `latest`",
+    ),
+    "restore": EventSpec(
+        fields=(
+            "requested", "name", "dir", "epoch", "best_metric", "fallback",
+            "skipped",
+        ),
+        module="gnot_tpu/train/checkpoint.py",
+        doc="clean (sidecar-named) checkpoint restore",
+    ),
+    "restore_fallback": EventSpec(
+        fields=(
+            "requested", "name", "dir", "epoch", "best_metric", "fallback",
+            "skipped",
+        ),
+        module="gnot_tpu/train/checkpoint.py",
+        doc="restore walked past corrupt/missing candidates",
+    ),
+    "io_retry": EventSpec(
+        fields=("op", "attempt", "error"),
+        module="gnot_tpu/train/checkpoint.py",
+        doc="transient checkpoint-I/O failure retried with backoff",
+    ),
+    "queue_depth": EventSpec(
+        fields=("depth", "batched", "dispatch", "bucket_nodes",
+                "bucket_funcs", "n"),
+        module="gnot_tpu/serve/server.py",
+        doc="one serving dispatch (depth at flush + its bucket)",
+    ),
+    "shed": EventSpec(
+        fields=("reason",),
+        module="gnot_tpu/serve/server.py",
+        doc="a request was shed/rejected (reason + per-reason detail)",
+    ),
+    "breaker_open": EventSpec(
+        fields=("state", "reason", "detail", "trips"),
+        module="gnot_tpu/serve/server.py",
+        doc="circuit breaker tripped open (backend unhealthy)",
+    ),
+    "breaker_close": EventSpec(
+        fields=("state",),
+        module="gnot_tpu/serve/server.py",
+        doc="half-open trial succeeded; breaker closed",
+    ),
+    "drain_timeout": EventSpec(
+        fields=("timeout_s",),
+        module="gnot_tpu/serve/server.py",
+        doc="graceful drain exceeded its budget (wedged dispatch)",
+    ),
+    "reload": EventSpec(
+        fields=("ok", "reload", "duration_ms"),
+        module="gnot_tpu/serve/server.py",
+        doc="hot weight reload (+ restore provenance when ok)",
+    ),
+    "serve_summary": EventSpec(
+        fields=(
+            "requests", "admitted", "completed", "shed", "dispatches",
+            "reloads", "breaker_trips", "compiled_shapes",
+            "latency_p50_ms", "latency_p99_ms",
+        ),
+        module="gnot_tpu/serve/server.py",
+        doc="end-of-serve rollup emitted on drain",
+    ),
+}
+
+# A constant and a dict key drifting apart would defeat the registry;
+# cheap to assert once at import (stdlib only, no jax in the loop).
+_CONSTANT_KINDS = {
+    v for k, v in vars().items() if k.isupper() and isinstance(v, str)
+}
+assert _CONSTANT_KINDS == set(EVENTS), (
+    "obs/events.py constants and EVENTS keys drifted: "
+    f"{sorted(_CONSTANT_KINDS ^ set(EVENTS))}"
+)
+
+
+def validate_record(record: dict) -> list[str]:
+    """Missing-field / unknown-kind problems for one sink record (empty
+    list = valid). Non-event records (no ``event`` key — step/epoch
+    metrics) always validate."""
+    kind = record.get("event")
+    if kind is None:
+        return []
+    spec = EVENTS.get(kind)
+    if spec is None:
+        return [f"unknown event kind {kind!r}"]
+    return [
+        f"event {kind!r} missing required field {f!r}"
+        for f in spec.fields
+        if f not in record
+    ]
+
+
+def markdown_table() -> str:
+    """The docs/observability.md event table, generated from the
+    registry so the docs cannot drift from the code (GL005 checks the
+    reverse direction — every kind mentioned in the doc)."""
+    lines = [
+        "| event | required fields | emitted by | meaning |",
+        "|---|---|---|---|",
+    ]
+    for kind, spec in EVENTS.items():
+        fields = ", ".join(f"`{f}`" for f in spec.fields)
+        lines.append(
+            f"| `{kind}` | {fields} | `{spec.module}` | {spec.doc} |"
+        )
+    return "\n".join(lines)

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 
+from gnot_tpu.obs import events
 from gnot_tpu.ops.segment import LOSSES
 
 
@@ -260,7 +261,7 @@ class TelemetryBuffer:
                 outlier = self._slow.observe(e["dt"])
                 if outlier is not None and self.sink is not None:
                     self.sink.log(
-                        event="slow_step", step=e["steps"][-1],
+                        event=events.SLOW_STEP, step=e["steps"][-1],
                         epoch=e["epoch"], **outlier,
                     )
             loss = np.atleast_1d(np.asarray(loss))

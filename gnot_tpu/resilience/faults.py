@@ -55,7 +55,10 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
-KINDS = (
+#: THE central registry of injectable fault kinds (the event-kind
+#: counterpart is gnot_tpu/obs/events.py). graftlint's GL005 enforces
+#: that every entry here is documented in docs/robustness.md.
+FAULT_KINDS = (
     "nan_grad",
     "bad_sample",
     "sigterm",
@@ -68,6 +71,8 @@ KINDS = (
     "reload_corrupt",
 )
 
+KINDS = FAULT_KINDS  # legacy alias
+
 
 class InjectedIOError(OSError):
     """A deliberately injected transient I/O failure (subclass of
@@ -77,7 +82,7 @@ class InjectedIOError(OSError):
 
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
-    kind: str  # one of KINDS
+    kind: str  # one of FAULT_KINDS
     at: int  # step / epoch / error budget, per kind
 
 
@@ -87,10 +92,10 @@ def parse_fault_spec(spec: str) -> list[FaultSpec]:
     out: list[FaultSpec] = []
     for entry in filter(None, (e.strip() for e in spec.split(","))):
         kind, sep, arg = entry.partition("@")
-        if not sep or kind not in KINDS or not arg.lstrip("-").isdigit():
+        if not sep or kind not in FAULT_KINDS or not arg.lstrip("-").isdigit():
             raise ValueError(
                 f"bad fault spec entry {entry!r}: want kind@N with kind in "
-                f"{KINDS} and integer N (got spec {spec!r})"
+                f"{FAULT_KINDS} and integer N (got spec {spec!r})"
             )
         at = int(arg)
         if at < 1:

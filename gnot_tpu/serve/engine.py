@@ -82,12 +82,16 @@ class InferenceEngine:
         self.group_pad = group_pad
         self.n_proc = n_proc
         self.p_idx = p_idx
-        self._params = params
-        self._lock = threading.Lock()
+        self._lock = threading.Lock()  # published params + shape log
+        # The published weight reference: swapped by reload callers,
+        # read by the dispatch threads (graftlint GL004 enforces the
+        # guarded_by annotation).
+        self._params = params  #: guarded_by _lock
         # Distinct (B, L, Lf) dispatch signatures — a host-side proxy
         # for the number of XLA programs this engine forced. The chaos
-        # suite bounds it by the bucket count.
-        self._shapes: set[tuple] = set()
+        # suite bounds it by the bucket count; mutated by whichever
+        # thread dispatches, read by the server's summary thread.
+        self._shapes: set[tuple] = set()  #: guarded_by _lock
 
     # -- params ------------------------------------------------------------
 
@@ -137,7 +141,8 @@ class InferenceEngine:
     def compiled_shapes(self) -> int:
         """Distinct dispatch shapes seen so far (compiled-program
         bound proxy; one XLA program per entry)."""
-        return len(self._shapes)
+        with self._lock:
+            return len(self._shapes)
 
     # -- the serving hot path ----------------------------------------------
 
@@ -175,9 +180,9 @@ class InferenceEngine:
         return [out[i, : s.coords.shape[0]] for i, s in enumerate(reqs)]
 
     def _note_shape(self, batch) -> None:
-        self._shapes.add(
-            tuple(np.shape(l) for l in jax.tree.leaves(batch))
-        )
+        key = tuple(np.shape(l) for l in jax.tree.leaves(batch))
+        with self._lock:
+            self._shapes.add(key)
 
     def warmup(
         self, samples: Sequence[MeshSample], *, rows: int | None = None

@@ -42,6 +42,7 @@ from typing import Callable
 import numpy as np
 
 from gnot_tpu.data.batch import MeshSample
+from gnot_tpu.obs import events
 from gnot_tpu.serve.batcher import Batcher
 from gnot_tpu.serve.engine import InferenceEngine
 from gnot_tpu.serve.policies import (
@@ -138,14 +139,16 @@ class InferenceServer:
         self._worker: threading.Thread | None = None
         self._draining = threading.Event()
         self._drained = threading.Event()
-        # Counters for serve_summary.
-        self._submitted = 0
-        self._admitted = 0
-        self._completed = 0
-        self._shed: dict[str, int] = {}
-        self._dispatches = 0
-        self._reloads = 0
-        self._latencies_ms: list[float] = []
+        # Counters for serve_summary — shared between the client
+        # threads (submit/reload/drain) and the worker (graftlint GL004
+        # enforces the guarded_by annotations).
+        self._submitted = 0  #: guarded_by _lock
+        self._admitted = 0  #: guarded_by _lock
+        self._completed = 0  #: guarded_by _lock
+        self._shed: dict[str, int] = {}  #: guarded_by _lock
+        self._dispatches = 0  #: guarded_by _lock
+        self._reloads = 0  #: guarded_by _lock
+        self._latencies_ms: list[float] = []  #: guarded_by _lock
 
     # -- client side -------------------------------------------------------
 
@@ -175,14 +178,14 @@ class InferenceServer:
         try:
             self.engine.validate([sample])
         except ValueError as err:
-            self._event("shed", reason="rejected_invalid", detail=str(err))
+            self._event(events.SHED, reason="rejected_invalid", detail=str(err))
             return self._resolve_now(
                 fut, "rejected_invalid", now, detail=str(err)
             )
         if not self.admission.try_admit():
             self._count_shed("shed_queue_full")
             self._event(
-                "shed",
+                events.SHED,
                 reason="shed_queue_full",
                 depth=self.admission.depth,
                 limit=self.admission.limit,
@@ -247,7 +250,7 @@ class InferenceServer:
         if ok:
             self.engine.swap_params(params)
         self._event(
-            "reload",
+            events.RELOAD,
             ok=ok,
             reload=ordinal,
             duration_ms=(self._clock() - t0) * 1e3,
@@ -271,7 +274,7 @@ class InferenceServer:
                 # batcher/queue — sweeping them from here would race it
                 # (double-finish, concurrent Batcher mutation); report
                 # and return what we have instead.
-                self._event("drain_timeout", timeout_s=timeout_s)
+                self._event(events.DRAIN_TIMEOUT, timeout_s=timeout_s)
                 return self._summary(emit=not self._drained.is_set())
         # The worker has exited (or never ran): resolve anything still
         # queued or batched — a request must NEVER be left hanging.
@@ -359,7 +362,7 @@ class InferenceServer:
                 self._finish(r, ServeResult(ok=False, reason="shed_deadline"))
                 self._count_shed("shed_deadline")
                 self._event(
-                    "shed", reason="shed_deadline", ordinal=r.ordinal,
+                    events.SHED, reason="shed_deadline", ordinal=r.ordinal,
                     waited_ms=(now - r.submitted) * 1e3,
                 )
             else:
@@ -378,14 +381,14 @@ class InferenceServer:
                 )
             self._count_shed("rejected_breaker_open", n=len(live))
             self._event(
-                "shed", reason="rejected_breaker_open", n=len(live)
+                events.SHED, reason="rejected_breaker_open", n=len(live)
             )
             return
         with self._lock:
             self._dispatches += 1
             dispatch = self._dispatches
         self._event(
-            "queue_depth",
+            events.QUEUE_DEPTH,
             depth=self.admission.depth,
             batched=len(self.batcher),
             dispatch=dispatch,
@@ -419,17 +422,17 @@ class InferenceServer:
             )
             return
         if self.breaker.record_success():
-            self._event("breaker_close", state="closed")
+            self._event(events.BREAKER_CLOSE, state="closed")
         done = self._clock()
         for r, o in zip(live, outs):
             lat = (done - r.submitted) * 1e3
-            self._latencies_ms.append(lat)
+            with self._lock:
+                self._latencies_ms.append(lat)
+                self._completed += 1
             self._finish(
                 r,
                 ServeResult(ok=True, reason="ok", output=o, latency_ms=lat),
             )
-            with self._lock:
-                self._completed += 1
 
     def _fail_dispatch(self, reqs, reason: str, detail: str) -> None:
         """A whole-dispatch failure: every rider gets a degraded
@@ -440,7 +443,7 @@ class InferenceServer:
         self._count_shed(reason, n=len(reqs))
         if self.breaker.record_failure():
             self._event(
-                "breaker_open",
+                events.BREAKER_OPEN,
                 state="open",
                 reason=reason,
                 detail=detail,
@@ -470,21 +473,27 @@ class InferenceServer:
             self.sink.log(event=event, **fields)
 
     def _summary(self, *, emit: bool) -> dict:
-        lat = np.asarray(self._latencies_ms, dtype=np.float64)
-        summary = {
-            "requests": self._submitted,
-            "admitted": self._admitted,
-            "completed": self._completed,
-            "shed": dict(self._shed),
-            "dispatches": self._dispatches,
-            "reloads": self._reloads,
-            "breaker_trips": self.breaker.trips,
-            "compiled_shapes": self.engine.compiled_shapes,
-            "latency_p50_ms": float(np.percentile(lat, 50)) if lat.size else None,
-            "latency_p99_ms": float(np.percentile(lat, 99)) if lat.size else None,
-        }
+        # Snapshot the shared counters under the lock (drain() may be
+        # summarizing while a wedged worker still mutates them — the
+        # drain_timeout path); the percentile math runs on the copies.
+        with self._lock:
+            lat = np.asarray(self._latencies_ms, dtype=np.float64)
+            summary = {
+                "requests": self._submitted,
+                "admitted": self._admitted,
+                "completed": self._completed,
+                "shed": dict(self._shed),
+                "dispatches": self._dispatches,
+                "reloads": self._reloads,
+            }
+        summary.update(
+            breaker_trips=self.breaker.trips,
+            compiled_shapes=self.engine.compiled_shapes,
+            latency_p50_ms=float(np.percentile(lat, 50)) if lat.size else None,
+            latency_p99_ms=float(np.percentile(lat, 99)) if lat.size else None,
+        )
         if emit:
-            self._event("serve_summary", **summary)
+            self._event(events.SERVE_SUMMARY, **summary)
             if self.sink is not None:
                 self.sink.flush()
         return summary

@@ -46,6 +46,7 @@ from typing import Any, Callable
 import jax
 import orbax.checkpoint as ocp
 
+from gnot_tpu.obs import events
 from gnot_tpu.resilience.retry import RetryPolicy, retry_io
 
 logger = logging.getLogger(__name__)
@@ -110,7 +111,8 @@ class Checkpointer:
         def note(attempt_n: int, exc: BaseException) -> None:
             if self.on_event is not None:
                 self.on_event(
-                    event="io_retry", op=op, attempt=attempt_n, error=str(exc)
+                    event=events.IO_RETRY, op=op, attempt=attempt_n,
+                    error=str(exc),
                 )
 
         return retry_io(
@@ -384,7 +386,7 @@ class Checkpointer:
                 )
             if self.on_event is not None:
                 self.on_event(
-                    event="restore_fallback" if fallback else "restore",
+                    event=events.RESTORE_FALLBACK if fallback else events.RESTORE,
                     **self.last_restore,
                 )
             return state, int(meta["epoch"]), float(meta["best_metric"])

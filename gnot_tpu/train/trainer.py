@@ -29,6 +29,7 @@ import optax
 from gnot_tpu.config import Config, ModelConfig, OptimConfig
 from gnot_tpu.data.batch import Loader, MeshBatch
 from gnot_tpu.models.gnot import GNOT
+from gnot_tpu.obs import events
 from gnot_tpu.ops.segment import LOSSES, PER_SAMPLE_LOSSES
 from gnot_tpu.train.schedule import make_lr_fn
 from gnot_tpu.utils import profiling
@@ -1081,8 +1082,8 @@ class Trainer:
             )
         if self.metrics_sink is not None:
             self.metrics_sink.log(
-                event="non_finite_loss", step=step, epoch=epoch, loss=loss,
-                detail=detail,
+                event=events.NON_FINITE_LOSS, step=step, epoch=epoch,
+                loss=loss, detail=detail,
             )
             self.metrics_sink.flush()
         raise FloatingPointError(
@@ -1415,12 +1416,12 @@ class Trainer:
                         )
                         if self.metrics_sink is not None:
                             self.metrics_sink.log(
-                                event="rollback", epoch=epoch,
+                                event=events.ROLLBACK, epoch=epoch,
                                 step=err.step, to_step=snap.host_step,
                                 rollbacks_used=sup.rollbacks_used,
                             )
                             self.metrics_sink.log(
-                                event="batch_quarantined", epoch=epoch,
+                                event=events.BATCH_QUARANTINED, epoch=epoch,
                                 step=err.step, ordinal=bad,
                             )
             train_loss = float(
@@ -1453,7 +1454,7 @@ class Trainer:
                 )
                 if self.metrics_sink is not None:
                     self.metrics_sink.log(
-                        event="recompile", epoch=epoch,
+                        event=events.RECOMPILE, epoch=epoch,
                         **{f"compiles/{k}": v for k, v in deltas.items()},
                     )
         if self._telemetry is not None and jax.process_count() > 1:
@@ -1466,7 +1467,7 @@ class Trainer:
             )
             if self.metrics_sink is not None:
                 self.metrics_sink.log(
-                    event="host_skew", epoch=epoch,
+                    event=events.HOST_SKEW, epoch=epoch,
                     step_time_per_host=per_host,
                     skew_s=float(per_host.max() - per_host.min()),
                 )
@@ -1533,7 +1534,7 @@ class Trainer:
             self.checkpointer.wait()
         if self.metrics_sink is not None:
             self.metrics_sink.log(
-                event="preempt_save", epoch=stop.epoch, step=stop.step,
+                event=events.PREEMPT_SAVE, epoch=stop.epoch, step=stop.step,
                 resumable=self.checkpointer is not None and state is not None,
             )
             self.metrics_sink.flush()
@@ -1562,7 +1563,7 @@ class Trainer:
         )
         if self.metrics_sink is not None:
             self.metrics_sink.log(
-                event="recovery_restore", epoch=err.epoch, step=err.step,
+                event=events.RECOVERY_RESTORE, epoch=err.epoch, step=err.step,
                 restored_epoch=epoch,
                 restored_from=(self.checkpointer.last_restore or {}).get("dir"),
             )

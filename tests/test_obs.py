@@ -222,6 +222,87 @@ def test_nan_watchdog_localizes_and_records(tmp_path):
     assert len(events) == 1
     assert events[0]["step"] == 1 and events[0]["loss"] is None
     assert "nan" in events[0]["detail"]  # checkify localization
+    # Every record — event or metric — validates against the central
+    # registry (obs/events.py): required payload fields all present.
+    from gnot_tpu.obs import events as events_registry
+
+    for rec in read_jsonl(mp):
+        assert events_registry.validate_record(rec) == [], rec
+
+
+# --- event registry (obs/events.py) ---------------------------------------
+
+
+def test_event_registry_validate_record():
+    from gnot_tpu.obs import events
+
+    assert events.validate_record({"step": 1, "loss": 0.5}) == []  # metric
+    assert events.validate_record(
+        {"event": "rollback", "epoch": 0, "step": 3, "to_step": 1,
+         "rollbacks_used": 1, "ts": 0.0}
+    ) == []
+    missing = events.validate_record({"event": "rollback", "epoch": 0})
+    assert len(missing) == 3  # step, to_step, rollbacks_used
+    assert events.validate_record({"event": "not_a_kind"}) == [
+        "unknown event kind 'not_a_kind'"
+    ]
+
+
+def test_event_registry_matches_serving_reasons():
+    """The `shed` family's reason strings are serve/server.py REASONS —
+    the registry requires the `reason` field, the server provides it
+    from its own closed vocabulary."""
+    from gnot_tpu.obs import events
+    from gnot_tpu.serve.server import REASONS
+
+    assert "reason" in events.EVENTS["shed"].fields
+    assert "ok" in REASONS and "shed_deadline" in REASONS
+
+
+def test_event_table_in_docs_is_generated():
+    """docs/observability.md embeds events.markdown_table() VERBATIM:
+    adding or changing a kind without regenerating the docs table
+    fails here (and GL005 catches the registry/docs direction)."""
+    from gnot_tpu.obs import events
+
+    doc_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "docs", "observability.md",
+    )
+    with open(doc_path) as f:
+        doc = f.read()
+    assert events.markdown_table() in doc
+
+
+def test_serve_events_validate_against_registry(tmp_path):
+    """A serving run's event stream (dispatch, shed, summary) validates
+    against the registry specs. The forward is a stub — the events
+    under test come from the server/batcher machinery, and skipping the
+    XLA compile keeps this inside the tier-1 time budget."""
+    from gnot_tpu.obs import events as events_registry
+    from gnot_tpu.serve import InferenceEngine, InferenceServer
+
+    samples = datasets.synth_darcy2d(4, seed=0, grid_n=8)
+    fake_forward = lambda params, batch: np.zeros(
+        (batch.coords.shape[0], batch.coords.shape[1], 1)
+    )
+    engine = InferenceEngine(
+        None, None, batch_size=2, forward=fake_forward
+    )
+    mp = str(tmp_path / "serve.jsonl")
+    with MetricsSink(mp) as sink:
+        server = InferenceServer(
+            engine, max_batch=2, max_wait_ms=5.0, sink=sink
+        ).start()
+        futs = [server.submit(s) for s in samples]
+        for f in futs:
+            assert f.result(timeout=60).ok
+        server.drain()
+    recs = read_jsonl(mp)
+    assert any(r.get("event") == "serve_summary" for r in recs)
+    assert any(r.get("event") == "queue_depth" for r in recs)
+    for rec in recs:
+        assert events_registry.validate_record(rec) == [], rec
 
 
 # --- health monitors ------------------------------------------------------

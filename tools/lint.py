@@ -1,0 +1,110 @@
+#!/usr/bin/env python
+"""graftlint CLI: run the JAX-aware static-analysis suite.
+
+Usage::
+
+    python tools/lint.py gnot_tpu                 # lint the package
+    python tools/lint.py gnot_tpu --format json   # machine-readable
+    python tools/lint.py path/to/file.py --rules GL004
+
+Exit status: 0 when clean, 1 when any finding survives suppressions,
+2 on usage errors. Configuration lives in ``[tool.graftlint]`` in
+pyproject.toml (docs/static_analysis.md); ``--rules`` narrows the run
+to a comma-separated subset without touching the config.
+
+Tier-1 wiring: ``tests/test_analysis.py::test_repo_tree_is_clean``
+runs the same analysis in-process and asserts zero findings, so a new
+violation anywhere in ``gnot_tpu/`` fails the suite — the same
+mechanical gate FlashAttention-style kernel work needs around
+correctness (ISSUE 4 motivation).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# Import the analysis package WITHOUT executing gnot_tpu/__init__.py
+# (which pulls jax/flax — a multi-second import the linter never
+# needs). A namespace stub with the real __path__ lets the ordinary
+# `gnot_tpu.analysis.*` imports resolve; the analysis modules are
+# stdlib-only by design. Fine for this short-lived CLI process; the
+# in-process path (tests) imports the real package instead.
+if "gnot_tpu" not in sys.modules:
+    import types
+
+    _stub = types.ModuleType("gnot_tpu")
+    _stub.__path__ = [os.path.join(_REPO_ROOT, "gnot_tpu")]
+    sys.modules["gnot_tpu"] = _stub
+
+from gnot_tpu.analysis import load_config, run_analysis  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    parser.add_argument(
+        "paths", nargs="+", help="files or directories to analyze"
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="findings as human-readable lines or one JSON document",
+    )
+    parser.add_argument(
+        "--rules", default="",
+        help="comma-separated rule ids to run (default: config/all)",
+    )
+    parser.add_argument(
+        "--root", default=_REPO_ROOT,
+        help="repo root (pyproject.toml location; default: this repo)",
+    )
+    args = parser.parse_args(argv)
+
+    root = os.path.abspath(args.root)
+    config = load_config(root)
+    if args.rules:
+        # An explicit --rules request overrides BOTH config lists — a
+        # pyproject `disable` must not silently turn the run into a
+        # zero-rule false-clean.
+        config.enable = [r.strip().upper() for r in args.rules.split(",") if r.strip()]
+        config.disable = []
+    for p in args.paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if not os.path.exists(full):
+            print(f"graftlint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    findings, stats = run_analysis(args.paths, root=root, config=config)
+
+    if args.format == "json":
+        print(
+            json.dumps(
+                {
+                    "findings": [f.to_dict() for f in findings],
+                    "stats": stats,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.format())
+        print(
+            f"graftlint: {stats['findings']} finding(s) in "
+            f"{stats['files']} file(s) "
+            f"({stats['suppressed']} suppressed; rules: "
+            f"{', '.join(stats['rules'])})"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
