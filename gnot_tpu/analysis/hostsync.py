@@ -21,7 +21,13 @@ A function is **hot** when any of:
 * it is nested inside another hot function.
 
 Flagged inside hot code: ``.item()``; ``float/int/bool(x)`` on a
-non-literal; ``np.asarray`` / ``np.array``; ``jax.device_get``.
+non-literal; ``np.asarray`` / ``np.array``; ``jax.device_get``; and
+``Tracer`` span calls (``tracer.span(...)`` / ``.add_span`` /
+``.start_trace`` / ``.flush`` on any ``*tracer*``-named receiver) —
+tracing must stay host-side by construction: inside a compiled body a
+span would execute once at TRACE time (timing the Python trace, not
+the run) and its clock reads / locked buffer appends are host work the
+compiled step must never carry.
 """
 
 from __future__ import annotations
@@ -42,6 +48,13 @@ from gnot_tpu.analysis.core import (
 #: Call targets that wrap their first positional argument into compiled
 #: code (terminal name -> requires-lax-prefix?).
 _WRAPPERS = {"jit": False, "shard_map": False, "scan": True, "map": True}
+
+#: obs.tracing.Tracer's recording surface (span sites + the buffer
+#: flush). A call to any of these on a receiver whose dotted name
+#: mentions "tracer" (``tracer``, ``self._tracer``, ``cfg.tracer``)
+#: inside hot code is flagged: host-side tracing of traced-out code is
+#: a lie (runs once, at trace time) and pure host work besides.
+_TRACER_METHODS = ("span", "add_span", "start_trace", "timed_iter", "flush")
 
 
 def collect_hot_functions(ctx: FileContext) -> set[ast.AST]:
@@ -123,6 +136,16 @@ def _sync_violation(call: ast.Call) -> str | None:
             return f"`{base}.{name}(...)` materializes the value on host"
     if name == "device_get":
         return "`jax.device_get(...)` is a blocking device->host fetch"
+    if (
+        isinstance(func, ast.Attribute)
+        and func.attr in _TRACER_METHODS
+        and "tracer" in dotted_name(func.value).lower()
+    ):
+        return (
+            f"`Tracer.{func.attr}(...)` is host-side tracing — inside "
+            "compiled code it runs once at trace time (timing the "
+            "trace, not the execution) and adds host work per call"
+        )
     return None
 
 

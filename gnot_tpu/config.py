@@ -229,6 +229,17 @@ class TrainConfig:
     # reductions), so the perf-measurement default stays untouched.
     telemetry: bool = False
     profile_dir: str = ""  # jax.profiler trace output
+    # Host-side structured span tracing (obs/tracing.py): request-
+    # lifecycle spans on the serve path, per-step phase spans on the
+    # train path, exported as Chrome trace-event JSON to trace_path
+    # (chrome://tracing / Perfetto-loadable). Off by default ("" = no
+    # tracer object is built; the hot paths carry no span sites).
+    trace_path: str = ""
+    # Head-based sampling rate in [0, 1]: the keep/drop decision is
+    # made once per trace (per epoch when training, per request when
+    # serving), deterministically — no RNG — so overhead stays bounded
+    # and replays sample identically.
+    trace_sample_rate: float = 1.0
     # Debug-build numeric guard: jax_debug_nans — the first NaN/inf in
     # any step raises with the producing op's location instead of
     # silently propagating.
@@ -286,6 +297,11 @@ class TrainConfig:
         if self.preempt_sync_every < 1:
             raise ValueError(
                 f"preempt_sync_every must be >= 1, got {self.preempt_sync_every}"
+            )
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ValueError(
+                f"trace_sample_rate must be in [0, 1], got "
+                f"{self.trace_sample_rate}"
             )
 
 

@@ -239,6 +239,22 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--profile_dir", type=str, default="")
     p.add_argument(
+        "--trace_path", type=str, default="",
+        help="host-side structured span tracing (obs/tracing.py): write "
+             "a Chrome trace-event JSON here at exit — request-"
+             "lifecycle spans (admission..resolve) when serving, "
+             "per-step phase spans (data_iter/host_to_device/"
+             "step_dispatch/...) when training; open in "
+             "chrome://tracing or https://ui.perfetto.dev, summarize "
+             "with tools/trace_report.py (docs/observability.md)"
+    )
+    p.add_argument(
+        "--trace_sample_rate", type=float, default=1.0,
+        help="head-based trace sampling rate in [0,1] (decided once "
+             "per request/epoch, deterministically); lower it to bound "
+             "tracing overhead under storm traffic"
+    )
+    p.add_argument(
         "--debug_checks", action="store_true",
         help="jax_debug_nans mode: the first NaN/inf raises with the "
              "producing op's location (debug builds; disables donation "
@@ -325,6 +341,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "train.log_every": args.log_every,
             "train.telemetry": args.telemetry,
             "train.profile_dir": args.profile_dir,
+            "train.trace_path": args.trace_path,
+            "train.trace_sample_rate": args.trace_sample_rate,
             "train.debug_checks": args.debug_checks,
             "train.steps_per_dispatch": args.steps_per_dispatch,
             "train.seed": args.seed,
@@ -577,9 +595,41 @@ def main(argv=None) -> float:
                     "flat_params": args.flat_params,
                 },
             )
+        tracer = None
+        if cfg.train.trace_path and jax.process_index() == 0:
+            # Process-0-only like the sink: other hosts would pay span
+            # recording for a buffer nothing ever flushes (one trace
+            # file per run, written below by process 0).
+            from gnot_tpu.obs.tracing import Tracer
+
+            # annotate=True only under --profile_dir: spans then also
+            # appear on the XLA timeline (utils/profiling.annotate), so
+            # host phases align with device ops in the same viewer.
+            tracer = Tracer(
+                path=cfg.train.trace_path,
+                sample_rate=cfg.train.trace_sample_rate,
+                annotate=bool(cfg.train.profile_dir),
+            )
+
+            # On the ExitStack like the sink — a run that dies
+            # mid-flight (NaN watchdog, Ctrl-C) must still write the
+            # trace; those are exactly the runs whose phase spans
+            # matter. Registered AFTER the sink's enter_context, so on
+            # LIFO unwind the flush (and its trace_flush sink event)
+            # lands before the sink closes.
+            def _flush_trace(t=tracer):
+                path = t.flush(sink=sink)
+                print(
+                    f"Wrote {len(t.snapshot())} spans to {path} "
+                    "(open in chrome://tracing / "
+                    "https://ui.perfetto.dev; summarize with "
+                    "tools/trace_report.py)"
+                )
+
+            stack.callback(_flush_trace)
         trainer = Trainer(
             cfg, mc, train_samples, test_samples, metrics_sink=sink,
-            checkpointer=checkpointer,
+            checkpointer=checkpointer, tracer=tracer,
         )
         def write_run_manifest():
             # Provenance manifest — docs/observability.md.
@@ -618,7 +668,8 @@ def main(argv=None) -> float:
             write_run_manifest()
         if args.serve:
             result = _run_serve(
-                args, cfg, trainer, full_test_samples, sink, checkpointer
+                args, cfg, trainer, full_test_samples, sink, checkpointer,
+                tracer=tracer,
             )
             if manifests_on and checkpointer is not None:
                 # Record which checkpoint serving actually restored.
@@ -666,7 +717,9 @@ def main(argv=None) -> float:
     return result
 
 
-def _run_serve(args, cfg, trainer, samples, sink, checkpointer) -> float:
+def _run_serve(
+    args, cfg, trainer, samples, sink, checkpointer, tracer=None
+) -> float:
     """``--serve``: restore weights, start the fault-tolerant
     InferenceServer, drive the test set through it as a request stream
     (the in-process demo/smoke traffic — a network transport would sit
@@ -717,6 +770,7 @@ def _run_serve(args, cfg, trainer, samples, sink, checkpointer) -> float:
             ),
             faults=FaultInjector.from_spec(sc.inject_fault),
             preempt=preempt,
+            tracer=tracer,
         ).start()
         futures = []
         for i, s in enumerate(samples):

@@ -1,7 +1,8 @@
 """Observability subsystem: on-device telemetry, run manifests, health
-monitors (ROADMAP north star: every perf/parity PR must be debuggable).
+monitors, and host-side span tracing (ROADMAP north star: every
+perf/parity PR must be debuggable).
 
-Three pieces, all off the hot path by construction:
+Four pieces, all off the hot path by construction:
 
 * ``telemetry`` — model-internals scalars (grad/param/update norms,
   per-layer MoE gate load + entropy, padding waste) computed as side
@@ -13,4 +14,9 @@ Three pieces, all off the hot path by construction:
 * ``health`` — recompile detection (trace-counter deltas), slow-step
   outlier gauges, and a NaN watchdog that localizes the producing op by
   re-executing the offending batch under ``utils.debug.checked``.
+* ``tracing`` — request-lifecycle and per-step phase spans (host wall
+  time only, head-sampled, bounded buffer) exported as Chrome
+  trace-event JSON; ``tools/trace_report.py`` prints per-kind
+  percentiles, the per-bucket queue-wait/device split, and the
+  critical path of the slowest request or step.
 """

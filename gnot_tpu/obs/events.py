@@ -43,19 +43,25 @@ BREAKER_CLOSE = "breaker_close"
 DRAIN_TIMEOUT = "drain_timeout"
 RELOAD = "reload"
 SERVE_SUMMARY = "serve_summary"
+TRACE_FLUSH = "trace_flush"
 
 
 @dataclasses.dataclass(frozen=True)
 class EventSpec:
     """One event kind: the payload keys every record MUST carry (extra
     keys are always allowed — ``shed`` attaches per-reason detail, the
-    ``recompile`` event dynamic ``compiles/<fn>`` counters), the module
-    that emits it, and the one-line description the docs table renders.
+    ``recompile`` event dynamic ``compiles/<fn>`` counters), the
+    DECLARED-optional keys (present only when the emitting feature is
+    on — e.g. ``trace_id`` correlation keys exist only under
+    ``--trace_path``; declaring them keeps the docs table honest
+    without making tracing mandatory), the module that emits it, and
+    the one-line description the docs table renders.
     """
 
     fields: tuple[str, ...]
     module: str
     doc: str
+    optional: tuple[str, ...] = ()
 
 
 #: kind -> spec. Keys are string literals ON PURPOSE: graftlint's GL005
@@ -65,6 +71,7 @@ EVENTS: dict[str, EventSpec] = {
         fields=("step", "epoch", "step_time_s", "median_s", "slowdown"),
         module="gnot_tpu/obs/telemetry.py",
         doc="dispatch interval exceeded 3x the rolling median",
+        optional=("span_id",),
     ),
     "recompile": EventSpec(
         fields=("epoch",),
@@ -128,16 +135,19 @@ EVENTS: dict[str, EventSpec] = {
                 "bucket_funcs", "n"),
         module="gnot_tpu/serve/server.py",
         doc="one serving dispatch (depth at flush + its bucket)",
+        optional=("trace_ids",),
     ),
     "shed": EventSpec(
         fields=("reason",),
         module="gnot_tpu/serve/server.py",
         doc="a request was shed/rejected (reason + per-reason detail)",
+        optional=("trace_id", "trace_ids"),
     ),
     "breaker_open": EventSpec(
         fields=("state", "reason", "detail", "trips"),
         module="gnot_tpu/serve/server.py",
         doc="circuit breaker tripped open (backend unhealthy)",
+        optional=("trace_id",),
     ),
     "breaker_close": EventSpec(
         fields=("state",),
@@ -153,6 +163,7 @@ EVENTS: dict[str, EventSpec] = {
         fields=("ok", "reload", "duration_ms"),
         module="gnot_tpu/serve/server.py",
         doc="hot weight reload (+ restore provenance when ok)",
+        optional=("trace_id",),
     ),
     "serve_summary": EventSpec(
         fields=(
@@ -162,6 +173,12 @@ EVENTS: dict[str, EventSpec] = {
         ),
         module="gnot_tpu/serve/server.py",
         doc="end-of-serve rollup emitted on drain",
+        optional=("queue_device_by_bucket",),
+    ),
+    "trace_flush": EventSpec(
+        fields=("path", "spans", "dropped"),
+        module="gnot_tpu/obs/tracing.py",
+        doc="the span tracer wrote its Chrome trace-event JSON file",
     ),
 }
 
@@ -198,12 +215,13 @@ def markdown_table() -> str:
     registry so the docs cannot drift from the code (GL005 checks the
     reverse direction — every kind mentioned in the doc)."""
     lines = [
-        "| event | required fields | emitted by | meaning |",
-        "|---|---|---|---|",
+        "| event | required fields | optional fields | emitted by | meaning |",
+        "|---|---|---|---|---|",
     ]
     for kind, spec in EVENTS.items():
         fields = ", ".join(f"`{f}`" for f in spec.fields)
+        opt = ", ".join(f"`{f}`" for f in spec.optional) or "—"
         lines.append(
-            f"| `{kind}` | {fields} | `{spec.module}` | {spec.doc} |"
+            f"| `{kind}` | {fields} | {opt} | `{spec.module}` | {spec.doc} |"
         )
     return "\n".join(lines)

@@ -217,10 +217,15 @@ class TelemetryBuffer:
         self._on_nonfinite = on_nonfinite
         self._last_t: float | None = None
 
-    def append(self, *, steps, epoch, lrs, loss, telem, batches) -> None:
+    def append(
+        self, *, steps, epoch, lrs, loss, telem, batches, span_ids=None
+    ) -> None:
         """One dispatch's outputs: ``steps``/``lrs``/``batches`` are
         length-K lists (K=1 single step), ``loss``/``telem`` the device
-        outputs (stacked on a leading K axis for K > 1)."""
+        outputs (stacked on a leading K axis for K > 1). ``span_ids``
+        (optional, parallel to ``steps``) are the tracer span ids of
+        the dispatches — a ``slow_step`` outlier event then names the
+        span it indicts, so the alert points into the trace file."""
         now = time.perf_counter()
         dt = (now - self._last_t) / len(steps) if self._last_t is not None else None
         self._last_t = now
@@ -228,7 +233,8 @@ class TelemetryBuffer:
             batches = [None] * len(steps)
         self._entries.append(
             dict(steps=list(steps), epoch=epoch, lrs=list(lrs), loss=loss,
-                 telem=telem, batches=list(batches), dt=dt)
+                 telem=telem, batches=list(batches), dt=dt,
+                 span_ids=list(span_ids) if span_ids is not None else None)
         )
         self._pending_steps += len(steps)
         if self._pending_steps >= self.drain_every:
@@ -260,9 +266,12 @@ class TelemetryBuffer:
             if self._slow is not None and e["dt"] is not None:
                 outlier = self._slow.observe(e["dt"])
                 if outlier is not None and self.sink is not None:
+                    ids = e.get("span_ids") or []
+                    span_id = next((s for s in ids if s is not None), None)
                     self.sink.log(
                         event=events.SLOW_STEP, step=e["steps"][-1],
                         epoch=e["epoch"], **outlier,
+                        **({"span_id": span_id} if span_id else {}),
                     )
             loss = np.atleast_1d(np.asarray(loss))
             for i, step in enumerate(e["steps"]):

@@ -1,0 +1,387 @@
+"""Host-side structured span tracing — the third leg of the
+observability stack (metrics -> events -> traces).
+
+Telemetry (PR 1) says *that* a step was slow and the event stream
+(PR 2-4) says *that* a request was shed; neither says *where the time
+went*. GNOT's ragged point-cloud meshes make latency intrinsically
+shape-dependent — bucketed padding means queue-wait, pad waste, compile
+and device time all vary per bucket — so this module records wall-time
+spans on the HOST side of every phase and exports them as Chrome
+trace-event JSON (loadable in ``chrome://tracing`` / Perfetto, no
+TensorBoard required).
+
+Design constraints (docs/observability.md "Tracing"):
+
+* **No device syncs.** A span is two reads of an injectable monotonic
+  clock plus one locked list append. Nothing here touches jax values;
+  the graftlint rule GL002 flags any ``Tracer`` call that leaks inside
+  a compiled step body (host tracing of traced-out code is a lie — the
+  span would time the trace, not the execution).
+* **Head-based sampling.** The keep/drop decision is made once per
+  trace at :meth:`Tracer.start_trace` (deterministic, counter-based —
+  no RNG, so tests and replays sample identically); an unsampled trace
+  costs one ``None`` check per span site.
+* **Bounded buffer, explicit flush.** At most ``max_spans`` spans are
+  held in memory; further spans are counted as ``dropped`` instead of
+  growing without bound. :meth:`Tracer.flush` writes the file (and
+  optionally a ``trace_flush`` event through the MetricsSink).
+* **Device-timeline bridge.** With ``annotate=True`` every span also
+  enters ``utils/profiling.annotate`` (``jax.profiler``
+  TraceAnnotation), so when ``--profile_dir`` is set the host spans
+  appear on the XLA timeline under the same names.
+
+Span taxonomy (the contract ``tools/trace_report.py`` groups by):
+
+* serving, per request (one ``trace_id`` per submitted request):
+  ``admission -> queue_wait -> batch_assembly -> dispatch -> device ->
+  unpad -> resolve``; batch-level phases are recorded once per member
+  request with the member's ``trace_id`` and a ``member_trace_ids``
+  arg linking the co-dispatched requests.
+* training, per epoch (one ``trace_id`` per epoch): an ``epoch`` root
+  with ``data_iter`` / ``step`` (containing ``host_to_device`` and
+  ``step_dispatch``) / ``telemetry_drain`` / ``eval`` /
+  ``checkpoint_save`` children.
+
+Ambient nesting uses a :mod:`contextvars` context variable, so spans
+opened on one thread parent correctly under that thread's enclosing
+span while other threads (the serve worker vs. its clients) keep their
+own chains.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import json
+import math
+import os
+import threading
+import time
+from typing import Callable, Iterable, Iterator
+
+from gnot_tpu.obs import events
+
+#: Serve-side span names, in request-lifecycle order (docs/serving.md).
+SERVE_SPANS = (
+    "admission",
+    "queue_wait",
+    "batch_assembly",
+    "dispatch",
+    "device",
+    "unpad",
+    "resolve",
+)
+
+#: Train-side span names (docs/observability.md "Tracing").
+TRAIN_SPANS = (
+    "epoch",
+    "data_iter",
+    "step",
+    "host_to_device",
+    "step_dispatch",
+    "telemetry_drain",
+    "eval",
+    "checkpoint_save",
+)
+
+
+@dataclasses.dataclass
+class Span:
+    """One closed host-side span. Times are raw ``clock()`` seconds;
+    the Chrome export rebases them to the tracer's start."""
+
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str | None
+    start: float
+    end: float
+    tid: int
+    args: dict | None = None
+    #: Set inside a ``span()`` block to drop the span on exit (the
+    #: timed_iter exhaustion probe is not a data pull).
+    discard: bool = False
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end - self.start) * 1e3
+
+
+class Tracer:
+    """Thread-safe span recorder with deterministic head sampling.
+
+    ``clock`` is any monotonic ``() -> float`` (tests inject a fake);
+    ``sample_rate`` in [0, 1] keeps that fraction of traces, decided at
+    :meth:`start_trace` by a counter rule (trace ``n`` is kept iff
+    ``floor(n * rate) > floor((n - 1) * rate)`` — rate 1.0 keeps all,
+    0.25 keeps every 4th, 0 none; no RNG, so runs are replayable);
+    ``max_spans`` bounds host memory (overflow increments ``dropped``,
+    never blocks); ``annotate=True`` mirrors spans onto the jax
+    profiler timeline (lazy import — only pay for it under
+    ``--profile_dir``).
+    """
+
+    def __init__(
+        self,
+        *,
+        path: str = "",
+        sample_rate: float = 1.0,
+        max_spans: int = 100_000,
+        clock: Callable[[], float] = time.monotonic,
+        annotate: bool = False,
+    ):
+        if not 0.0 <= sample_rate <= 1.0:
+            raise ValueError(
+                f"sample_rate must be in [0, 1], got {sample_rate}"
+            )
+        if max_spans < 1:
+            raise ValueError(f"max_spans must be >= 1, got {max_spans}")
+        self.path = path
+        self.sample_rate = sample_rate
+        self.max_spans = max_spans
+        self._clock = clock
+        self._annotate = annotate
+        self._t0 = clock()
+        self._lock = threading.Lock()
+        self._spans: list[Span] = []  #: guarded_by _lock
+        self._dropped = 0  #: guarded_by _lock
+        # Per-stream sampling counters (stream = trace-id prefix):
+        # requests sample on "t", aux lifecycles (serve reloads) on
+        # "r", so aux traces never shift which requests head sampling
+        # keeps.
+        self._stream_seen: dict[str, int] = {}  #: guarded_by _lock
+        self._stream_kept: dict[str, int] = {}  #: guarded_by _lock
+        self._next_span = 0  #: guarded_by _lock
+        self._current: contextvars.ContextVar[Span | None] = (
+            contextvars.ContextVar("gnot_trace_span", default=None)
+        )
+
+    # -- trace / span creation ---------------------------------------------
+
+    def start_trace(self, stream: str = "t") -> str | None:
+        """Head-sampling decision point: returns a fresh ``trace_id``
+        when this trace is kept, ``None`` when sampled out. Callers
+        thread the id (or the None) through the whole lifecycle — every
+        downstream span call is a no-op for an unsampled trace.
+
+        ``stream`` is the id prefix AND the sampling population:
+        each stream counts (and floor-samples) independently, so e.g.
+        serve reloads (stream ``"r"``) never consume a request keep
+        slot — the documented request contract (rate 0.25 keeps
+        requests 4, 8, 12, …) holds regardless of aux traffic."""
+        with self._lock:
+            n = self._stream_seen.get(stream, 0) + 1
+            self._stream_seen[stream] = n
+            keep = math.floor(n * self.sample_rate) > math.floor(
+                (n - 1) * self.sample_rate
+            )
+            if not keep:
+                return None
+            kept = self._stream_kept.get(stream, 0) + 1
+            self._stream_kept[stream] = kept
+            return f"{stream}{kept:06d}"
+
+    def _new_span_id(self) -> str:
+        with self._lock:
+            self._next_span += 1
+            return f"s{self._next_span:06d}"
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, trace: str | None = None, args: dict | None = None):
+        """Context-managed span. ``trace`` pins the trace id (root
+        spans); omitted, it inherits the ambient (same-thread enclosing)
+        span's trace. No ambient and no ``trace`` — or an unsampled
+        ``trace=None`` — yields ``None`` and records nothing. The
+        ambient span becomes the parent when it shares the trace id."""
+        parent = self._current.get()
+        trace_id = trace if trace is not None else (
+            parent.trace_id if parent is not None else None
+        )
+        if trace_id is None:
+            yield None
+            return
+        parent_id = (
+            parent.span_id
+            if parent is not None and parent.trace_id == trace_id
+            else None
+        )
+        s = Span(
+            name=name,
+            trace_id=trace_id,
+            span_id=self._new_span_id(),
+            parent_id=parent_id,
+            start=self._clock(),
+            end=0.0,
+            tid=threading.get_ident(),
+            args=args,
+        )
+        token = self._current.set(s)
+        ann = None
+        if self._annotate:
+            from gnot_tpu.utils import profiling
+
+            ann = profiling.annotate(name)
+            ann.__enter__()
+        try:
+            yield s
+        finally:
+            if ann is not None:
+                ann.__exit__(None, None, None)
+            self._current.reset(token)
+            s.end = self._clock()
+            if not s.discard:
+                self._store(s)
+
+    def add_span(
+        self,
+        name: str,
+        start: float,
+        end: float,
+        *,
+        trace: str | None,
+        parent_id: str | None = None,
+        tid: int | None = None,
+        args: dict | None = None,
+    ) -> str | None:
+        """Record a span from timestamps measured elsewhere — the
+        cross-thread phases (a request's queue-wait starts on the
+        client thread and ends on the worker). Returns the span id, or
+        None for an unsampled trace."""
+        if trace is None:
+            return None
+        s = Span(
+            name=name,
+            trace_id=trace,
+            span_id=self._new_span_id(),
+            parent_id=parent_id,
+            start=start,
+            end=end,
+            tid=tid if tid is not None else threading.get_ident(),
+            args=args,
+        )
+        self._store(s)
+        return s.span_id
+
+    def timed_iter(
+        self, it: Iterable, name: str, *, trace: str | None
+    ) -> Iterator:
+        """Wrap an iterator so each ``next()`` is recorded as one
+        ``name`` span (the data-iteration phase: time the consumer
+        spent WAITING on the producer, prefetch included). The final
+        exhausted ``next()`` is discarded — N pulls export exactly N
+        spans, so per-kind counts in trace_report match step counts."""
+        it = iter(it)
+        _end = object()
+        while True:
+            with self.span(name, trace=trace) as sp:
+                item = next(it, _end)
+                if item is _end and sp is not None:
+                    sp.discard = True
+            if item is _end:
+                return
+            yield item
+
+    def _store(self, s: Span) -> None:
+        with self._lock:
+            if len(self._spans) < self.max_spans:
+                self._spans.append(s)
+            else:
+                self._dropped += 1
+
+    # -- inspection / export -----------------------------------------------
+
+    def snapshot(self) -> list[Span]:
+        with self._lock:
+            return list(self._spans)
+
+    @property
+    def dropped(self) -> int:
+        with self._lock:
+            return self._dropped
+
+    def export(self) -> dict:
+        """The buffered spans as a Chrome trace-event JSON object
+        (``traceEvents`` of ``ph: "X"`` complete events, microsecond
+        timestamps rebased to the earliest span start). Open the
+        written file directly in ``chrome://tracing`` or
+        https://ui.perfetto.dev — each OS thread renders as one track,
+        span args (trace_id, bucket, step, ...) show on click."""
+        with self._lock:
+            spans = list(self._spans)
+            dropped = self._dropped
+            kept = sum(self._stream_kept.values())
+            seen = sum(self._stream_seen.values())
+        # Rebase against the earliest span, NOT the tracer's own clock
+        # at construction: recorders stamp spans with their own
+        # injectable clock (InferenceServer's queue-wait arithmetic
+        # runs on the server clock), which need not share an epoch
+        # with the tracer's — only offsets within the span set mean
+        # anything.
+        t0 = min((s.start for s in spans), default=self._t0)
+        trace_events = [
+            {
+                "name": s.name,
+                "cat": "host",
+                "ph": "X",
+                "ts": round((s.start - t0) * 1e6, 3),
+                "dur": round((s.end - s.start) * 1e6, 3),
+                "pid": os.getpid(),
+                "tid": s.tid,
+                "args": {
+                    "trace_id": s.trace_id,
+                    "span_id": s.span_id,
+                    **({"parent_id": s.parent_id} if s.parent_id else {}),
+                    **(s.args or {}),
+                },
+            }
+            for s in spans
+        ]
+        return {
+            "traceEvents": trace_events,
+            "displayTimeUnit": "ms",
+            "otherData": {
+                "generator": "gnot_tpu.obs.tracing",
+                "sample_rate": self.sample_rate,
+                "traces_seen": seen,
+                "traces_kept": kept,
+                "spans_dropped": dropped,
+            },
+        }
+
+    def flush(self, sink=None) -> str | None:
+        """Write the Chrome trace file to ``self.path`` (no-op without
+        a path) and, given a sink, record a ``trace_flush`` event so
+        the metrics stream names the artifact. Buffered spans are
+        retained (flush is idempotent; the file is rewritten whole —
+        Chrome JSON is one object, not appendable)."""
+        if not self.path:
+            return None
+        out = self.export()
+        if d := os.path.dirname(self.path):
+            os.makedirs(d, exist_ok=True)
+        tmp = f"{self.path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(out, f)
+        os.replace(tmp, self.path)
+        if sink is not None:
+            sink.log(
+                event=events.TRACE_FLUSH,
+                path=self.path,
+                spans=len(out["traceEvents"]),
+                dropped=out["otherData"]["spans_dropped"],
+            )
+        return self.path
+
+
+def percentiles(values_ms: list[float]) -> dict:
+    """p50/p99 of a duration list without numpy (stdlib-only module):
+    nearest-rank on the sorted values. Empty -> Nones."""
+    if not values_ms:
+        return {"p50_ms": None, "p99_ms": None}
+    v = sorted(values_ms)
+    rank = lambda q: v[min(len(v) - 1, math.ceil(q * len(v)) - 1)]
+    return {
+        "p50_ms": round(rank(0.50), 4),
+        "p99_ms": round(rank(0.99), 4),
+    }

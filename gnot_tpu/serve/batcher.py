@@ -14,7 +14,11 @@ waited ``max_wait_ms``. Two invariants the chaos suite asserts:
 
 Pure data structure — no thread, no clock of its own (callers pass
 ``now``); the server's worker loop drives it. FIFO within a bucket, so
-per-bucket latency is arrival-ordered.
+per-bucket latency is arrival-ordered — which also makes the server's
+``queue_wait`` spans (obs/tracing.py: submit -> dispatch pop, the same
+interval the deadline shed reports as ``waited_ms``) monotone within a
+bucket: a request never overtakes an older batchmate, so a trace's
+queue-wait outlier always indicts real queueing, not reordering.
 """
 
 from __future__ import annotations

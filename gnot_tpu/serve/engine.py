@@ -27,6 +27,7 @@ in-flight requests always see one consistent weight set.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Sequence
 
 import jax
@@ -153,13 +154,25 @@ class InferenceEngine:
         pad_nodes: int,
         pad_funcs: int,
         rows: int | None = None,
+        timings: dict | None = None,
+        clock: Callable[[], float] | None = None,
     ) -> list[np.ndarray]:
         """ONE dispatch at the fully static shape ``(rows, pad_nodes,
         pad_funcs)``: short batches are padded to ``rows`` with repeats
         of the last sample (dropped on return), so a bucket compiles
         exactly one program no matter how full its flushes run.
         Returns per-sample UNPADDED outputs ``[n_i, out]``. Callers
-        (the server) validate and bucket upstream."""
+        (the server) validate and bucket upstream.
+
+        ``timings`` (tracing hook, obs/tracing.py): when a dict is
+        passed it is filled with ``phase -> (start, end)`` stamps for
+        ``batch_assembly`` (collate + pad), ``device`` (forward
+        dispatch + the blocking fetch — host wall-time until the
+        outputs landed), and ``unpad`` (per-sample slicing), read on
+        ``clock`` (the caller's monotonic clock; defaults to
+        ``time.monotonic``). ``timings=None`` (the default) stamps
+        nothing — the serving hot path is unchanged when tracing is
+        off."""
         reqs = list(samples)
         if not reqs:
             return []
@@ -168,6 +181,9 @@ class InferenceEngine:
             raise ValueError(
                 f"infer() got {len(reqs)} samples for a {rows}-row dispatch"
             )
+        tick = clock if clock is not None else time.monotonic
+        if timings is not None:
+            t0 = tick()
         batch = collate(
             reqs + [reqs[-1]] * (rows - len(reqs)),
             bucket=False,
@@ -176,8 +192,17 @@ class InferenceEngine:
         )
         self._note_shape(batch)
         params = self.params  # one consistent weight set per dispatch
+        if timings is not None:
+            t1 = tick()
+            timings["batch_assembly"] = (t0, t1)
         out = np.asarray(self._forward(params, self._device_put(batch)))
-        return [out[i, : s.coords.shape[0]] for i, s in enumerate(reqs)]
+        if timings is not None:
+            t2 = tick()
+            timings["device"] = (t1, t2)
+        outs = [out[i, : s.coords.shape[0]] for i, s in enumerate(reqs)]
+        if timings is not None:
+            timings["unpad"] = (t2, tick())
+        return outs
 
     def _note_shape(self, batch) -> None:
         key = tuple(np.shape(l) for l in jax.tree.leaves(batch))

@@ -31,6 +31,13 @@ class Deadline:
     def remaining_s(self, now: float) -> float:
         return max(0.0, self.at - now)
 
+    def remaining_ms(self, now: float) -> float:
+        """Milliseconds of budget left — the unit the serve events and
+        trace span args report in (a shed's ``waited_ms`` plus the
+        victim's ``remaining_ms`` at dispatch reconstructs the full
+        deadline arithmetic from the trace alone)."""
+        return self.remaining_s(now) * 1e3
+
 
 class AdmissionController:
     """Bounded-queue admission: at most ``limit`` requests in the

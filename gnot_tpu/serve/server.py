@@ -28,6 +28,16 @@ Every decision is observable: ``queue_depth`` / ``shed`` /
 events flow through the ordinary ``MetricsSink`` (schema in
 docs/serving.md), so serving runs leave the same JSONL/manifest trail
 training runs do.
+
+With a ``tracer`` (``obs/tracing.py``, ``--trace_path``) every request
+additionally gets a ``trace_id`` at submit and a host-side span chain
+``admission -> queue_wait -> batch_assembly -> dispatch -> device ->
+unpad -> resolve``; batch-level phases are recorded per member request
+with a ``member_trace_ids`` arg linking co-dispatched requests, shed/
+breaker/reload events carry the ``trace_id`` so the event stream and
+the trace correlate, and ``serve_summary`` gains the span-derived
+per-bucket queue-wait vs device-time breakdown. Tracing off
+(``tracer=None``, the default) leaves every path above untouched.
 """
 
 from __future__ import annotations
@@ -43,6 +53,7 @@ import numpy as np
 
 from gnot_tpu.data.batch import MeshSample
 from gnot_tpu.obs import events
+from gnot_tpu.obs.tracing import percentiles
 from gnot_tpu.serve.batcher import Batcher
 from gnot_tpu.serve.engine import InferenceEngine
 from gnot_tpu.serve.policies import (
@@ -84,6 +95,7 @@ class _Request:
     ordinal: int  # 1-indexed admission count (fault-injection key)
     submitted: float
     deadline: Deadline | None
+    trace: str | None = None  # tracer trace_id; None = off / unsampled
 
 
 class InferenceServer:
@@ -114,6 +126,7 @@ class InferenceServer:
         faults=None,
         preempt=None,
         clock: Callable[[], float] = time.monotonic,
+        tracer=None,
     ):
         self.engine = engine
         self.sink = sink
@@ -121,6 +134,11 @@ class InferenceServer:
         self.faults = faults
         self.preempt = preempt
         self._clock = clock
+        # obs.tracing.Tracer (or None = tracing off, zero added work).
+        # The tracer's own clock is independent; span timestamps here
+        # use OUR clock so queue-wait arithmetic is exact under the
+        # fake clocks the tests inject.
+        self._tracer = tracer
         self.default_deadline_ms = default_deadline_ms
         self.max_batch = max_batch
         self.admission = AdmissionController(queue_limit)
@@ -149,6 +167,15 @@ class InferenceServer:
         self._dispatches = 0  #: guarded_by _lock
         self._reloads = 0  #: guarded_by _lock
         self._latencies_ms: list[float] = []  #: guarded_by _lock
+        # Span-derived per-bucket timing for serve_summary: bucket key
+        # -> {"queue_ms": one wait per TRACED request (shed included),
+        # "device_ms": the dispatch's device time once per traced
+        # member}. The population and the (nearest-rank) percentiles
+        # mirror tools/trace_report.py's bucket_breakdown exactly, so
+        # the two views agree on any trace. Populated only when tracing
+        # is on; mutated by the worker, snapshotted by _summary on the
+        # drain thread.
+        self._bucket_stats: dict = {}  #: guarded_by _lock
 
     # -- client side -------------------------------------------------------
 
@@ -171,14 +198,25 @@ class InferenceServer:
         NaN-ing a whole batch of innocent neighbors)."""
         fut: Future = Future()
         now = self._clock()
+        # trace_id assignment happens AT SUBMIT (head sampling decides
+        # once, here); every later span/event for this request reuses
+        # it, so even a shed request's events correlate to its trace.
+        trace = (
+            self._tracer.start_trace() if self._tracer is not None else None
+        )
         with self._lock:
             self._submitted += 1
         if self._draining.is_set():
+            self._trace_span(trace, "admission", now, reason="rejected_draining")
             return self._resolve_now(fut, "rejected_draining", now)
         try:
             self.engine.validate([sample])
         except ValueError as err:
-            self._event(events.SHED, reason="rejected_invalid", detail=str(err))
+            self._event(
+                events.SHED, reason="rejected_invalid", detail=str(err),
+                **({"trace_id": trace} if trace else {}),
+            )
+            self._trace_span(trace, "admission", now, reason="rejected_invalid")
             return self._resolve_now(
                 fut, "rejected_invalid", now, detail=str(err)
             )
@@ -189,7 +227,9 @@ class InferenceServer:
                 reason="shed_queue_full",
                 depth=self.admission.depth,
                 limit=self.admission.limit,
+                **({"trace_id": trace} if trace else {}),
             )
+            self._trace_span(trace, "admission", now, reason="shed_queue_full")
             fut.set_result(
                 ServeResult(ok=False, reason="shed_queue_full")
             )
@@ -217,11 +257,16 @@ class InferenceServer:
                     deadline=(
                         Deadline(now + ms / 1e3) if ms is not None else None
                     ),
+                    trace=trace,
                 )
                 self._inbound.put(req)
         if raced_shutdown:
             self.admission.release()
+            self._trace_span(trace, "admission", now, reason="rejected_draining")
             return self._resolve_now(fut, "rejected_draining", now)
+        # Admission closed; queue_wait opens here (recorded at dispatch,
+        # when its end is known — spans cross the client/worker threads).
+        self._trace_span(trace, "admission", now, reason="admitted")
         return fut
 
     def reload(self, *, deadline_ms: float = 0.0) -> bool:
@@ -249,12 +294,21 @@ class InferenceServer:
         ok = params is not None
         if ok:
             self.engine.swap_params(params)
+        # Reloads trace on their own "r" stream: an aux lifecycle must
+        # not consume a request keep slot (obs/tracing.start_trace).
+        trace = (
+            self._tracer.start_trace(stream="r")
+            if self._tracer is not None
+            else None
+        )
+        self._trace_span(trace, "reload", t0, ok=ok, reload=ordinal)
         self._event(
             events.RELOAD,
             ok=ok,
             reload=ordinal,
             duration_ms=(self._clock() - t0) * 1e3,
             **info,
+            **({"trace_id": trace} if trace else {}),
         )
         return ok
 
@@ -286,6 +340,15 @@ class InferenceServer:
                         item, ServeResult(ok=False, reason="rejected_draining")
                     )
                     self._count_shed("rejected_draining")
+                    # Terminal span so the trace chain ends at its shed
+                    # point with the reason (the propagation contract,
+                    # docs/observability.md). No bucket arg: the rollup
+                    # doesn't note drain-swept requests either, so the
+                    # trace_report/serve_summary populations agree.
+                    self._trace_span(
+                        item.trace, "queue_wait", item.submitted,
+                        reason="rejected_draining",
+                    )
         except queue.Empty:
             pass
         for r in list(self.batcher.requests()):
@@ -293,6 +356,10 @@ class InferenceServer:
                 r, ServeResult(ok=False, reason="rejected_draining")
             )
             self._count_shed("rejected_draining")
+            self._trace_span(
+                r.trace, "queue_wait", r.submitted,
+                reason="rejected_draining",
+            )
         if not self._drained.is_set():
             self._drained.set()
             return self._summary(emit=True)
@@ -356,14 +423,24 @@ class InferenceServer:
                     )
                     time.sleep(stall)
         now = self._clock()
+        bucket = f"{pn}x{pf}"
         live: list[_Request] = []
         for r in reqs:
             if r.deadline is not None and r.deadline.expired(now):
                 self._finish(r, ServeResult(ok=False, reason="shed_deadline"))
                 self._count_shed("shed_deadline")
+                if r.trace is not None:
+                    self._trace_span(
+                        r.trace, "queue_wait", r.submitted, now,
+                        bucket=bucket, reason="shed_deadline",
+                    )
+                    self._note_bucket(
+                        bucket, queue_ms=[(now - r.submitted) * 1e3]
+                    )
                 self._event(
                     events.SHED, reason="shed_deadline", ordinal=r.ordinal,
                     waited_ms=(now - r.submitted) * 1e3,
+                    **({"trace_id": r.trace} if r.trace else {}),
                 )
             else:
                 live.append(r)
@@ -379,14 +456,41 @@ class InferenceServer:
                         detail="circuit breaker open (backend unhealthy)",
                     ),
                 )
+                if r.trace is not None:
+                    self._trace_span(
+                        r.trace, "queue_wait", r.submitted, now,
+                        bucket=bucket, reason="rejected_breaker_open",
+                    )
+                    self._note_bucket(
+                        bucket, queue_ms=[(now - r.submitted) * 1e3]
+                    )
             self._count_shed("rejected_breaker_open", n=len(live))
+            rejected_ids = [r.trace for r in live if r.trace is not None]
             self._event(
-                events.SHED, reason="rejected_breaker_open", n=len(live)
+                events.SHED, reason="rejected_breaker_open", n=len(live),
+                **({"trace_ids": rejected_ids} if rejected_ids else {}),
             )
             return
         with self._lock:
             self._dispatches += 1
             dispatch = self._dispatches
+        # Traced members of this batch: queue_wait closes at dispatch
+        # pop; the batch-level phases below are recorded per member
+        # (same trace_id) with member_trace_ids linking the riders.
+        member_ids = [r.trace for r in live if r.trace is not None]
+        for r in live:
+            # remaining_ms: deadline budget left when dispatch finally
+            # pulled the request — how close this bucket runs to
+            # shedding (0 would have been a shed).
+            self._trace_span(
+                r.trace, "queue_wait", r.submitted, now,
+                bucket=bucket, waited_ms=(now - r.submitted) * 1e3,
+                **(
+                    {"remaining_ms": r.deadline.remaining_ms(now)}
+                    if r.deadline is not None
+                    else {}
+                ),
+            )
         self._event(
             events.QUEUE_DEPTH,
             depth=self.admission.depth,
@@ -395,15 +499,32 @@ class InferenceServer:
             bucket_nodes=pn,
             bucket_funcs=pf,
             n=len(live),
+            **({"trace_ids": member_ids} if member_ids else {}),
         )
+        timings: dict | None = {} if member_ids else None
         try:
             outs = self.engine.infer(
                 [r.sample for r in live],
                 pad_nodes=pn,
                 pad_funcs=pf,
                 rows=self.max_batch,
+                timings=timings,
+                clock=self._clock if timings is not None else None,
             )
         except Exception as err:  # noqa: BLE001 — device errors feed the breaker
+            for r in live:
+                if r.trace is None:
+                    continue
+                self._trace_span(
+                    r.trace, "dispatch", now, bucket=bucket,
+                    dispatch=dispatch, error="error_dispatch",
+                )
+                # The queue_wait spans above are already in the trace;
+                # mirror them into the rollup so serve_summary and a
+                # trace_report over the file agree on this path too.
+                self._note_bucket(
+                    bucket, queue_ms=[(now - r.submitted) * 1e3]
+                )
             self._fail_dispatch(
                 live, "error_dispatch", f"{type(err).__name__}: {err}"
             )
@@ -414,6 +535,10 @@ class InferenceServer:
             i for i, o in enumerate(outs) if not np.all(np.isfinite(o))
         ]
         if bad:
+            self._trace_batch_phases(
+                live, timings, now, self._clock(), dispatch, bucket,
+                member_ids,
+            )
             self._fail_dispatch(
                 live,
                 "error_nan_output",
@@ -423,7 +548,15 @@ class InferenceServer:
             return
         if self.breaker.record_success():
             self._event(events.BREAKER_CLOSE, state="closed")
+        # `done` is stamped AFTER the output-finiteness scan and breaker
+        # bookkeeping (the pre-tracing semantics): latency_ms and the
+        # resolve span must cover everything up to the result being
+        # publishable, and the dispatch span ends here too so
+        # queue_wait + dispatch == latency holds exactly.
         done = self._clock()
+        self._trace_batch_phases(
+            live, timings, now, done, dispatch, bucket, member_ids
+        )
         for r, o in zip(live, outs):
             lat = (done - r.submitted) * 1e3
             with self._lock:
@@ -433,24 +566,92 @@ class InferenceServer:
                 r,
                 ServeResult(ok=True, reason="ok", output=o, latency_ms=lat),
             )
+            self._trace_span(
+                r.trace, "resolve", done, reason="ok", latency_ms=lat
+            )
+
+    def _trace_batch_phases(
+        self, live, timings, start, done, dispatch, bucket, member_ids
+    ) -> None:
+        """Record the batch-level phase spans (batch_assembly / device /
+        unpad from the engine's phase stamps, plus the enclosing
+        dispatch span) once per traced member, and feed the per-bucket
+        queue/device rollup serve_summary reports — one queue and one
+        device observation per TRACED member, exactly the population
+        trace_report sees in the file. No-op when no batch member was
+        sampled."""
+        if timings is None:
+            return
+        link = {"dispatch": dispatch, "bucket": bucket,
+                "member_trace_ids": member_ids}
+        device_ms = None
+        if "device" in timings:
+            t0, t1 = timings["device"]
+            device_ms = (t1 - t0) * 1e3
+        for r in live:
+            if r.trace is None:
+                continue
+            self._trace_span(r.trace, "dispatch", start, done, **link)
+            for phase in ("batch_assembly", "device", "unpad"):
+                if phase in timings:
+                    t0, t1 = timings[phase]
+                    self._trace_span(r.trace, phase, t0, t1, **link)
+            self._note_bucket(
+                bucket,
+                queue_ms=[(start - r.submitted) * 1e3],
+                device_ms=[device_ms] if device_ms is not None else (),
+            )
+
+    def _note_bucket(self, bucket: str, queue_ms=(), device_ms=()) -> None:
+        """One traced request's contribution to the per-bucket
+        queue/device rollup (serve_summary.queue_device_by_bucket)."""
+        with self._lock:
+            st = self._bucket_stats.setdefault(
+                bucket, {"queue_ms": [], "device_ms": []}
+            )
+            st["queue_ms"].extend(queue_ms)
+            st["device_ms"].extend(device_ms)
 
     def _fail_dispatch(self, reqs, reason: str, detail: str) -> None:
         """A whole-dispatch failure: every rider gets a degraded
         response NOW (no hang, no retry queue growth) and the breaker
         counts one failure."""
+        now = self._clock()
         for r in reqs:
             self._finish(r, ServeResult(ok=False, reason=reason, detail=detail))
+            self._trace_span(r.trace, "resolve", now, reason=reason)
         self._count_shed(reason, n=len(reqs))
         if self.breaker.record_failure():
+            first_trace = next(
+                (r.trace for r in reqs if r.trace is not None), None
+            )
             self._event(
                 events.BREAKER_OPEN,
                 state="open",
                 reason=reason,
                 detail=detail,
                 trips=self.breaker.trips,
+                **({"trace_id": first_trace} if first_trace else {}),
             )
 
     # -- bookkeeping -------------------------------------------------------
+
+    def _trace_span(
+        self, trace, name: str, start: float, end: float | None = None,
+        **args,
+    ):
+        """One request-lifecycle span on the server's clock (end
+        defaults to now). No-op (one None check) when tracing is off or
+        this request's trace was sampled out. Returns the span id."""
+        if self._tracer is None or trace is None:
+            return None
+        return self._tracer.add_span(
+            name,
+            start,
+            end if end is not None else self._clock(),
+            trace=trace,
+            args=args or None,
+        )
 
     def _finish(self, req: _Request, result: ServeResult) -> None:
         self.admission.release()
@@ -485,6 +686,30 @@ class InferenceServer:
                 "shed": dict(self._shed),
                 "dispatches": self._dispatches,
                 "reloads": self._reloads,
+            }
+            bucket_stats = {
+                k: {kk: list(vv) for kk, vv in v.items()}
+                for k, v in self._bucket_stats.items()
+            }
+        if self._tracer is not None:
+            # Span-derived queue-wait vs device-time breakdown per
+            # bucket — where a request's latency went, by shape class.
+            # Same population AND same nearest-rank percentiles as
+            # tools/trace_report.py::bucket_breakdown, so this rollup
+            # and a report over the trace file agree number-for-number.
+            summary["queue_device_by_bucket"] = {
+                key: {
+                    "n": len(st["queue_ms"]),
+                    **{
+                        f"queue_{k}": v
+                        for k, v in percentiles(st["queue_ms"]).items()
+                    },
+                    **{
+                        f"device_{k}": v
+                        for k, v in percentiles(st["device_ms"]).items()
+                    },
+                }
+                for key, st in sorted(bucket_stats.items())
             }
         summary.update(
             breaker_trips=self.breaker.trips,

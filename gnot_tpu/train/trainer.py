@@ -15,6 +15,7 @@ scalar argument so schedule changes never trigger recompiles.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import itertools
 import time
@@ -422,8 +423,14 @@ class Trainer:
         *,
         metrics_sink=None,
         checkpointer=None,
+        tracer=None,
     ):
         self.config = config
+        # obs.tracing.Tracer (--trace_path) or None = tracing off. All
+        # trainer spans are host-side (around dispatch, never inside
+        # the compiled step — GL002 enforces that); one trace per
+        # epoch, head-sampled at trace_sample_rate.
+        self._tracer = tracer
         self.mesh = None
         self._eval_tail = 0  # real samples in a repeat-padded tail eval batch
         if config.train.telemetry and config.train.distributed and config.mesh.pipe > 1:
@@ -1175,9 +1182,29 @@ class Trainer:
         print(f"\nBest Test Metric: {self.best_metric}")
         return self.best_metric
 
+    def _tspan(self, trace, name: str, **args):
+        """One train-phase span under the epoch's trace — a
+        nullcontext when tracing is off or this epoch was sampled out,
+        so the untraced path pays one None check and nothing else."""
+        if self._tracer is None or trace is None:
+            return contextlib.nullcontext()
+        return self._tracer.span(name, trace=trace, args=args or None)
+
     def _fit_epoch(self, epoch: int, trace_at: int, preempt) -> None:
         """One epoch — dispatch loop (under the recovery harness),
-        eval, health checks, epoch record, checkpoint saves."""
+        eval, health checks, epoch record, checkpoint saves. With a
+        tracer (--trace_path), the epoch is ONE trace (head-sampled
+        here): an ``epoch`` root span with ``data_iter`` / ``step``
+        (containing ``host_to_device`` + ``step_dispatch``) /
+        ``telemetry_drain`` / ``eval`` / ``checkpoint_save`` phase
+        children — docs/observability.md "Tracing"."""
+        trace = (
+            self._tracer.start_trace() if self._tracer is not None else None
+        )
+        with self._tspan(trace, "epoch", epoch=epoch):
+            self._run_epoch(epoch, trace_at, preempt, trace)
+
+    def _run_epoch(self, epoch: int, trace_at: int, preempt, trace) -> None:
         cfg = self.config
         # Shuffle order is a function of (seed, epoch): resumed runs
         # replay the continuous run's batch order exactly.
@@ -1194,11 +1221,15 @@ class Trainer:
             # The telemetry step returns (state, (loss, telem));
             # the plain step (state, loss) — one call site, the
             # unpack is the only difference.
-            self.state, out = self.train_step(
-                self.state,
-                self._device_batch(batch),
-                jnp.asarray(lr, jnp.float32),
-            )
+            with self._tspan(trace, "step", step=self.host_step + 1) as sp:
+                with self._tspan(trace, "host_to_device"):
+                    device_batch = self._device_batch(batch)
+                with self._tspan(trace, "step_dispatch"):
+                    self.state, out = self.train_step(
+                        self.state,
+                        device_batch,
+                        jnp.asarray(lr, jnp.float32),
+                    )
             loss, telem = out if self._telemetry is not None else (out, None)
             self.host_step += 1
             losses.append(loss)
@@ -1207,6 +1238,7 @@ class Trainer:
                 self._telemetry.append(
                     steps=[self.host_step], epoch=epoch, lrs=[lr],
                     loss=loss, telem=telem, batches=[batch],
+                    span_ids=[sp.span_id if sp is not None else None],
                 )
             if cfg.train.debug_checks and not np.isfinite(
                 float(np.asarray(loss))
@@ -1255,11 +1287,19 @@ class Trainer:
                 self.lr_fn(self.host_step + i, epoch)
                 for i in range(len(group))
             ]
-            self.state, out = self.multi_train_step(
-                self.state,
-                self._device_batch(stack_batches(group), stacked=True),
-                jnp.asarray(lrs, dtype=jnp.float32),
-            )
+            with self._tspan(
+                trace, "step", step=self.host_step + 1, k=len(group)
+            ) as sp:
+                with self._tspan(trace, "host_to_device"):
+                    device_batches = self._device_batch(
+                        stack_batches(group), stacked=True
+                    )
+                with self._tspan(trace, "step_dispatch"):
+                    self.state, out = self.multi_train_step(
+                        self.state,
+                        device_batches,
+                        jnp.asarray(lrs, dtype=jnp.float32),
+                    )
             loss_k, telem_k = (
                 out if self._telemetry is not None else (out, None)
             )
@@ -1273,6 +1313,8 @@ class Trainer:
                     steps=list(range(start + 1, start + len(group) + 1)),
                     epoch=epoch, lrs=lrs, loss=loss_k, telem=telem_k,
                     batches=group,
+                    span_ids=[sp.span_id if sp is not None else None]
+                    * len(group),
                 )
             if cfg.train.debug_checks and not np.all(
                 np.isfinite(np.asarray(loss_k))
@@ -1335,9 +1377,14 @@ class Trainer:
                         # rollback replays the epoch's deterministic
                         # (seed, epoch) order; already-done and
                         # quarantined dispatches are skipped.
-                        for ordinal, (kind, item) in enumerate(
-                            group_batches(self.train_loader, k_dis)
-                        ):
+                        batches = group_batches(self.train_loader, k_dis)
+                        if trace is not None:
+                            # data_iter spans: time spent WAITING on
+                            # the loader (prefetch included) per pull.
+                            batches = self._tracer.timed_iter(
+                                batches, "data_iter", trace=trace
+                            )
+                        for ordinal, (kind, item) in enumerate(batches):
                             if ordinal < resume_at or ordinal in quarantine:
                                 continue
                             start_step = self.host_step
@@ -1367,7 +1414,8 @@ class Trainer:
                             # wastes a pass on a dead run, and the
                             # epoch boundary is a sync point anyway
                             # (train_loss fetch below).
-                            self._telemetry.drain()
+                            with self._tspan(trace, "telemetry_drain"):
+                                self._telemetry.drain()
                         if sup is not None:
                             # Epoch-end check: a NaN in the final
                             # partial snapshot window must not
@@ -1435,7 +1483,7 @@ class Trainer:
             # Reference's exact console line (main.py:105).
             print(f"Epoch {epoch}, Loss: {train_loss}")
 
-            with profiling.annotate("eval_epoch"):
+            with profiling.annotate("eval_epoch"), self._tspan(trace, "eval"):
                 res = self.evaluate()
         print(f"Epoch {epoch}, Test Metric: {res}")
         print("-----------------------------------")
@@ -1484,12 +1532,18 @@ class Trainer:
         if res < self.best_metric:
             self.best_metric = res
             if self.checkpointer is not None:
-                self.checkpointer.save_best(self.state, epoch, self.best_metric)
+                with self._tspan(trace, "checkpoint_save", which="best"):
+                    self.checkpointer.save_best(
+                        self.state, epoch, self.best_metric
+                    )
         if self.checkpointer is not None and (
             cfg.train.checkpoint_every
             and (epoch + 1) % cfg.train.checkpoint_every == 0
         ):
-            self.checkpointer.save_latest(self.state, epoch + 1, self.best_metric)
+            with self._tspan(trace, "checkpoint_save", which="latest"):
+                self.checkpointer.save_latest(
+                    self.state, epoch + 1, self.best_metric
+                )
 
     def _preempt_save(self, stop) -> None:
         """Graceful-preemption exit: save ``latest`` at the CURRENT

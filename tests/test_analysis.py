@@ -203,6 +203,59 @@ def test_gl002_hot_container_nested_body(tmp_path):
     assert len(findings) == 1 and "body" in findings[0].message
 
 
+GL002_TRACER_BAD = """
+    import jax
+
+    def train_step_body(cfg, tracer):
+        def body(state, xs):
+            with tracer.span("step"):
+                state = state + xs
+            return state, xs
+        return body
+
+    @jax.jit
+    def hot(x, tracer):
+        tracer.add_span("device", 0.0, 1.0, trace="t1")
+        return x
+"""
+
+GL002_TRACER_CLEAN = """
+    import jax
+
+    @jax.jit
+    def step(state, xs):
+        return state + xs, xs
+
+    def run_single(batch, state, tracer, trace):
+        # Host-side span AROUND the dispatch: the trainer's pattern.
+        with tracer.span("step_dispatch", trace=trace):
+            state, out = step(state, batch)
+        return state, out
+
+    def spanner(tracer):
+        tracer.start_trace()
+        tracer.flush()
+"""
+
+
+def test_gl002_tracer_calls_in_hot_code_flagged(tmp_path):
+    """Tracing must stay host-side by construction: a Tracer span site
+    inside a compiled step body is flagged like any other host op (it
+    would run once at trace time and time nothing real)."""
+    findings, _ = lint_source(tmp_path, GL002_TRACER_BAD, rules=["GL002"])
+    assert rule_ids(findings) == ["GL002"]
+    msgs = " ".join(f.message for f in findings)
+    assert "Tracer.span" in msgs and "Tracer.add_span" in msgs
+    assert len(findings) == 2
+
+
+def test_gl002_tracer_host_side_clean(tmp_path):
+    """Spans AROUND dispatch (the trainer/server pattern) are host-side
+    and clean — only tracer calls INSIDE hot bodies fire."""
+    findings, _ = lint_source(tmp_path, GL002_TRACER_CLEAN, rules=["GL002"])
+    assert findings == []
+
+
 # --- GL003 recompile-hazard -----------------------------------------------
 
 GL003_BAD = """

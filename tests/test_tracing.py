@@ -1,0 +1,540 @@
+"""Span tracing (gnot_tpu/obs/tracing.py): fake-clock nesting and
+parenting, queue-wait arithmetic through the real serving stack,
+deterministic head sampling, thread-safety under the serve worker
+pool, and Chrome trace-event JSON schema validity of exported files.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from gnot_tpu.data import datasets
+from gnot_tpu.obs.tracing import SERVE_SPANS, Tracer, percentiles
+from gnot_tpu.serve import InferenceEngine, InferenceServer
+from gnot_tpu.utils.metrics import MetricsSink
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    """Deterministic monotonic clock: reads are stable, ticks explicit."""
+
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def fake_server(tracer=None, sink=None, max_batch=2, **kw):
+    """Real InferenceServer over a stubbed forward (no XLA compile —
+    these tests exercise the span plumbing, not the model)."""
+    fake_forward = lambda params, batch: np.zeros(
+        (batch.coords.shape[0], batch.coords.shape[1], 1)
+    )
+    engine = InferenceEngine(None, None, batch_size=max_batch, forward=fake_forward)
+    return InferenceServer(
+        engine, max_batch=max_batch, max_wait_ms=5.0, sink=sink,
+        tracer=tracer, **kw,
+    )
+
+
+# --- nesting / parenting (fake clock) --------------------------------------
+
+
+def test_span_nesting_and_parenting_fake_clock():
+    clk = FakeClock()
+    tr = Tracer(clock=clk)
+    t = tr.start_trace()
+    with tr.span("epoch", trace=t) as root:
+        clk.tick(1.0)
+        with tr.span("step", trace=t) as step:
+            assert step.parent_id == root.span_id  # ambient parent
+            clk.tick(0.5)
+        clk.tick(0.25)
+    spans = {s.name: s for s in tr.snapshot()}
+    assert spans["epoch"].trace_id == spans["step"].trace_id == t
+    assert spans["step"].parent_id == spans["epoch"].span_id
+    assert spans["epoch"].parent_id is None
+    assert (spans["step"].start, spans["step"].end) == (1.0, 1.5)
+    assert (spans["epoch"].start, spans["epoch"].end) == (0.0, 1.75)
+    assert spans["step"].duration_ms == pytest.approx(500.0)
+
+
+def test_span_inherits_ambient_trace():
+    tr = Tracer(clock=FakeClock())
+    t = tr.start_trace()
+    with tr.span("outer", trace=t):
+        with tr.span("inner"):  # no explicit trace: inherits ambient's
+            pass
+    inner = next(s for s in tr.snapshot() if s.name == "inner")
+    assert inner.trace_id == t
+
+
+def test_span_without_trace_records_nothing():
+    tr = Tracer(clock=FakeClock())
+    with tr.span("orphan") as s:  # no ambient, no trace -> no-op
+        assert s is None
+    with tr.span("unsampled", trace=None) as s:
+        assert s is None
+    assert tr.snapshot() == []
+
+
+def test_threads_keep_separate_ambient_chains():
+    """Two threads nest concurrently on one tracer: each child parents
+    under ITS thread's enclosing span, never the other's."""
+    tr = Tracer()
+    errs = []
+
+    def worker(label):
+        try:
+            t = tr.start_trace()
+            with tr.span("outer", trace=t, args={"label": label}) as o:
+                with tr.span("inner") as i:
+                    assert i.parent_id == o.span_id
+                    assert i.trace_id == t
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(k,)) for k in range(8)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert errs == []
+    spans = tr.snapshot()
+    assert len(spans) == 16
+    # Every inner's parent is the outer OF THE SAME trace.
+    outers = {s.trace_id: s.span_id for s in spans if s.name == "outer"}
+    for s in spans:
+        if s.name == "inner":
+            assert s.parent_id == outers[s.trace_id]
+
+
+# --- sampling --------------------------------------------------------------
+
+
+def test_head_sampling_deterministic():
+    tr = Tracer(sample_rate=0.25)
+    picks = [tr.start_trace() is not None for _ in range(12)]
+    # floor(n/4) increments at n = 4, 8, 12: exactly every 4th trace.
+    assert picks == [False, False, False, True] * 3
+    # A fresh tracer with the same rate decides identically (no RNG).
+    tr2 = Tracer(sample_rate=0.25)
+    assert [tr2.start_trace() is not None for _ in range(12)] == picks
+
+
+def test_stream_sampling_is_independent():
+    # Aux lifecycles (serve reloads, stream "r") sample on their own
+    # counter: interleaving them must not shift which requests the
+    # "t" stream keeps, and ids carry the stream prefix.
+    tr = Tracer(sample_rate=0.25)
+    reqs, rels = [], []
+    for _ in range(8):
+        reqs.append(tr.start_trace())
+        rels.append(tr.start_trace(stream="r"))
+    assert reqs == [None] * 3 + ["t000001"] + [None] * 3 + ["t000002"]
+    assert rels == [None] * 3 + ["r000001"] + [None] * 3 + ["r000002"]
+
+
+def test_sampling_edge_rates():
+    assert all(
+        Tracer(sample_rate=1.0).start_trace() is not None for _ in range(1)
+    )
+    tr1 = Tracer(sample_rate=1.0)
+    assert [tr1.start_trace() for _ in range(5)] == [
+        f"t{i:06d}" for i in range(1, 6)
+    ]
+    tr0 = Tracer(sample_rate=0.0)
+    assert [tr0.start_trace() for _ in range(5)] == [None] * 5
+    with pytest.raises(ValueError, match="sample_rate"):
+        Tracer(sample_rate=1.5)
+
+
+def test_unsampled_spans_cost_nothing():
+    tr = Tracer(sample_rate=0.0)
+    t = tr.start_trace()
+    assert t is None
+    assert tr.add_span("queue_wait", 0.0, 1.0, trace=t) is None
+    with tr.span("x", trace=t) as s:
+        assert s is None
+    assert tr.snapshot() == []
+
+
+# --- buffer bound / add_span arithmetic ------------------------------------
+
+
+def test_bounded_buffer_counts_drops():
+    tr = Tracer(max_spans=2, clock=FakeClock())
+    t = tr.start_trace()
+    for i in range(5):
+        tr.add_span("s", 0.0, 1.0, trace=t)
+    assert len(tr.snapshot()) == 2
+    assert tr.dropped == 3
+    assert tr.export()["otherData"]["spans_dropped"] == 3
+
+
+def test_add_span_exact_durations():
+    tr = Tracer(clock=FakeClock())
+    t = tr.start_trace()
+    sid = tr.add_span(
+        "queue_wait", 2.0, 5.0, trace=t, args={"bucket": "64x64"}
+    )
+    (s,) = tr.snapshot()
+    assert s.span_id == sid
+    assert s.duration_ms == pytest.approx(3000.0)
+    assert s.args["bucket"] == "64x64"
+
+
+def test_percentiles_helper():
+    assert percentiles([]) == {"p50_ms": None, "p99_ms": None}
+    out = percentiles([1.0, 2.0, 3.0, 4.0])
+    assert out["p50_ms"] == 2.0 and out["p99_ms"] == 4.0
+
+
+# --- the serving stack: chains + queue-wait arithmetic ---------------------
+
+
+def test_serve_request_chain_and_queue_wait_arithmetic(tmp_path):
+    """Every completed request gets the full admission->resolve chain
+    under ONE trace_id, and the span arithmetic closes: queue_wait
+    starts at submit, ends where dispatch begins, and queue_wait +
+    dispatch duration equals the reported request latency."""
+    tracer = Tracer(path=str(tmp_path / "trace.json"))
+    mp = str(tmp_path / "serve.jsonl")
+    samples = datasets.synth_darcy2d(4, seed=0, grid_n=8)
+    with MetricsSink(mp) as sink:
+        server = fake_server(tracer=tracer, sink=sink).start()
+        futs = [server.submit(s) for s in samples]
+        results = [f.result(timeout=60) for f in futs]
+        summary = server.drain()
+    assert all(r.ok for r in results)
+    by_trace = {}
+    for s in tracer.snapshot():
+        by_trace.setdefault(s.trace_id, {})[s.name] = s
+    assert len(by_trace) == len(samples)
+    for t, chain in by_trace.items():
+        assert set(chain) == set(SERVE_SPANS), (t, sorted(chain))
+        qw, disp = chain["queue_wait"], chain["dispatch"]
+        assert chain["admission"].start == qw.start  # both from submit
+        assert qw.end == disp.start  # dispatch pop closes the queue
+        # batch phases nest inside dispatch; resolve follows device.
+        assert disp.start <= chain["batch_assembly"].start
+        assert chain["device"].end <= disp.end + 1e-9
+        assert chain["resolve"].start >= chain["device"].end - 1e-9
+        assert "member_trace_ids" in disp.args
+        assert t in disp.args["member_trace_ids"]
+    # queue_wait + dispatch == reported latency (same clock, same ends).
+    for r, t in zip(results, sorted(by_trace, key=lambda t: by_trace[t]["admission"].start)):
+        chain = by_trace[t]
+        assert chain["queue_wait"].duration_ms + chain["dispatch"].duration_ms == pytest.approx(
+            r.latency_ms, rel=1e-6, abs=1e-6
+        )
+    # serve_summary carries the span-derived per-bucket breakdown.
+    assert summary["queue_device_by_bucket"]
+    for st in summary["queue_device_by_bucket"].values():
+        assert st["n"] >= 1 and st["queue_p50_ms"] is not None
+        assert st["device_p50_ms"] is not None
+
+
+def test_serve_sampled_out_requests_trace_nothing(tmp_path):
+    tracer = Tracer(sample_rate=0.5)
+    samples = datasets.synth_darcy2d(4, seed=0, grid_n=8)
+    server = fake_server(tracer=tracer).start()
+    futs = [server.submit(s) for s in samples]
+    assert all(f.result(timeout=60).ok for f in futs)
+    server.drain()
+    traced = {s.trace_id for s in tracer.snapshot()}
+    assert len(traced) == 2  # every 2nd request at rate 0.5
+
+
+def test_serve_shed_chain_and_event_trace_id(tmp_path):
+    """A deadline-shed request's chain ends at queue_wait with the shed
+    reason, and its shed event carries the trace_id."""
+    clk = FakeClock()
+    tracer = Tracer(clock=clk)
+    mp = str(tmp_path / "serve.jsonl")
+    samples = datasets.synth_darcy2d(2, seed=0, grid_n=8)
+    with MetricsSink(mp) as sink:
+        server = fake_server(
+            tracer=tracer, sink=sink, max_batch=4, clock=clk,
+        )
+        # No worker thread: drive the internals directly so the fake
+        # clock controls the deadline arithmetic exactly.
+        fut = server.submit(samples[0], deadline_ms=10.0)
+        (req,) = list(server._inbound.queue)
+        server._inbound.get_nowait()
+        clk.tick(0.050)  # 50 ms >> the 10 ms deadline
+        server._dispatch(server.engine.bucket_key(samples[0]) , [req])
+        assert fut.result(timeout=5).reason == "shed_deadline"
+    qw = next(s for s in tracer.snapshot() if s.name == "queue_wait")
+    assert qw.args["reason"] == "shed_deadline"
+    assert qw.duration_ms == pytest.approx(50.0)
+    shed_events = [
+        json.loads(l) for l in open(mp) if '"shed"' in l
+    ]
+    assert shed_events and shed_events[0]["trace_id"] == qw.trace_id
+    assert shed_events[0]["waited_ms"] == pytest.approx(50.0)
+
+
+def test_drain_sweep_ends_chain_with_terminal_span():
+    """A traced request swept by drain()'s final pass (worker never
+    ran) still gets a terminal queue_wait span with the reject reason —
+    no trace dangles at an 'admitted' admission span."""
+    tracer = Tracer()
+    samples = datasets.synth_darcy2d(1, seed=0, grid_n=8)
+    server = fake_server(tracer=tracer)  # .start() never called
+    fut = server.submit(samples[0])
+    server.drain(timeout_s=1.0)
+    assert fut.result(timeout=5).reason == "rejected_draining"
+    spans = {
+        s.name: (s.args or {}).get("reason") for s in tracer.snapshot()
+    }
+    assert spans["admission"] == "admitted"
+    assert spans["queue_wait"] == "rejected_draining"
+
+
+def test_serve_thread_safety_under_client_storm(tmp_path):
+    """Many client threads submitting concurrently against the worker:
+    no span is lost or cross-linked, ids stay unique, every completed
+    request's chain is whole."""
+    tracer = Tracer()
+    samples = datasets.synth_darcy2d(4, seed=0, grid_n=8)
+    server = fake_server(tracer=tracer, max_batch=4, queue_limit=256).start()
+    results = []
+    lock = threading.Lock()
+
+    def client(k):
+        futs = [server.submit(samples[i % 4]) for i in range(8)]
+        rs = [f.result(timeout=60) for f in futs]
+        with lock:
+            results.extend(rs)
+
+    threads = [threading.Thread(target=client, args=(k,)) for k in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    server.drain()
+    n_ok = sum(r.ok for r in results)
+    assert len(results) == 32 and n_ok == 32
+    spans = tracer.snapshot()
+    ids = [s.span_id for s in spans]
+    assert len(ids) == len(set(ids))  # unique under concurrency
+    by_trace = {}
+    for s in spans:
+        by_trace.setdefault(s.trace_id, []).append(s.name)
+    assert len(by_trace) == 32
+    for names in by_trace.values():
+        assert set(names) == set(SERVE_SPANS)
+
+
+# --- Chrome trace-event JSON schema ----------------------------------------
+
+
+def test_exported_file_is_valid_chrome_trace(tmp_path):
+    path = str(tmp_path / "trace.json")
+    clk = FakeClock()
+    tr = Tracer(path=path, clock=clk)
+    t = tr.start_trace()
+    with tr.span("epoch", trace=t, args={"epoch": 0}):
+        clk.tick(0.001)
+        with tr.span("step"):
+            clk.tick(0.002)
+    mp = str(tmp_path / "m.jsonl")
+    with MetricsSink(mp) as sink:
+        assert tr.flush(sink=sink) == path
+    doc = json.load(open(path))
+    assert doc["displayTimeUnit"] == "ms"
+    assert isinstance(doc["traceEvents"], list) and doc["traceEvents"]
+    for e in doc["traceEvents"]:
+        assert e["ph"] == "X"
+        assert isinstance(e["name"], str) and e["name"]
+        assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+        assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert "trace_id" in e["args"] and "span_id" in e["args"]
+    od = doc["otherData"]
+    assert od["sample_rate"] == 1.0 and od["traces_kept"] == 1
+    # The flush is announced on the metrics stream (registry-valid).
+    from gnot_tpu.obs import events as events_registry
+
+    recs = [json.loads(l) for l in open(mp)]
+    flushes = [r for r in recs if r.get("event") == "trace_flush"]
+    assert len(flushes) == 1 and flushes[0]["path"] == path
+    assert flushes[0]["spans"] == 2
+    for r in recs:
+        assert events_registry.validate_record(r) == [], r
+
+
+def test_flush_without_path_is_noop():
+    tr = Tracer()
+    assert tr.flush() is None
+
+
+# --- slow_step <-> span correlation ----------------------------------------
+
+
+def test_slow_step_event_carries_span_id(tmp_path):
+    from gnot_tpu.obs import events as events_registry
+    from gnot_tpu.obs.telemetry import TelemetryBuffer
+
+    class AlwaysSlow:
+        def observe(self, dt):
+            return {"step_time_s": dt, "median_s": 0.01, "slowdown": 9.0}
+
+    import jax.numpy as jnp
+
+    mp = str(tmp_path / "m.jsonl")
+    with MetricsSink(mp) as sink:
+        # log_every=2: both appends land in ONE drain window (an
+        # every-step drain would reset the interval clock between
+        # appends and no dt would ever exist).
+        buf = TelemetryBuffer(sink, log_every=2, slow_step=AlwaysSlow())
+        for step, sid in ((1, None), (2, "s000042")):
+            buf.append(
+                steps=[step], epoch=0, lrs=[1e-3],
+                loss=jnp.asarray(1.0), telem={}, batches=[None],
+                span_ids=[sid],
+            )
+        buf.drain()
+    recs = [json.loads(l) for l in open(mp)]
+    slow = [r for r in recs if r.get("event") == "slow_step"]
+    # dt exists only from the 2nd append; its span id is attached.
+    assert len(slow) == 1 and slow[0]["span_id"] == "s000042"
+    for r in recs:
+        assert events_registry.validate_record(r) == [], r
+
+
+# --- trace_report ----------------------------------------------------------
+
+
+def _tool(name):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        f"gnot_tool_{name}", os.path.join(REPO, "tools", f"{name}.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_trace_report_on_synthetic_serve_trace(tmp_path):
+    """Known durations in -> exact percentiles and critical path out."""
+    trace_report = _tool("trace_report")
+    path = str(tmp_path / "trace.json")
+    tr = Tracer(path=path, clock=FakeClock())
+    for i, (queue_s, device_s) in enumerate([(0.010, 0.004), (0.030, 0.004)]):
+        t = tr.start_trace()
+        t0 = float(i)
+        tr.add_span("admission", t0, t0 + 0.001, trace=t)
+        tr.add_span(
+            "queue_wait", t0, t0 + queue_s, trace=t,
+            args={"bucket": "64x64"},
+        )
+        tr.add_span(
+            "dispatch", t0 + queue_s, t0 + queue_s + device_s + 0.002,
+            trace=t, args={"bucket": "64x64"},
+        )
+        tr.add_span(
+            "device", t0 + queue_s + 0.001, t0 + queue_s + 0.001 + device_s,
+            trace=t, args={"bucket": "64x64"},
+        )
+        tr.add_span(
+            "resolve", t0 + queue_s + device_s + 0.002,
+            t0 + queue_s + device_s + 0.003, trace=t,
+        )
+    tr.flush()
+    rep = trace_report.report(path)
+    assert rep["spans"] == 10
+    assert rep["kinds"]["queue_wait"]["count"] == 2
+    assert rep["kinds"]["queue_wait"]["p50_ms"] == pytest.approx(10.0)
+    assert rep["kinds"]["queue_wait"]["p99_ms"] == pytest.approx(30.0)
+    bb = rep["buckets"]["64x64"]
+    assert bb["requests"] == 2
+    assert bb["queue_p99_ms"] == pytest.approx(30.0)
+    assert bb["device_p50_ms"] == pytest.approx(4.0)
+    cp = rep["critical_path"]
+    assert cp["kind"] == "request" and cp["trace_id"] == "t000002"
+    assert [p["name"] for p in cp["phases"]][0] in ("admission", "queue_wait")
+    assert cp["total_ms"] == pytest.approx(37.0)
+    # Queue-wait dominates the slowest request's critical path.
+    qw = next(p for p in cp["phases"] if p["name"] == "queue_wait")
+    assert qw["share"] > 0.8
+
+
+def test_trace_report_cli_and_train_critical_path(tmp_path, capsys):
+    """Train-shaped trace: the critical path picks the slowest step and
+    its phase children; the CLI prints without error."""
+    trace_report = _tool("trace_report")
+    path = str(tmp_path / "train_trace.json")
+    clk = FakeClock()
+    tr = Tracer(path=path, clock=clk)
+    t = tr.start_trace()
+    with tr.span("epoch", trace=t):
+        for step, cost in ((1, 0.010), (2, 0.050)):
+            with tr.span("step", args={"step": step}):
+                with tr.span("host_to_device"):
+                    clk.tick(0.001)
+                with tr.span("step_dispatch"):
+                    clk.tick(cost)
+    tr.flush()
+    rep = trace_report.report(path)
+    cp = rep["critical_path"]
+    assert cp["kind"] == "step"
+    assert cp["total_ms"] == pytest.approx(51.0)
+    names = [p["name"] for p in cp["phases"]]
+    assert names[0] == "step" and "step_dispatch" in names
+    assert trace_report.main([path]) == 0
+    out = capsys.readouterr().out
+    assert "critical path" in out and "step_dispatch" in out
+    assert trace_report.main([str(tmp_path / "missing.json")]) == 2
+
+
+# --- end-to-end: CLI + smoke tool ------------------------------------------
+
+
+def test_train_cli_writes_trace(tmp_path):
+    """--trace_path on a tiny training run: epoch/step phase spans land
+    in a valid trace; the trainer path stays numerically untouched."""
+    from gnot_tpu.main import main
+
+    trace_report = _tool("trace_report")
+    tp = str(tmp_path / "trace.json")
+    main([
+        "--n_attn_layers", "1", "--n_attn_hidden_dim", "16",
+        "--n_mlp_num_layers", "1", "--n_mlp_hidden_dim", "16",
+        "--n_input_hidden_dim", "16", "--n_expert", "2", "--n_head", "2",
+        "--epochs", "1", "--n_train", "4", "--n_test", "2",
+        "--synthetic", "ns2d", "--trace_path", tp,
+    ])
+    rep = trace_report.report(tp)
+    assert {"epoch", "step", "step_dispatch", "host_to_device",
+            "data_iter", "eval"} <= set(rep["kinds"])
+    assert rep["kinds"]["step"]["count"] == 1  # 4 train / batch 4
+    assert rep["critical_path"]["kind"] == "step"
+
+
+def test_serve_smoke_tool_with_tracing(tmp_path):
+    """The ISSUE 5 acceptance run: serve smoke with --trace_path
+    produces a Chrome trace where every completed request has the full
+    chain, and the smoke's own trace assertions all hold (exit 0)."""
+    serve_smoke = _tool("serve_smoke")
+    tp = str(tmp_path / "smoke_trace.json")
+    summary = serve_smoke.run([
+        "--n", "8", "--trace_path", tp,
+        "--metrics_path", str(tmp_path / "serve.jsonl"),
+    ])
+    assert summary["failures"] == []
+    assert summary["queue_device_by_bucket"]
+    assert os.path.exists(tp)

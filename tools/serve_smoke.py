@@ -109,6 +109,15 @@ def run(argv=None) -> dict:
         help="JSONL event sink (default: a temp file, validated then "
              "discarded)"
     )
+    p.add_argument(
+        "--trace_path", type=str, default="",
+        help="also run the span tracer (obs/tracing.py) and write the "
+             "Chrome trace-event JSON here; the smoke then ALSO asserts "
+             "every completed request has a complete admission->resolve "
+             "span chain sharing one trace_id (the ISSUE 5 acceptance "
+             "criterion)"
+    )
+    p.add_argument("--trace_sample_rate", type=float, default=1.0)
     args = p.parse_args(argv)
     if not args.inject_fault:
         args.inject_fault = f"slow_request@{args.n}"
@@ -121,6 +130,13 @@ def run(argv=None) -> dict:
     metrics_path = args.metrics_path or os.path.join(
         tempfile.mkdtemp(prefix="serve_smoke_"), "serve.jsonl"
     )
+    tracer = None
+    if args.trace_path:
+        from gnot_tpu.obs.tracing import Tracer
+
+        tracer = Tracer(
+            path=args.trace_path, sample_rate=args.trace_sample_rate
+        )
     engine = build_engine(max_batch=args.max_batch)
     traffic = mixed_traffic(args.n)
     # Precompile every bucket the storm will hit (serving-startup
@@ -136,10 +152,13 @@ def run(argv=None) -> dict:
             default_deadline_ms=args.deadline_ms,
             sink=sink,
             faults=FaultInjector.from_spec(args.inject_fault),
+            tracer=tracer,
         ).start()
         futures = [server.submit(s) for s in traffic]
         results = [f.result(timeout=120) for f in futures]
         summary = server.drain()
+        if tracer is not None:
+            tracer.flush(sink=sink)
 
     # -- assertions (the point of a smoke test) ----------------------------
     failures = []
@@ -193,6 +212,82 @@ def run(argv=None) -> dict:
         any(e.get("event") == "serve_summary" for e in events),
         "no serve_summary event in the sink",
     )
+
+    if tracer is not None:
+        # Trace-file assertions (ISSUE 5 acceptance): every completed
+        # request's trace carries the full lifecycle chain under ONE
+        # trace_id, and trace_report derives a per-bucket queue/device
+        # breakdown from the file.
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import trace_report
+
+        from gnot_tpu.obs import tracing
+
+        spans = trace_report.load_spans(args.trace_path)
+        by_trace: dict = {}
+        for s in spans:
+            by_trace.setdefault(s["trace_id"], set()).add(s["name"])
+        chain = set(tracing.SERVE_SPANS)
+        complete = [t for t, names in by_trace.items() if chain <= names]
+        # Head sampling is deterministic (floor-counter rule) and every
+        # submit calls start_trace exactly once, so the sampled-trace
+        # count is exact; at rate 1.0 every completed request must also
+        # have a whole chain (a sampled shed chain legitimately stops
+        # early, so below 1.0 only the bound holds).
+        sampled = math.floor(args.n * args.trace_sample_rate)
+        check(
+            len(by_trace) == sampled,
+            f"{len(by_trace)} sampled traces != floor(n*rate) = {sampled}",
+        )
+        if args.trace_sample_rate >= 1.0:
+            # Trace ids assign in submit order at rate 1.0, so result i
+            # is trace t(i+1): every COMPLETED request must have a
+            # whole chain. Requests failed by an injected nan_output
+            # also carry whole chains (they reached resolve), so the
+            # ok set is a subset, not an equality.
+            ok_traces = {
+                f"t{i + 1:06d}" for i, r in enumerate(results) if r.ok
+            }
+            check(
+                ok_traces <= set(complete),
+                f"completed requests missing whole chains: "
+                f"{sorted(ok_traces - set(complete))}",
+            )
+        else:
+            check(
+                len(complete) <= sampled,
+                f"{len(complete)} complete chains exceed {sampled} "
+                "sampled traces",
+            )
+        # Every bucket has queue-wait numbers; buckets that only ever
+        # shed (no dispatch reached the device) legitimately carry no
+        # device time, so require device numbers on at least one — and
+        # only when some sampled request actually completed (at low
+        # rates the lone sampled trace can be the injected straggler's
+        # shed request, which never reaches the device). At rates low
+        # enough that floor(n*rate) == 0 an empty breakdown is the
+        # configured behavior — nothing to check.
+        bb = trace_report.bucket_breakdown(spans)
+        if sampled:
+            check(
+                bool(bb)
+                and all(v["queue_p50_ms"] is not None for v in bb.values())
+                and (
+                    not complete
+                    or any(
+                        v["device_p50_ms"] is not None for v in bb.values()
+                    )
+                ),
+                f"trace_report bucket breakdown empty/malformed: {bb}",
+            )
+        check(
+            summary.get("queue_device_by_bucket") is not None,
+            "serve_summary missing queue_device_by_bucket with tracing on",
+        )
+        print(
+            f"serve_smoke: trace {args.trace_path}: {len(spans)} spans, "
+            f"{len(complete)} complete chains, buckets={sorted(bb)}"
+        )
 
     p50, p99 = summary["latency_p50_ms"], summary["latency_p99_ms"]
     print(

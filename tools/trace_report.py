@@ -1,0 +1,250 @@
+"""Trace analysis: load a Chrome trace-event JSON written by
+``--trace_path`` (obs/tracing.py) and print where the time went.
+
+Three views (docs/observability.md "Tracing"):
+
+1. **Per-span-kind latency table** — count, p50, p99, total wall-time
+   per span name (``queue_wait``, ``device``, ``step_dispatch``, ...).
+2. **Per-bucket queue-wait vs device-time breakdown** (serve traces) —
+   the shape-dependent latency split bucketed padding creates: a
+   request's time divides into waiting for batchmates vs the compiled
+   forward, and both vary per bucket.
+3. **Critical path of the slowest request / step** — the single worst
+   trace (serve: a request's admission→resolve chain; train: the
+   slowest ``step`` span and its phase children), each phase with its
+   duration and share, plus unattributed gap time.
+
+Usage::
+
+    python tools/trace_report.py run/trace.json
+    python tools/trace_report.py docs/artifacts/serve_trace_example.json
+
+Stdlib-only (reads JSON, prints text); importable — the tests and
+other tools call :func:`report` and assert on the returned dict.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gnot_tpu.obs.tracing import percentiles  # noqa: E402
+
+
+def load_spans(path: str) -> list[dict]:
+    """Chrome ``traceEvents`` -> span dicts with ms floats. Only
+    ``ph: "X"`` complete events are spans; metadata events pass."""
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents", [])
+    if not isinstance(events, list):
+        raise ValueError(f"{path}: traceEvents is not a list")
+    spans = []
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        args = e.get("args", {})
+        spans.append(
+            {
+                "name": e["name"],
+                "start_ms": e["ts"] / 1e3,
+                "dur_ms": e["dur"] / 1e3,
+                "end_ms": (e["ts"] + e["dur"]) / 1e3,
+                "trace_id": args.get("trace_id"),
+                "span_id": args.get("span_id"),
+                "parent_id": args.get("parent_id"),
+                "args": args,
+            }
+        )
+    return spans
+
+
+def kind_stats(spans: list[dict]) -> dict[str, dict]:
+    """name -> {count, p50_ms, p99_ms, total_ms}, ordered by total."""
+    by_name: dict[str, list[float]] = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s["dur_ms"])
+    out = {}
+    for name, durs in sorted(
+        by_name.items(), key=lambda kv: -sum(kv[1])
+    ):
+        out[name] = {
+            "count": len(durs),
+            **percentiles(durs),
+            "total_ms": round(sum(durs), 4),
+        }
+    return out
+
+
+def bucket_breakdown(spans: list[dict]) -> dict[str, dict]:
+    """bucket -> queue-wait vs device-time percentiles (serve traces:
+    ``queue_wait`` and ``device`` spans carry a ``bucket`` arg)."""
+    buckets: dict[str, dict[str, list[float]]] = {}
+    for s in spans:
+        bucket = s["args"].get("bucket")
+        if bucket is None or s["name"] not in ("queue_wait", "device"):
+            continue
+        st = buckets.setdefault(bucket, {"queue_wait": [], "device": []})
+        st[s["name"]].append(s["dur_ms"])
+    out = {}
+    for bucket, st in sorted(buckets.items()):
+        q, d = percentiles(st["queue_wait"]), percentiles(st["device"])
+        out[bucket] = {
+            "requests": len(st["queue_wait"]),
+            "queue_p50_ms": q["p50_ms"],
+            "queue_p99_ms": q["p99_ms"],
+            "device_p50_ms": d["p50_ms"],
+            "device_p99_ms": d["p99_ms"],
+        }
+    return out
+
+
+def critical_path(spans: list[dict]) -> dict | None:
+    """The slowest request (serve) or step (train), phase by phase.
+
+    Serve traces: the trace_id whose ``admission``..``resolve`` extent
+    is longest; its spans in start order are the critical path (the
+    chain is sequential by construction). Train traces: the slowest
+    ``step`` span; its children plus itself. Returns ``{kind, trace_id,
+    total_ms, phases: [{name, start_ms, dur_ms, share}], gap_ms}``."""
+    steps = [s for s in spans if s["name"] == "step"]
+    if steps and not any(s["name"] == "queue_wait" for s in spans):
+        worst = max(steps, key=lambda s: s["dur_ms"])
+        members = [worst] + [
+            s for s in spans if s["parent_id"] == worst["span_id"]
+        ]
+        kind = "step"
+    else:
+        by_trace: dict[str, list[dict]] = {}
+        for s in spans:
+            if s["trace_id"] and s["name"] != "epoch":
+                by_trace.setdefault(s["trace_id"], []).append(s)
+        # Only complete request chains compete (a lone admission span
+        # from a shed request isn't a latency story).
+        candidates = {
+            t: ss
+            for t, ss in by_trace.items()
+            if any(s["name"] == "resolve" for s in ss)
+        } or by_trace
+        if not candidates:
+            return None
+        members = max(
+            candidates.values(),
+            key=lambda ss: max(s["end_ms"] for s in ss)
+            - min(s["start_ms"] for s in ss),
+        )
+        kind = "request"
+    start = min(s["start_ms"] for s in members)
+    end = max(s["end_ms"] for s in members)
+    total = end - start
+    phases = []
+    attributed = 0.0
+    for s in sorted(members, key=lambda s: (s["start_ms"], -s["dur_ms"])):
+        if kind == "step" and s is not worst:
+            attributed += s["dur_ms"]
+        if kind == "request" and s["name"] not in (
+            "admission", "batch_assembly", "device", "unpad"
+        ):
+            # The dispatch span CONTAINS assembly/device/unpad, and
+            # admission is a sub-interval of queue_wait (both start at
+            # submit); count only the non-overlapping top-level chain
+            # (queue_wait, dispatch, resolve) toward attributed time so
+            # gap_ms reports REAL unattributed gaps.
+            attributed += s["dur_ms"]
+        phases.append(
+            {
+                "name": s["name"],
+                "start_ms": round(s["start_ms"] - start, 4),
+                "dur_ms": round(s["dur_ms"], 4),
+                "share": round(s["dur_ms"] / total, 4) if total else None,
+            }
+        )
+    if kind == "step":
+        attributed = min(attributed, worst["dur_ms"])
+        total = worst["dur_ms"]
+    return {
+        "kind": kind,
+        "trace_id": members[0]["trace_id"],
+        "total_ms": round(total, 4),
+        "phases": phases,
+        "gap_ms": round(max(0.0, total - attributed), 4),
+    }
+
+
+def report(path: str) -> dict:
+    spans = load_spans(path)
+    return {
+        "path": path,
+        "spans": len(spans),
+        "kinds": kind_stats(spans),
+        "buckets": bucket_breakdown(spans),
+        "critical_path": critical_path(spans),
+    }
+
+
+def _fmt(v) -> str:
+    return "-" if v is None else f"{v:10.3f}"
+
+
+def print_report(rep: dict) -> None:
+    print(f"{rep['path']}: {rep['spans']} spans")
+    print("\nper-span-kind latency (ms):")
+    print(f"  {'kind':<16} {'count':>6} {'p50':>10} {'p99':>10} {'total':>10}")
+    for name, st in rep["kinds"].items():
+        print(
+            f"  {name:<16} {st['count']:>6} {_fmt(st['p50_ms'])} "
+            f"{_fmt(st['p99_ms'])} {_fmt(st['total_ms'])}"
+        )
+    if rep["buckets"]:
+        print("\nqueue-wait vs device-time per bucket (ms):")
+        print(
+            f"  {'bucket':<12} {'reqs':>5} {'queue p50':>10} "
+            f"{'queue p99':>10} {'device p50':>11} {'device p99':>11}"
+        )
+        for bucket, st in rep["buckets"].items():
+            print(
+                f"  {bucket:<12} {st['requests']:>5} "
+                f"{_fmt(st['queue_p50_ms'])} {_fmt(st['queue_p99_ms'])} "
+                f" {_fmt(st['device_p50_ms'])}  {_fmt(st['device_p99_ms'])}"
+            )
+    cp = rep["critical_path"]
+    if cp is not None:
+        print(
+            f"\ncritical path — slowest {cp['kind']} "
+            f"({cp['trace_id']}, {cp['total_ms']:.3f} ms total, "
+            f"{cp['gap_ms']:.3f} ms unattributed):"
+        )
+        for ph in cp["phases"]:
+            share = f"{ph['share'] * 100:5.1f}%" if ph["share"] is not None else ""
+            print(
+                f"  +{ph['start_ms']:9.3f} ms  {ph['name']:<16} "
+                f"{ph['dur_ms']:9.3f} ms  {share}"
+            )
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("trace", help="Chrome trace-event JSON (--trace_path)")
+    p.add_argument(
+        "--json", action="store_true",
+        help="emit the report as JSON instead of text",
+    )
+    args = p.parse_args(argv)
+    if not os.path.exists(args.trace):
+        print(f"no such trace file: {args.trace}", file=sys.stderr)
+        return 2
+    rep = report(args.trace)
+    if args.json:
+        json.dump(rep, sys.stdout, indent=1)
+        print()
+    else:
+        print_report(rep)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,177 @@
+"""Tracing-overhead A/B: train step time with span tracing OFF vs ON.
+
+The acceptance bar for the tracing subsystem (docs/observability.md
+"Tracing") is <=2% step-time regression at the default sample rate
+(1.0 — every step traced) on the ns2d CPU micro-bench. The ON arm runs
+the REAL per-step span sites the trainer uses — ``Tracer.timed_iter``
+wrapping the batch iterator (one ``data_iter`` span per pull) and a
+``step`` span wrapping ``host_to_device`` + ``step_dispatch`` children
+per step (``host_to_device`` times the single-device identity
+placement, exactly what ``Trainer._device_batch`` is with no mesh),
+one trace for the whole window — against a live ``Tracer`` with a real
+output path, and the final flush (the Chrome-JSON write) is INSIDE the
+timed window, so the measured cost is everything tracing adds end to
+end. Timed windows are best-of-N and interleaved off/on like
+tools/telemetry_ab.py, so ambient machine-load drift hits both arms
+alike.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/tracing_ab.py \
+        --steps 60 --repeats 3 --out docs/artifacts/tracing_overhead_ab.jsonl
+
+Emits one JSONL record per arm plus a summary record with
+``overhead_frac``; committed as docs/artifacts/tracing_overhead_ab.jsonl.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build(n_points: int, batch_size: int):
+    from gnot_tpu.config import ModelConfig, OptimConfig
+    from gnot_tpu.data import datasets
+    from gnot_tpu.data.batch import Loader
+    from gnot_tpu.models.gnot import GNOT
+    from gnot_tpu.train.trainer import init_state, make_train_step
+
+    samples = datasets.synth_ns2d(batch_size, n_points=n_points, seed=0)
+    batch = next(iter(Loader(samples, batch_size)))
+    # Same micro-bench architecture as tools/telemetry_ab.py: reference
+    # shape at half width/depth — CPU-fast, realistic relative cost.
+    mc = ModelConfig(
+        n_attn_layers=2, n_attn_hidden_dim=128, n_mlp_num_layers=2,
+        n_mlp_hidden_dim=128, n_input_hidden_dim=128, n_expert=3, n_head=4,
+        **datasets.infer_model_dims(samples),
+    )
+    model = GNOT(mc)
+    optim = OptimConfig()
+    state = init_state(model, optim, batch, seed=0)
+    step = make_train_step(model, optim, "rel_l2")
+    return step, state, batch
+
+
+def _window(step, state0, batch, traced: bool, steps: int, sample_rate: float,
+            copy_tree, lr) -> float:
+    """One timed window of ``steps`` steps; the ON arm runs the real
+    trainer span sites plus the end-of-window flush. Warm-up step
+    outside the window."""
+    from gnot_tpu.obs.tracing import Tracer
+
+    state = copy_tree(state0)
+    tracer = trace = None
+    if traced:
+        tracer = Tracer(
+            path=os.path.join(tempfile.mkdtemp(), "tracing_ab_trace.json"),
+            sample_rate=sample_rate,
+        )
+        trace = tracer.start_trace()
+
+    import contextlib
+
+    def tspan(name, **args):
+        if trace is None:
+            return contextlib.nullcontext()
+        return tracer.span(name, trace=trace, args=args or None)
+
+    def one(state, i, b):
+        with tspan("step", step=i):
+            with tspan("host_to_device"):
+                db = b  # single-device _device_batch is the identity
+            with tspan("step_dispatch"):
+                state, loss = step(state, db, lr)
+        return state, loss
+
+    def batch_iter(n):
+        # The trainer wraps its loader in Tracer.timed_iter — same
+        # data_iter span site here, over the same repeated batch.
+        it = iter([batch] * n)
+        if trace is not None:
+            return tracer.timed_iter(it, "data_iter", trace=trace)
+        return it
+
+    state, loss = one(state, 0, batch)
+    np.asarray(loss)
+    t0 = time.perf_counter()
+    for i, b in enumerate(batch_iter(steps), start=1):
+        state, loss = one(state, i, b)
+    if tracer is not None:
+        tracer.flush()
+    np.asarray(loss)  # hard fetch: the window ends when the device does
+    return (time.perf_counter() - t0) / steps
+
+
+def time_ab(n_points: int, batch_size: int, steps: int, sample_rate: float,
+            repeats: int) -> tuple[float, float]:
+    """Best-of-``repeats`` seconds/step for (off, on), timed windows
+    interleaved off/on so ambient load drift cancels (the
+    tools/telemetry_ab.py methodology)."""
+    step, state, batch = build(n_points, batch_size)
+    lr = jnp.asarray(1e-3, jnp.float32)
+    copy_tree = jax.jit(lambda s: jax.tree.map(jnp.copy, s))
+    best_off = best_on = float("inf")
+    for _ in range(max(1, repeats)):
+        best_off = min(
+            best_off,
+            _window(step, state, batch, False, steps, sample_rate,
+                    copy_tree, lr),
+        )
+        best_on = min(
+            best_on,
+            _window(step, state, batch, True, steps, sample_rate,
+                    copy_tree, lr),
+        )
+    return best_off, best_on
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--n_points", type=int, default=512)
+    p.add_argument("--batch_size", type=int, default=4)
+    p.add_argument("--steps", type=int, default=60)
+    p.add_argument("--sample_rate", type=float, default=1.0)
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--out", type=str, default="")
+    args = p.parse_args()
+
+    platform = jax.devices()[0].platform
+    sec_off, sec_on = time_ab(
+        args.n_points, args.batch_size, args.steps, args.sample_rate,
+        args.repeats,
+    )
+    records = []
+    for arm, sec in (("tracing_off", sec_off), ("tracing_on", sec_on)):
+        records.append({
+            "arm": arm, "ms_per_step": round(sec * 1e3, 4),
+            "platform": platform, "n_points": args.n_points,
+            "batch_size": args.batch_size, "steps": args.steps,
+            "sample_rate": args.sample_rate, "repeats": args.repeats,
+        })
+    off, on = records[0]["ms_per_step"], records[1]["ms_per_step"]
+    records.append({
+        "summary": "tracing_overhead", "config": "ns2d_micro",
+        "ms_per_step_off": off, "ms_per_step_on": on,
+        "overhead_frac": round(on / off - 1.0, 4),
+        "bar": "overhead_frac < 0.02 at the default sample_rate=1.0",
+    })
+    out = "\n".join(json.dumps(r) for r in records) + "\n"
+    sys.stdout.write(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out)
+
+
+if __name__ == "__main__":
+    main()
