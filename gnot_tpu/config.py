@@ -338,10 +338,25 @@ class ServeConfig:
     # Serve-side deterministic fault injection (resilience/faults.py):
     # slow_request@N, nan_output@N, reload_corrupt@N. "" = none.
     inject_fault: str = ""
+    # Packed dispatch mode ("pack, don't pad" on the serving hot path,
+    # docs/performance.md): plan-fitting requests are first-fit packed
+    # as chunk-aligned segments into ONE fixed-shape program
+    # (data/batch.py::PackPlan derived from the warmup traffic) instead
+    # of one padded row each; oversize requests fall back to the
+    # per-bucket padded path. pack_chunk is the segment alignment (and
+    # the packed kernel tile) — a multiple of 8; smaller packs small
+    # meshes tighter, larger gives the MXU longer contiguous spans.
+    packed: bool = False
+    pack_chunk: int = 64
 
     def __post_init__(self) -> None:
         if self.max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.pack_chunk < 8 or self.pack_chunk % 8:
+            raise ValueError(
+                f"pack_chunk must be a positive multiple of 8, got "
+                f"{self.pack_chunk}"
+            )
         if self.max_wait_ms < 0:
             raise ValueError(
                 f"max_wait_ms must be >= 0, got {self.max_wait_ms}"

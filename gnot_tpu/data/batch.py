@@ -285,6 +285,113 @@ def pack_collate(
     )
 
 
+@dataclasses.dataclass(frozen=True)
+class PackPlan:
+    """The STATIC shape of one packed serve dispatch: ``n_rows`` rows of
+    ``row_len`` tokens (chunk-aligned segments), ``n_slots`` sample
+    slots, input functions padded to ``pad_funcs``. One plan == one
+    compiled XLA program, no matter how many small requests ride each
+    dispatch — the serving counterpart of ``PackedLoader``'s fixed
+    epoch shape (docs/performance.md "Pack, don't pad").
+    """
+
+    row_len: int
+    chunk: int
+    n_rows: int
+    n_slots: int
+    pad_funcs: int
+
+    def __post_init__(self) -> None:
+        if self.chunk < 1:
+            raise ValueError(f"chunk must be >= 1, got {self.chunk}")
+        if self.row_len % self.chunk:
+            raise ValueError(
+                f"row_len {self.row_len} must be a multiple of chunk "
+                f"{self.chunk}"
+            )
+        if self.n_rows < 1 or self.n_slots < 1:
+            raise ValueError("n_rows and n_slots must be >= 1")
+
+    @classmethod
+    def from_samples(
+        cls,
+        samples: Sequence[MeshSample],
+        *,
+        chunk: int = 128,
+        n_rows: int = 0,
+        batch_size: int = 4,
+        row_len: int = 0,
+    ) -> "PackPlan":
+        """Derive a plan from representative traffic (the serve warmup
+        set), mirroring ``PackedLoader``'s shape derivation: row_len
+        fits ~2 max-size samples (bucketed), ``n_rows`` defaults to
+        carrying ~batch_size samples per dispatch, slots sized so no
+        packing of the row grid can overflow them."""
+        if not samples:
+            raise ValueError("PackPlan.from_samples needs at least one sample")
+        aligned = [-(-s.coords.shape[0] // chunk) * chunk for s in samples]
+        if not row_len:
+            row_len = -(-bucket_length(2 * max(aligned)) // chunk) * chunk
+        mean_a = float(np.mean(aligned))
+        if not n_rows:
+            n_rows = max(1, -(-int(batch_size * mean_a) // row_len))
+        # Static slot capacity: traffic may include samples down to one
+        # chunk, so no packing of the row grid can overflow this.
+        n_slots = n_rows * (row_len // chunk)
+        pad_funcs = max(
+            (f.shape[0] for s in samples for f in s.funcs), default=0
+        )
+        if pad_funcs:
+            pad_funcs = bucket_length(pad_funcs)
+        return cls(
+            row_len=row_len, chunk=chunk, n_rows=n_rows,
+            n_slots=n_slots, pad_funcs=pad_funcs,
+        )
+
+    def aligned(self, n: int) -> int:
+        """Chunk-aligned token footprint of an n-point mesh."""
+        return -(-n // self.chunk) * self.chunk
+
+    def packable(self, sample: MeshSample) -> bool:
+        """Whether this sample can ride a packed dispatch: its aligned
+        span fits one row and every input function fits the slot pad.
+        Oversize requests fall back to the per-bucket padded path."""
+        if self.aligned(sample.coords.shape[0]) > self.row_len:
+            return False
+        return all(f.shape[0] <= self.pad_funcs for f in sample.funcs)
+
+    @property
+    def capacity_tokens(self) -> int:
+        """Token capacity of one dispatch (the pad-waste denominator)."""
+        return self.n_rows * self.row_len
+
+
+def pack_prefix(
+    sizes: Sequence[int], plan: PackPlan
+) -> list[tuple[int, int]]:
+    """First-fit FIFO *prefix* packing into one ``plan``-shaped
+    dispatch: place each sample (in order) into the first row with
+    space; STOP at the first that fits nowhere (or when slots run out)
+    so a dispatch is always an arrival-order prefix — a request never
+    overtakes an older one, preserving the Batcher's FIFO/monotone
+    queue-wait contract. Returns ``(row, offset)`` placements for the
+    packed prefix (``len(result)`` = how many were placed)."""
+    used = [0] * plan.n_rows
+    placements: list[tuple[int, int]] = []
+    for n in sizes:
+        if len(placements) >= plan.n_slots:
+            break
+        a = plan.aligned(n)
+        for r in range(plan.n_rows):
+            if used[r] + a <= plan.row_len:
+                placements.append((r, used[r]))
+                used[r] += a
+                break
+        else:
+            break
+    return placements
+
+
 class PackedLoader:
     """Epoch iterator over PACKED batches: the epoch's (shuffled) sample
     stream is first-fit packed into rows of one fixed length, then R

@@ -186,6 +186,21 @@ def build_parser() -> argparse.ArgumentParser:
              "reload_corrupt@reload"
     )
     p.add_argument(
+        "--serve_packed", action="store_true",
+        help="serving: packed dispatch mode ('pack, don't pad') — "
+             "first-fit pack many small requests as chunk-aligned "
+             "segments into ONE fixed-shape compiled program (PackPlan "
+             "derived from the traffic) instead of one padded row "
+             "each; per-segment unpad keeps every response exactly its "
+             "own nodes, oversize requests fall back to the padded "
+             "per-bucket path (docs/performance.md)"
+    )
+    p.add_argument(
+        "--serve_pack_chunk", type=int, default=64,
+        help="serving: packed-mode segment alignment in tokens "
+             "(multiple of 8)"
+    )
+    p.add_argument(
         "--serve_reload_every", type=int, default=0,
         help="serving demo traffic: hot-reload the checkpoint after "
              "every N requests (0 = never) — exercises the atomic "
@@ -354,6 +369,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "serve.breaker_threshold": args.serve_breaker_threshold,
             "serve.breaker_cooldown_s": args.serve_breaker_cooldown_s,
             "serve.inject_fault": args.serve_inject_fault,
+            "serve.packed": args.serve_packed,
+            "serve.pack_chunk": args.serve_pack_chunk,
             "mesh.data": args.mesh_data,
             "mesh.seq": args.mesh_seq,
             "mesh.model": args.mesh_model,
@@ -749,10 +766,24 @@ def _run_serve(
             print("note: no restorable checkpoint — serving fresh weights")
     sc = cfg.serve
     engine = trainer.inference_engine()
+    # Packed dispatch ("pack, don't pad", docs/performance.md): derive
+    # the ONE fixed dispatch shape from the traffic itself — the same
+    # samples we are about to serve are the representative set.
+    pack_plan = None
+    if sc.packed:
+        from gnot_tpu.data.batch import PackPlan
+
+        pack_plan = PackPlan.from_samples(
+            samples, chunk=sc.pack_chunk, batch_size=sc.max_batch
+        )
     # Serving-startup discipline (docs/serving.md): precompile one
     # program per bucket the traffic will hit — a cold XLA compile
     # landing under a tight deadline would shed everything behind it.
+    # Packed mode still warms the padded buckets too (the oversize
+    # fallback path).
     engine.warmup(samples, rows=sc.max_batch)
+    if pack_plan is not None:
+        engine.warmup_packed(samples, pack_plan)
     with PreemptionHandler() as preempt:
         server = InferenceServer(
             engine,
@@ -762,6 +793,7 @@ def _run_serve(
             default_deadline_ms=sc.deadline_ms,
             breaker_threshold=sc.breaker_threshold,
             breaker_cooldown_s=sc.breaker_cooldown_s,
+            pack_plan=pack_plan,
             sink=sink,
             reload_fn=(
                 CheckpointReloader(checkpointer, trainer.state)

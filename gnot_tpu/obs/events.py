@@ -132,9 +132,12 @@ EVENTS: dict[str, EventSpec] = {
     ),
     "queue_depth": EventSpec(
         fields=("depth", "batched", "dispatch", "bucket_nodes",
-                "bucket_funcs", "n"),
+                "bucket_funcs", "n", "packed", "real_tokens",
+                "capacity_tokens"),
         module="gnot_tpu/serve/server.py",
-        doc="one serving dispatch (depth at flush + its bucket)",
+        doc="one serving dispatch (depth at flush, its bucket, and the "
+        "dispatch's real-vs-capacity node tokens; `packed` marks a "
+        "pack_plan dispatch)",
         optional=("trace_ids",),
     ),
     "shed": EventSpec(
@@ -173,7 +176,7 @@ EVENTS: dict[str, EventSpec] = {
         ),
         module="gnot_tpu/serve/server.py",
         doc="end-of-serve rollup emitted on drain",
-        optional=("queue_device_by_bucket",),
+        optional=("queue_device_by_bucket", "pad_waste_by_bucket"),
     ),
     "trace_flush": EventSpec(
         fields=("path", "spans", "dropped"),

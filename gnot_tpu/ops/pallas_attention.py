@@ -48,6 +48,15 @@ composed over heads (reference ``/root/reference/model.py:53-107``);
 outputs come back head-merged exactly as ``merge_heads`` would produce
 (the non-parity merge — parity mode's interleaved merge stays on the
 XLA path).
+
+``nla_reduce_seg`` / ``nla_apply_seg`` / ``fused_nla_packed`` are the
+SEGMENT-AWARE forms for packed ragged execution ("pack, don't pad"):
+with the kernel tile pinned to the packing chunk, segment structure is
+carried as prefetched scalar index tables (the grouped-matmul idiom)
+and packed sequences can never attend across segment boundaries. One
+segment-aware kernel pays off across the physics-attention family —
+Transolver's framing (PAPERS.md, arXiv 2511.06294) shows the same
+linear-attention reduction recurs in every planned variant.
 """
 
 from __future__ import annotations
@@ -56,7 +65,9 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
 Array = jax.Array
@@ -430,3 +441,337 @@ def _reference_impl(q, k, v, mask, n_head: int):
     """Full einsum oracle in the merged-head layout (tests)."""
     kv, ksum = _reduce_ref(k, v, mask, n_head)
     return _apply_ref(q, kv, ksum, n_head)
+
+
+# --------------------------------------------------------------------------
+# Segment-packed stages: "pack, don't pad" in the kernel itself.
+#
+# Packed rows carry several samples (segments) as contiguous,
+# chunk-aligned spans (data/batch.py::PackedBatch). With the kernel tile
+# pinned to the packing chunk, every sequence tile belongs to exactly
+# ONE segment, so segment structure enters the kernels as *indices*, not
+# masks:
+#
+# * ``nla_reduce_seg`` scatters each tile's Gram/k_sum contribution
+#   straight into its segment's output block — the output BlockSpec's
+#   index map reads the prefetched chunk->segment id table
+#   (pltpu.PrefetchScalarGridSpec), the grouped-matmul idiom. A
+#   segment's tiles are contiguous in grid order (one placement per
+#   sample), so each output block is revisited in a single run and the
+#   zero-init fires on the prefetched run-start flag.
+# * ``nla_apply_seg`` gathers each query tile's segment Gram/k_sum the
+#   same way (read-only, so revisit order is unconstrained).
+#
+# No token ever attends across a segment boundary BY CONSTRUCTION: a
+# tile only ever meets its own segment's accumulators. Pad chunks carry
+# segment id S (one garbage slot, sliced off / zero-Gram'd), and
+# intra-chunk tail padding rides the ordinary 0/1 token mask.
+# --------------------------------------------------------------------------
+
+
+def _run_starts(seg: Array) -> Array:
+    """[B, N] tile segment ids -> int32 1/0 first-tile-of-run flags.
+    Contiguous placement means a segment's tiles form one run per row;
+    the reduce kernel zero-inits its output block exactly there."""
+    seg = seg.astype(jnp.int32)
+    first = jnp.ones_like(seg[:, :1])
+    return jnp.concatenate(
+        [first, (seg[:, 1:] != seg[:, :-1]).astype(jnp.int32)], axis=1
+    )
+
+
+def _seg_tile(l: int, n_tiles: int, what: str) -> int:
+    if l % n_tiles:
+        raise ValueError(
+            f"{what}: sequence length {l} not divisible by the segment "
+            f"tile count {n_tiles} (chunk-aligned packing required)"
+        )
+    tile = l // n_tiles
+    if tile % 8:
+        raise ValueError(
+            f"{what}: packing chunk {tile} must be a multiple of 8 "
+            "(TPU sublane alignment); repack with chunk in {64, 128, 256}"
+        )
+    return tile
+
+
+def _visited_mask(seg: Array, n_seg: int) -> Array:
+    """[S] 0/1: which segment slots any tile actually wrote. Unvisited
+    output blocks hold uninitialized memory — zeroed after the call."""
+    flat = jnp.clip(seg.reshape(-1), 0, n_seg)  # garbage slot folds to S
+    return jnp.zeros(n_seg + 1, jnp.float32).at[flat].max(1.0)[:n_seg]
+
+
+def _reduce_seg_kernel(
+    seg_ref, init_ref, k_ref, v_ref, m_ref, kv_ref, ksum_ref, *, n_head
+):
+    b_i = pl.program_id(0)
+    lk_i = pl.program_id(2)
+
+    @pl.when(init_ref[b_i, lk_i] == 1)
+    def _():
+        kv_ref[0, 0] = jnp.zeros_like(kv_ref[0, 0])
+        ksum_ref[0, 0] = jnp.zeros_like(ksum_ref[0, 0])
+
+    k = k_ref[0, 0].astype(jnp.float32)  # [T, E]
+    v = v_ref[0, 0].astype(jnp.float32)  # [T, E]
+    m = m_ref[0, 0].astype(jnp.float32)  # [T, 1]
+    ks = _group_softmax(k, n_head) * m
+    kv_ref[0, 0] += jax.lax.dot_general(
+        ks, v, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    ksum_ref[0, 0] += jnp.sum(ks, axis=0, keepdims=True)
+
+
+def _reduce_seg_call(k, v, mask, seg, n_seg: int, n_head: int, interpret: bool):
+    f, b, lk, e = k.shape
+    tile = _seg_tile(lk, seg.shape[1], "nla_reduce_seg")
+    nt = lk // tile
+    seg32 = seg.astype(jnp.int32)
+    init = _run_starts(seg32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, f, nt),
+        in_specs=[
+            pl.BlockSpec((1, 1, tile, e), lambda bi, fi, li, s_r, i_r: (fi, bi, li, 0)),
+            pl.BlockSpec((1, 1, tile, e), lambda bi, fi, li, s_r, i_r: (fi, bi, li, 0)),
+            pl.BlockSpec((1, 1, tile, 1), lambda bi, fi, li, s_r, i_r: (fi, bi, li, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, e, e), lambda bi, fi, li, s_r, i_r: (fi, s_r[bi, li], 0, 0)),
+            pl.BlockSpec((1, 1, 1, e), lambda bi, fi, li, s_r, i_r: (fi, s_r[bi, li], 0, 0)),
+        ),
+    )
+    kv, ksum = pl.pallas_call(
+        functools.partial(_reduce_seg_kernel, n_head=n_head),
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((f, n_seg + 1, e, e), jnp.float32),
+            jax.ShapeDtypeStruct((f, n_seg + 1, 1, e), jnp.float32),
+        ),
+        interpret=interpret,
+    )(seg32, init, k, v, mask[..., None])
+    # Slots no tile scattered into hold uninitialized memory; zero them
+    # so empty sample slots read as "no keys" (like an all-masked slab).
+    vis = _visited_mask(seg32, n_seg)
+    kv = jnp.where(vis[None, :, None, None] > 0, kv[:, :n_seg], 0.0)
+    ksum = jnp.where(vis[None, :, None, None] > 0, ksum[:, :n_seg], 0.0)
+    return kv, ksum
+
+
+def _reduce_seg_ref(k, v, mask, seg, n_seg: int, n_head: int):
+    """Einsum form of the segment reduce (backward source + oracle)."""
+
+    def gsm(x):
+        shaped = x.reshape(*x.shape[:-1], n_head, x.shape[-1] // n_head)
+        sm = jax.nn.softmax(shaped.astype(jnp.float32), axis=-1)
+        return sm.reshape(x.shape)
+
+    lk = k.shape[2]
+    ks = gsm(k) * mask[..., None]  # [F, B, Lk, E]
+    tok_seg = jnp.repeat(seg, lk // seg.shape[1], axis=1)  # [B, Lk]
+    oh = jax.nn.one_hot(tok_seg, n_seg + 1, dtype=jnp.float32)[..., :n_seg]
+    kv = jnp.einsum("fbld,fble,bls->fsde", ks, v.astype(jnp.float32), oh)
+    ksum = jnp.einsum("fbld,bls->fsd", ks, oh)[:, :, None, :]
+    return kv, ksum
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def nla_reduce_seg(
+    k: Array,
+    v: Array,
+    mask: Array,
+    seg: Array,
+    n_seg: int,
+    n_head: int,
+    interpret: bool | None = None,
+):
+    """Segment-scattered Gram accumulation over PACKED key rows.
+
+    Args:
+      k: ``[F, B, Lk, E]`` raw keys, rows packed (``F=1`` for
+        self-attention over node rows).
+      v: ``[F, B, Lk, E]`` values.
+      mask: ``[F, B, Lk]`` 0/1 token mask (intra-chunk tail padding).
+      seg: ``[B, N]`` int chunk->segment ids, ``Lk % N == 0``; pad
+        chunks carry ``n_seg``. The kernel tile IS the packing chunk.
+      n_seg: static segment-slot count S.
+
+    Returns:
+      ``(kv [F, S, E, E], k_sum [F, S, 1, E])`` in f32 — one Gram per
+      segment; empty slots are exactly zero.
+    """
+    interpret = _interpret_default() if interpret is None else interpret
+    return _reduce_seg_call(k, v, mask, seg, n_seg, n_head, interpret)
+
+
+def _nla_reduce_seg_fwd(k, v, mask, seg, n_seg, n_head, interpret):
+    interpret = _interpret_default() if interpret is None else interpret
+    out = _reduce_seg_call(k, v, mask, seg, n_seg, n_head, interpret)
+    return out, (k, v, mask, seg)
+
+
+def _nla_reduce_seg_bwd(n_seg, n_head, interpret, residuals, cotangents):
+    del interpret
+    k, v, mask, seg = residuals
+    _, vjp = jax.vjp(
+        lambda k_, v_: _reduce_seg_ref(k_, v_, mask, seg, n_seg, n_head), k, v
+    )
+    dk, dv = vjp(cotangents)
+    return dk, dv, jnp.zeros_like(mask), np.zeros(seg.shape, jax.dtypes.float0)
+
+
+nla_reduce_seg.defvjp(_nla_reduce_seg_fwd, _nla_reduce_seg_bwd)
+
+
+def _apply_seg_kernel(seg_ref, q_ref, kv_ref, ksum_ref, out_ref, qs_ref, *, n_head):
+    f_i = pl.program_id(2)
+    e = q_ref.shape[-1]
+    bd = _block_diag_mask(e, e // n_head)
+
+    qs = _group_softmax(q_ref[0].astype(jnp.float32), n_head)  # [T, E]
+
+    @pl.when(f_i == 0)
+    def _():
+        qs_ref[0] = qs.astype(qs_ref.dtype)
+
+    kv = kv_ref[0, 0] * bd  # this tile's SEGMENT Gram, head-diag blocks
+    ksum = ksum_ref[0, 0]  # [1, E]
+    denom = jax.lax.dot_general(
+        qs * ksum, bd, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    # Pad tiles gather the zero garbage Gram and empty segments have
+    # ksum == 0: both give denom == 0 with a zero numerator; select 1
+    # so their output is 0, not nan.
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    out = jnp.dot(qs, kv, preferred_element_type=jnp.float32) / denom
+    out_ref[0, 0] = out.astype(out_ref.dtype)
+
+
+def _apply_seg_call(q, kv, ksum, seg, n_head: int, interpret: bool):
+    b, l, e = q.shape
+    f, n_seg = kv.shape[0], kv.shape[1]
+    tile = _seg_tile(l, seg.shape[1], "nla_apply_seg")
+    nt = l // tile
+    seg32 = seg.astype(jnp.int32)
+    # One zero garbage block at index S: pad chunks (seg id == S)
+    # gather it and emit exactly 0 (denominator select above).
+    kv_g = jnp.concatenate([kv, jnp.zeros((f, 1, e, e), kv.dtype)], axis=1)
+    ksum_g = jnp.concatenate([ksum, jnp.zeros((f, 1, 1, e), ksum.dtype)], axis=1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(b, nt, f),
+        in_specs=[
+            pl.BlockSpec((1, tile, e), lambda bi, li, fi, s_r: (bi, li, 0)),
+            pl.BlockSpec((1, 1, e, e), lambda bi, li, fi, s_r: (fi, s_r[bi, li], 0, 0)),
+            pl.BlockSpec((1, 1, 1, e), lambda bi, li, fi, s_r: (fi, s_r[bi, li], 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, tile, e), lambda bi, li, fi, s_r: (fi, bi, li, 0)),
+            pl.BlockSpec((1, tile, e), lambda bi, li, fi, s_r: (bi, li, 0)),
+        ),
+    )
+    out, qs = pl.pallas_call(
+        functools.partial(_apply_seg_kernel, n_head=n_head),
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((f, b, l, e), q.dtype),
+            jax.ShapeDtypeStruct((b, l, e), q.dtype),
+        ),
+        interpret=interpret,
+    )(seg32, q, kv_g, ksum_g)
+    return out, qs
+
+
+def _apply_seg_ref(q, kv, ksum, seg, n_head: int):
+    """Einsum form of the segment apply (backward source + oracle)."""
+    b, l, e = q.shape
+    n_seg = kv.shape[1]
+    n = seg.shape[1]
+    c = l // n
+    shaped = q.reshape(*q.shape[:-1], n_head, e // n_head)
+    qs = jax.nn.softmax(shaped.astype(jnp.float32), axis=-1).reshape(q.shape)
+    bd = _block_diag_mask(e, e // n_head)
+    oh = jax.nn.one_hot(seg, n_seg + 1, dtype=jnp.float32)[..., :n_seg]  # [B,N,S]
+    kv_t = jnp.einsum("bns,fsde->fbnde", oh, kv * bd)
+    ks_t = jnp.einsum("bns,fse->fbne", oh, ksum[:, :, 0])
+    qc = qs.reshape(b, n, c, e)
+    # Per-head <q, k_sum>, broadcast to the head's lanes via bd (the
+    # masked unpacked op's denominator, per segment).
+    denom = jnp.einsum("bncd,fbnd,de->fbnce", qc, ks_t, bd)
+    denom = jnp.where(denom == 0.0, 1.0, denom)
+    out = jnp.einsum("bncd,fbnde->fbnce", qc, kv_t) / denom
+    return out.reshape(kv.shape[0], b, l, e).astype(q.dtype), qs.astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def nla_apply_seg(
+    q: Array,
+    kv: Array,
+    ksum: Array,
+    seg: Array,
+    n_head: int,
+    interpret: bool | None = None,
+):
+    """Apply per-SEGMENT Gram accumulators to packed query rows.
+
+    Each query tile gathers exactly its own segment's ``kv``/``k_sum``
+    (``seg [B, N]`` chunk->segment ids; pad chunks ``>= S`` emit 0), so
+    two segments sharing a row can never see each other's keys.
+
+    Returns ``(out [F, B, L, E], q_softmaxed [B, L, E])``, head-merged.
+    """
+    interpret = _interpret_default() if interpret is None else interpret
+    return _apply_seg_call(q, kv, ksum, seg, n_head, interpret)
+
+
+def _nla_apply_seg_fwd(q, kv, ksum, seg, n_head, interpret):
+    interpret = _interpret_default() if interpret is None else interpret
+    return _apply_seg_call(q, kv, ksum, seg, n_head, interpret), (q, kv, ksum, seg)
+
+
+def _nla_apply_seg_bwd(n_head, interpret, residuals, cotangents):
+    del interpret
+    q, kv, ksum, seg = residuals
+    _, vjp = jax.vjp(
+        lambda q_, kv_, ks_: _apply_seg_ref(q_, kv_, ks_, seg, n_head),
+        q, kv, ksum,
+    )
+    dq, dkv, dksum = vjp(cotangents)
+    return dq, dkv, dksum, np.zeros(seg.shape, jax.dtypes.float0)
+
+
+nla_apply_seg.defvjp(_nla_apply_seg_fwd, _nla_apply_seg_bwd)
+
+
+def fused_nla_packed(
+    q: Array,
+    k: Array,
+    v: Array,
+    mask: Array,
+    q_seg: Array,
+    kv_seg: Array,
+    n_seg: int,
+    n_head: int,
+    interpret: bool | None = None,
+):
+    """Fused normalized linear attention over PACKED rows.
+
+    The packed counterpart of ``fused_nla``: ``kv_seg``/``q_seg`` are
+    ``[B, N]`` chunk->segment id tables for the key and query rows
+    (DIFFERENT packings allowed — cross-attention packs input functions
+    separately; segments are global ids shared by both sides). Exact
+    per-segment attention: tokens never attend across segment
+    boundaries, so the result for each segment equals running the
+    unpacked kernel on that segment alone (fp summation order aside).
+    """
+    kv, ksum = nla_reduce_seg(k, v, mask, kv_seg, n_seg, n_head, interpret)
+    return nla_apply_seg(q, kv, ksum, q_seg, n_head, interpret)
+
+
+def _reference_seg_impl(q, k, v, mask, q_seg, kv_seg, n_seg: int, n_head: int):
+    """Full einsum oracle for the packed stages (tests)."""
+    kv, ksum = _reduce_seg_ref(k, v, mask, kv_seg, n_seg, n_head)
+    return _apply_seg_ref(q, kv, ksum, q_seg, n_head)

@@ -40,6 +40,7 @@ class Batcher:
         max_batch: int,
         max_wait_ms: float,
         key_fn: Callable[[object], Hashable],
+        take_fn: Callable[[Hashable, list], int | None] | None = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -48,6 +49,13 @@ class Batcher:
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1e3
         self.key_fn = key_fn
+        # Optional per-bucket dispatch-capacity override (the packed
+        # serve path): ``take_fn(key, requests) -> n | None`` returns
+        # how many of the FIFO prefix fit one dispatch (a first-fit
+        # packer for the packed bucket), or None for the default
+        # max_batch discipline. A bucket whose prefix-take is smaller
+        # than its queue is FULL (one whole dispatch is ready).
+        self.take_fn = take_fn
         # Per-bucket FIFO of (request, arrival) pairs: ages are
         # per-request, so a leftover surviving a size-based flush keeps
         # its true arrival time and the max_wait bound holds for it too
@@ -62,30 +70,64 @@ class Batcher:
             (request, now)
         )
 
+    def _take(self, key: Hashable, q: list) -> int | None:
+        """Prefix-take for the next dispatch from ``q`` under
+        ``take_fn`` (None = bucket uses the default max_batch
+        discipline). Clamped to ``[1, len(q)]``: the key_fn routes only
+        plan-fitting requests to a packed bucket, so a 0 from a
+        degenerate packer must not wedge the queue forever."""
+        if self.take_fn is None:
+            return None
+        n = self.take_fn(key, [r for r, _ in q])
+        if n is None:
+            return None
+        return max(1, min(n, len(q)))
+
     def pop_ready(
         self, now: float, *, flush_all: bool = False
     ) -> list[tuple[Hashable, list]]:
         """Flushable ``(bucket_key, requests)`` batches: full buckets
         always; aged buckets (oldest waiting >= max_wait); everything
         when ``flush_all`` (drain). Each batch holds at most
-        ``max_batch`` requests from ONE bucket; an overfull bucket
-        yields several batches in arrival order."""
+        ``max_batch`` requests from ONE bucket — or, for a bucket with
+        a ``take_fn`` capacity (the packed serve path), exactly the
+        FIFO prefix the packer says fits one dispatch; such a bucket is
+        FULL when its prefix-take is smaller than its queue (one whole
+        dispatch is ready and the next arrival already spills). An
+        overfull bucket yields several batches in arrival order."""
         out: list[tuple[Hashable, list]] = []
         for key in list(self._pending):
             q = self._pending[key]
-            ready = (
-                flush_all
-                or len(q) >= self.max_batch
-                or now - q[0][1] >= self.max_wait_s
-            )
-            if not ready:
-                continue
-            while q and (flush_all or len(q) >= self.max_batch):
-                out.append((key, [r for r, _ in q[: self.max_batch]]))
-                del q[: self.max_batch]
+            take = self._take(key, q)
+            if take is None:
+                ready = (
+                    flush_all
+                    or len(q) >= self.max_batch
+                    or now - q[0][1] >= self.max_wait_s
+                )
+                if not ready:
+                    continue
+                while q and (flush_all or len(q) >= self.max_batch):
+                    out.append((key, [r for r, _ in q[: self.max_batch]]))
+                    del q[: self.max_batch]
+            else:
+                ready = (
+                    flush_all
+                    or take < len(q)
+                    or now - q[0][1] >= self.max_wait_s
+                )
+                if not ready:
+                    continue
+                while q and (flush_all or take < len(q)):
+                    out.append((key, [r for r, _ in q[:take]]))
+                    del q[:take]
+                    if q:
+                        take = self._take(key, q)
             if q and not flush_all and now - q[0][1] >= self.max_wait_s:
                 # Aged flush of a partial bucket: take it all — the
-                # oldest entry has already waited its budget.
+                # oldest entry has already waited its budget. (In the
+                # take_fn case the size loop above has already cut the
+                # queue down to one whole dispatch: take == len(q).)
                 out.append((key, [r for r, _ in q]))
                 q.clear()
             if not q:

@@ -29,6 +29,18 @@ events flow through the ordinary ``MetricsSink`` (schema in
 docs/serving.md), so serving runs leave the same JSONL/manifest trail
 training runs do.
 
+With a ``pack_plan`` (``data/batch.py::PackPlan``, ``--serve_packed``)
+the server additionally runs PACKED dispatch: every plan-fitting
+request shares one ``PACKED_BUCKET`` whose dispatches are cut by
+first-fit FIFO prefix packing (``pack_prefix``) — many small requests
+ride ONE fixed-shape compiled program as chunk-aligned segments
+(``engine.infer_packed``) instead of one padded row each, per-segment
+unpad hands each request exactly its own nodes, and packing decisions
+flow through the same spans/events as padded dispatches (the
+``queue_depth`` event carries ``packed``/``real_tokens``/
+``capacity_tokens``; ``serve_summary`` gains ``pad_waste_by_bucket``).
+Oversize requests fall back to the ordinary padded per-bucket path.
+
 With a ``tracer`` (``obs/tracing.py``, ``--trace_path``) every request
 additionally gets a ``trace_id`` at submit and a host-side span chain
 ``admission -> queue_wait -> batch_assembly -> dispatch -> device ->
@@ -51,7 +63,7 @@ from typing import Callable
 
 import numpy as np
 
-from gnot_tpu.data.batch import MeshSample
+from gnot_tpu.data.batch import MeshSample, PackPlan, pack_prefix
 from gnot_tpu.obs import events
 from gnot_tpu.obs.tracing import percentiles
 from gnot_tpu.serve.batcher import Batcher
@@ -61,6 +73,12 @@ from gnot_tpu.serve.policies import (
     CircuitBreaker,
     Deadline,
 )
+
+#: The bucket key every plan-fitting request shares under packed
+#: dispatch mode (``pack_plan=``). Distinct from any ``(pn, pf)``
+#: bucket tuple; the batcher's take_fn sizes its dispatches by
+#: first-fit prefix packing instead of max_batch.
+PACKED_BUCKET = ("packed",)
 
 #: Terminal reasons a request can resolve with. "ok" carries an output;
 #: everything else is a degraded reject-with-reason response.
@@ -127,6 +145,7 @@ class InferenceServer:
         preempt=None,
         clock: Callable[[], float] = time.monotonic,
         tracer=None,
+        pack_plan: PackPlan | None = None,
     ):
         self.engine = engine
         self.sink = sink
@@ -147,10 +166,34 @@ class InferenceServer:
             cooldown_s=breaker_cooldown_s,
             clock=clock,
         )
+        # Packed dispatch mode ("pack, don't pad" on the serving hot
+        # path): plan-fitting requests all share ONE bucket whose
+        # dispatches are cut by first-fit FIFO prefix packing (many
+        # small requests ride one fixed-shape program as chunk-aligned
+        # segments) instead of one padded row per request. Oversize
+        # requests fall back to the ordinary per-bucket padded path, so
+        # packing never rejects traffic the padded server accepted.
+        self.pack_plan = pack_plan
+
+        def key_fn(r):
+            if pack_plan is not None and pack_plan.packable(r.sample):
+                return PACKED_BUCKET
+            return engine.bucket_key(r.sample)
+
+        def take_fn(key, reqs):
+            if key is not PACKED_BUCKET:
+                return None
+            return len(
+                pack_prefix(
+                    [r.sample.coords.shape[0] for r in reqs], pack_plan
+                )
+            )
+
         self.batcher = Batcher(
             max_batch=max_batch,
             max_wait_ms=max_wait_ms,
-            key_fn=lambda r: engine.bucket_key(r.sample),
+            key_fn=key_fn,
+            take_fn=take_fn if pack_plan is not None else None,
         )
         self._inbound: queue.Queue = queue.Queue()
         self._lock = threading.Lock()  # counters + admission ordinal
@@ -176,6 +219,13 @@ class InferenceServer:
         # is on; mutated by the worker, snapshotted by _summary on the
         # drain thread.
         self._bucket_stats: dict = {}  #: guarded_by _lock
+        # Per-bucket packing efficiency for serve_summary: bucket label
+        # -> {"dispatches", "real_tokens", "capacity_tokens"} over ALL
+        # dispatches (packed and padded alike — node tokens only, the
+        # FLOP-dominant axis), i.e. the measured pad waste the packing
+        # A/B (tools/pack_ab.py) compares. Mutated by the worker,
+        # snapshotted by _summary on the drain thread.
+        self._pack_stats: dict = {}  #: guarded_by _lock
 
     # -- client side -------------------------------------------------------
 
@@ -410,7 +460,15 @@ class InferenceServer:
                 return
 
     def _dispatch(self, key, reqs: list[_Request]) -> None:
-        pn, pf = key
+        packed = key is PACKED_BUCKET
+        if packed:
+            plan = self.pack_plan
+            pn = pf = None
+            bucket = f"packed:{plan.n_rows}x{plan.row_len}"
+        else:
+            plan = None
+            pn, pf = key
+            bucket = f"{pn}x{pf}"
         # Injected straggler: stall until the victim's deadline passes
         # (deterministic head-of-line blocking — docs/serving.md).
         if self.faults is not None:
@@ -423,7 +481,6 @@ class InferenceServer:
                     )
                     time.sleep(stall)
         now = self._clock()
-        bucket = f"{pn}x{pf}"
         live: list[_Request] = []
         for r in reqs:
             if r.deadline is not None and r.deadline.expired(now):
@@ -471,6 +528,36 @@ class InferenceServer:
                 **({"trace_ids": rejected_ids} if rejected_ids else {}),
             )
             return
+        if packed:
+            # First-fit prefix packing of the LIVE set (recomputed —
+            # deadline sheds may have changed it since the batcher's
+            # take, and first-fit is not monotone under removals, so a
+            # shed can occasionally leave a live set that no longer
+            # fits one dispatch). The loop cuts it into however many
+            # plan-shaped dispatches it needs, in arrival order.
+            rest = live
+            while rest:
+                placements = pack_prefix(
+                    [r.sample.coords.shape[0] for r in rest], plan
+                )
+                n = max(1, len(placements))
+                self._dispatch_one(
+                    rest[:n], placements[:n], bucket, now, pn, pf
+                )
+                rest = rest[n:]
+        else:
+            self._dispatch_one(live, None, bucket, now, pn, pf)
+
+    def _dispatch_one(
+        self, live, placements, bucket, now, pn, pf
+    ) -> None:
+        """ONE engine dispatch (one compiled-program execution) for an
+        already deadline/breaker-screened request group: lifecycle
+        spans, queue_depth event, pad-waste bookkeeping, forward,
+        output-finiteness scan, resolve. ``placements`` selects the
+        packed path (pack_plan-shaped dispatch); None is the ordinary
+        padded per-bucket dispatch."""
+        plan = self.pack_plan if placements is not None else None
         with self._lock:
             self._dispatches += 1
             dispatch = self._dispatches
@@ -491,26 +578,45 @@ class InferenceServer:
                     else {}
                 ),
             )
+        # Pad waste of this dispatch's static shape: real node tokens
+        # vs the compiled program's token capacity (padded path: rows x
+        # bucket length; packed path: the plan's fixed row grid).
+        real_tokens = sum(r.sample.coords.shape[0] for r in live)
+        capacity_tokens = (
+            plan.capacity_tokens if plan is not None else self.max_batch * pn
+        )
         self._event(
             events.QUEUE_DEPTH,
             depth=self.admission.depth,
             batched=len(self.batcher),
             dispatch=dispatch,
-            bucket_nodes=pn,
-            bucket_funcs=pf,
+            bucket_nodes=plan.row_len if plan is not None else pn,
+            bucket_funcs=plan.pad_funcs if plan is not None else pf,
             n=len(live),
+            packed=plan is not None,
+            real_tokens=real_tokens,
+            capacity_tokens=capacity_tokens,
             **({"trace_ids": member_ids} if member_ids else {}),
         )
         timings: dict | None = {} if member_ids else None
         try:
-            outs = self.engine.infer(
-                [r.sample for r in live],
-                pad_nodes=pn,
-                pad_funcs=pf,
-                rows=self.max_batch,
-                timings=timings,
-                clock=self._clock if timings is not None else None,
-            )
+            if plan is not None:
+                outs = self.engine.infer_packed(
+                    [r.sample for r in live],
+                    plan,
+                    placements=placements,
+                    timings=timings,
+                    clock=self._clock if timings is not None else None,
+                )
+            else:
+                outs = self.engine.infer(
+                    [r.sample for r in live],
+                    pad_nodes=pn,
+                    pad_funcs=pf,
+                    rows=self.max_batch,
+                    timings=timings,
+                    clock=self._clock if timings is not None else None,
+                )
         except Exception as err:  # noqa: BLE001 — device errors feed the breaker
             for r in live:
                 if r.trace is None:
@@ -529,6 +635,9 @@ class InferenceServer:
                 live, "error_dispatch", f"{type(err).__name__}: {err}"
             )
             return
+        # The program ran: its pad waste is real whatever the outputs
+        # hold, so the packing rollup counts it here.
+        self._note_pack(bucket, real_tokens, capacity_tokens)
         if self.faults is not None and self.faults.maybe_nan_output(dispatch):
             outs = [np.full_like(o, np.nan) for o in outs]
         bad = [
@@ -601,6 +710,20 @@ class InferenceServer:
                 queue_ms=[(start - r.submitted) * 1e3],
                 device_ms=[device_ms] if device_ms is not None else (),
             )
+
+    def _note_pack(
+        self, bucket: str, real_tokens: int, capacity_tokens: int
+    ) -> None:
+        """One executed dispatch's contribution to the per-bucket
+        packing-efficiency rollup (serve_summary.pad_waste_by_bucket)."""
+        with self._lock:
+            st = self._pack_stats.setdefault(
+                bucket,
+                {"dispatches": 0, "real_tokens": 0, "capacity_tokens": 0},
+            )
+            st["dispatches"] += 1
+            st["real_tokens"] += real_tokens
+            st["capacity_tokens"] += capacity_tokens
 
     def _note_bucket(self, bucket: str, queue_ms=(), device_ms=()) -> None:
         """One traced request's contribution to the per-bucket
@@ -690,6 +813,30 @@ class InferenceServer:
             bucket_stats = {
                 k: {kk: list(vv) for kk, vv in v.items()}
                 for k, v in self._bucket_stats.items()
+            }
+            pack_stats = {k: dict(v) for k, v in self._pack_stats.items()}
+        if pack_stats:
+            # Per-bucket pad-waste / packing efficiency over every
+            # executed dispatch: fill = real/capacity node tokens,
+            # pad_waste = 1 - fill. The packed bucket (when pack_plan
+            # is set) reports alongside the padded ones, so one summary
+            # shows what packing bought (tools/pack_ab.py compares
+            # these across arms).
+            summary["pad_waste_by_bucket"] = {
+                key: {
+                    **st,
+                    "fill_frac": (
+                        st["real_tokens"] / st["capacity_tokens"]
+                        if st["capacity_tokens"]
+                        else None
+                    ),
+                    "pad_waste_frac": (
+                        1.0 - st["real_tokens"] / st["capacity_tokens"]
+                        if st["capacity_tokens"]
+                        else None
+                    ),
+                }
+                for key, st in sorted(pack_stats.items())
             }
         if self._tracer is not None:
             # Span-derived queue-wait vs device-time breakdown per

@@ -71,6 +71,42 @@ def test_tracing_ab_artifact_schema():
     assert summary["ms_per_step_on"] == arms["tracing_on"]["ms_per_step"]
 
 
+def test_pack_ab_artifact_schema():
+    """The committed packing A/B (tools/pack_ab.py): four measured arms
+    plus a summary meeting the ISSUE 6 acceptance bar — pad waste DOWN
+    and throughput UP on BOTH hot paths, packed-vs-unpacked outputs
+    within 1e-5 per request."""
+    path = os.path.join(ARTIFACT_DIR, "pack_ab.jsonl")
+    with open(path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    arms = {r["arm"]: r for r in recs if "arm" in r}
+    assert set(arms) == {
+        "train_padded", "train_packed", "serve_unpacked", "serve_packed",
+    }
+    for r in arms.values():
+        assert 0.0 <= r["pad_waste_frac"] < 1.0
+    assert arms["train_padded"]["real_tokens"] > 0
+    assert arms["serve_packed"]["pack_chunk"] % 8 == 0
+    (summary,) = [r for r in recs if r.get("summary") == "pack_ab"]
+    # Pad waste reduced on both paths.
+    assert (
+        summary["train_pad_waste_packed"] < summary["train_pad_waste_padded"]
+    )
+    assert (
+        summary["serve_pad_waste_packed"] < summary["serve_pad_waste_unpacked"]
+    )
+    # Throughput improved on both paths (tokens/s train, requests/s serve).
+    assert summary["train_speedup"] > 1.0
+    assert summary["serve_speedup"] > 1.0
+    assert summary["train_speedup"] == pytest.approx(
+        summary["train_tokens_per_s_packed"]
+        / summary["train_tokens_per_s_padded"],
+        rel=1e-2,
+    )
+    # Numerics bar: packed output == solo padded output per request.
+    assert summary["max_abs_diff"] <= 1e-5
+
+
 def test_serve_trace_example_is_complete_chrome_trace():
     """The committed example trace (docs/observability.md "Reading a
     trace"): a real serve-smoke run whose completed requests each carry

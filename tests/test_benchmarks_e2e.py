@@ -206,11 +206,27 @@ def test_bench_scan_marginal_matches_persstep_on_cpu():
     dev = jax.devices()[0]
     lr = jnp.asarray(1e-3, jnp.float32)
 
-    per_scan = bench.time_scan_marginal(step, state, batch, lr, dev, 4, 16, 2)
-    per_step = bench.time_steps(step, state, batch, lr, 2, 16, dev, repeats=2)
+    # Deflaked (ISSUE 6 satellite): a single wall-clock sample of either
+    # estimator is at the mercy of host scheduling on a loaded CI box —
+    # compare MEDIANS over independent estimates, and tolerate the
+    # occasional degenerate marginal (noise swallowing T(k2)-T(k1)),
+    # which time_scan_marginal reports as a RuntimeError by design.
+    scans, steps = [], []
+    for _ in range(3):
+        try:
+            scans.append(
+                bench.time_scan_marginal(step, state, batch, lr, dev, 4, 16, 2)
+            )
+        except RuntimeError:
+            pass  # degenerate window; the median of the rest decides
+        steps.append(bench.time_steps(step, state, batch, lr, 2, 16, dev, repeats=2))
+    assert scans, "every scan-marginal window degenerated — workload too small"
+    per_scan = float(np.median(scans))
+    per_step = float(np.median(steps))
     assert per_scan > 0 and np.isfinite(per_scan)
     assert per_step > 0 and np.isfinite(per_step)
-    # Same device work; generous bound for host-loop overhead and CI noise.
+    # Same device work; generous ratio slack for host-loop overhead and
+    # CI noise.
     assert 0.2 < per_scan / per_step < 5.0
 
 

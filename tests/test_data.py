@@ -373,3 +373,64 @@ def test_packed_loader_covers_every_sample_each_epoch():
     # truncation by a wrong count would silently drop samples.
     unshuffled = PackedLoader(samples, batch_size=8, chunk=128)
     assert len(list(unshuffled)) == len(unshuffled)
+
+
+def _mesh(n, seed=0, n_func=12):
+    rng = np.random.default_rng(seed + n)
+    return MeshSample(
+        coords=rng.uniform(0, 1, size=(n, 2)).astype(np.float32),
+        y=np.zeros((n, 1), np.float32),
+        theta=np.zeros((1,), np.float32),
+        funcs=(rng.uniform(0, 1, size=(n_func, 3)).astype(np.float32),),
+    )
+
+
+def test_pack_plan_from_samples_invariants():
+    """The serve-side PackPlan derives a static dispatch shape from
+    representative traffic: chunk-aligned row_len, slot capacity no
+    packing can overflow, bucketed pad_funcs covering every function."""
+    from gnot_tpu.data.batch import PackPlan, bucket_length
+
+    samples = [_mesh(n) for n in (40, 90, 130, 64, 200)]
+    plan = PackPlan.from_samples(samples, chunk=64, batch_size=4)
+    assert plan.row_len % plan.chunk == 0
+    assert plan.n_slots == plan.n_rows * (plan.row_len // plan.chunk)
+    assert plan.pad_funcs == bucket_length(12)
+    # Every sample in the representative set is packable by its own plan.
+    assert all(plan.packable(s) for s in samples)
+    # Oversize (aligned span exceeds a row) and over-long functions are not.
+    assert not plan.packable(_mesh(plan.row_len + 1))
+    assert not plan.packable(_mesh(40, n_func=plan.pad_funcs + 1))
+    with pytest.raises(ValueError, match="at least one sample"):
+        PackPlan.from_samples([], chunk=64)
+    with pytest.raises(ValueError, match="multiple of chunk"):
+        PackPlan(row_len=100, chunk=64, n_rows=1, n_slots=1, pad_funcs=0)
+
+
+def test_pack_prefix_is_fifo_prefix():
+    """pack_prefix packs an ARRIVAL-ORDER PREFIX: it stops at the first
+    sample that fits nowhere — a newer small request never overtakes an
+    older big one (the Batcher's FIFO/monotone queue-wait contract
+    depends on this). Placements are chunk-aligned, in-bounds and
+    non-overlapping."""
+    from gnot_tpu.data.batch import PackPlan, pack_prefix
+
+    plan = PackPlan(row_len=256, chunk=64, n_rows=2, n_slots=8, pad_funcs=64)
+    # 200 -> 256 aligned fills row 0; 200 again fills row 1; the 250
+    # fits NOWHERE, so packing stops there even though the trailing 10
+    # would fit — prefix discipline.
+    sizes = [200, 200, 250, 10]
+    placed = pack_prefix(sizes, plan)
+    assert len(placed) == 2
+    used: set = set()
+    for (r, off), n in zip(placed, sizes):
+        assert 0 <= r < plan.n_rows and off % plan.chunk == 0
+        span = range(off, off + plan.aligned(n))
+        assert off + plan.aligned(n) <= plan.row_len
+        assert not (used & set((r, t) for t in span))
+        used |= set((r, t) for t in span)
+    # Small meshes pack many-per-row, capped by the slot budget.
+    placed_small = pack_prefix([10] * 20, plan)
+    assert len(placed_small) == plan.n_slots
+    # Everything fitting -> everything placed.
+    assert len(pack_prefix([64, 64, 64], plan)) == 3

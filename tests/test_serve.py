@@ -532,3 +532,180 @@ def test_long_mixed_storm_with_faults(setup, tmp_path):
     events = read_events(path)
     _storm_asserts(events, engine, traffic)
     assert summary["breaker_trips"] >= 1
+
+
+# --- packed dispatch ("pack, don't pad" on the serving hot path) ----------
+
+
+def _ragged_traffic(setup, sizes, seed=0):
+    """Small ragged meshes in the module's Darcy schema (same theta /
+    func feature dims, varying node and function-row counts)."""
+    _, _, samples, _ = setup
+    rng = np.random.default_rng(seed)
+    f_dim = samples[0].funcs[0].shape[-1]
+    out = []
+    for i, m in enumerate(sizes):
+        out.append(
+            MeshSample(
+                coords=rng.uniform(0, 1, size=(m, 2)).astype(np.float32),
+                y=np.zeros((m, 1), np.float32),
+                theta=samples[0].theta,
+                funcs=(
+                    rng.uniform(
+                        0, 1, size=(max(4, m // 4), f_dim)
+                    ).astype(np.float32),
+                ),
+            )
+        )
+    return out
+
+
+def test_batcher_take_fn_prefix_capacity():
+    """A take_fn bucket dispatches exactly the FIFO prefix the packer
+    says fits: the bucket is FULL when the prefix-take is smaller than
+    its queue, aged flushes still take whole dispatches, and other
+    buckets keep the max_batch discipline."""
+    def take(key, reqs):
+        if key != "packed":
+            return None
+        return min(2, len(reqs))  # two requests per dispatch
+
+    b = Batcher(max_batch=8, max_wait_ms=100, key_fn=lambda r: r[0], take_fn=take)
+    b.add(("packed", 1), now=0.0)
+    b.add(("packed", 2), now=0.01)
+    # take == len(q): one whole dispatch is pending but nothing spills
+    # yet — not full, not aged.
+    assert b.pop_ready(0.02) == []
+    b.add(("packed", 3), now=0.02)  # spills -> FULL
+    [(key, reqs)] = b.pop_ready(0.03)
+    assert key == "packed" and [r[1] for r in reqs] == [1, 2]
+    # The leftover ages out as one whole dispatch.
+    [(key, reqs)] = b.pop_ready(0.2)
+    assert [r[1] for r in reqs] == [3]
+    # A non-take_fn bucket is untouched by the packer.
+    b.add(("pad", 4), now=0.0)
+    assert b.pop_ready(0.01) == []
+    [(key, reqs)] = b.pop_ready(0.2)
+    assert key == "pad" and len(reqs) == 1
+    # flush_all drains a take_fn bucket in dispatch-sized cuts.
+    for i in range(5):
+        b.add(("packed", i), now=0.5)
+    batches = b.pop_ready(0.5, flush_all=True)
+    assert [len(r) for _, r in batches] == [2, 2, 1]
+
+
+def test_engine_infer_packed_matches_solo(setup):
+    """Per-request outputs of ONE packed dispatch == each request's own
+    padded dispatch (<= 1e-5, the ISSUE 6 bar), with exactly-per-request
+    unpad shapes; repeat dispatches at different fills reuse the ONE
+    compiled program."""
+    from gnot_tpu.data.batch import PackPlan
+
+    model, params, samples, engine = setup
+    traffic = _ragged_traffic(setup, [16, 40, 24, 64, 8, 32])
+    plan = PackPlan.from_samples(traffic, chunk=8, batch_size=8)
+    assert all(plan.packable(s) for s in traffic)
+    assert engine.warmup_packed(traffic, plan) == 1
+    shapes_before = engine.compiled_shapes
+    outs = engine.infer_packed(traffic, plan)
+    assert engine.compiled_shapes == shapes_before  # warmed, no recompile
+    for s, o in zip(traffic, outs):
+        assert o.shape[0] == s.coords.shape[0]
+        key = engine.bucket_key(s)
+        solo = engine.infer(
+            [s], pad_nodes=key[0], pad_funcs=key[1], rows=MAX_BATCH
+        )[0]
+        np.testing.assert_allclose(o, solo, rtol=1e-5, atol=1e-5)
+    # A different fill level of the same plan: same program.
+    engine.infer_packed(traffic[:2], plan)
+    assert engine.compiled_shapes == shapes_before
+
+
+def test_packed_server_end_to_end(setup, tmp_path):
+    """Packed dispatch through the whole server: plan-fitting requests
+    ride pack-plan dispatches (packed=True queue_depth events), an
+    oversize request falls back to the padded per-bucket path, every
+    Future resolves with exactly its own nodes matching the solo
+    dispatch <= 1e-5, and serve_summary reports per-bucket pad-waste
+    with the packed bucket's fill above the padded path's for the same
+    small-mesh traffic."""
+    from gnot_tpu.data.batch import PackPlan
+
+    model, params, samples, engine = setup
+    small = _ragged_traffic(setup, [16, 40, 24, 64, 8, 32, 48, 16])
+    plan = PackPlan.from_samples(small, chunk=8, batch_size=4)
+    oversize = _ragged_traffic(setup, [plan.row_len + 8], seed=5)[0]
+    engine.warmup(small + [oversize], rows=MAX_BATCH)
+    engine.warmup_packed(small, plan)
+    server, sink, path = make_server(setup, tmp_path, pack_plan=plan)
+    with sink:
+        server.start()
+        futures = [server.submit(s) for s in small + [oversize]]
+        results = [f.result(timeout=60) for f in futures]
+        summary = server.drain()
+    assert all(r.ok for r in results), [r.reason for r in results]
+    for s, r in zip(small + [oversize], results):
+        assert r.output.shape[0] == s.coords.shape[0]
+        key = engine.bucket_key(s)
+        solo = engine.infer(
+            [s], pad_nodes=key[0], pad_funcs=key[1], rows=MAX_BATCH
+        )[0]
+        np.testing.assert_allclose(r.output, solo, rtol=1e-5, atol=1e-5)
+    events = read_events(path)
+    dispatches = [e for e in events if e["event"] == "queue_depth"]
+    packed_d = [e for e in dispatches if e["packed"]]
+    padded_d = [e for e in dispatches if not e["packed"]]
+    assert packed_d, "no packed dispatch happened"
+    assert padded_d, "oversize request did not fall back to padded path"
+    for e in dispatches:
+        assert 0 < e["real_tokens"] <= e["capacity_tokens"]
+    # The oversize fallback went to its own (pn, pf) bucket.
+    ob = engine.bucket_key(oversize)
+    assert any(
+        (e["bucket_nodes"], e["bucket_funcs"]) == ob for e in padded_d
+    )
+    # Packing efficiency rollup: both bucket families present, fractions
+    # coherent, and packing beat row-per-request padding for the smalls.
+    pw = summary["pad_waste_by_bucket"]
+    packed_key = f"packed:{plan.n_rows}x{plan.row_len}"
+    assert packed_key in pw
+    st = pw[packed_key]
+    assert st["real_tokens"] == sum(s.coords.shape[0] for s in small)
+    assert st["fill_frac"] == pytest.approx(
+        st["real_tokens"] / st["capacity_tokens"]
+    )
+    padded_fill = sum(s.coords.shape[0] for s in small) / (
+        len(small) * bucket_length(max(s.coords.shape[0] for s in small))
+    )
+    assert st["fill_frac"] > padded_fill, (
+        f"packing ({st['fill_frac']:.2%}) should beat row-per-request "
+        f"padding ({padded_fill:.2%}) on small-mesh traffic"
+    )
+
+
+def test_packed_server_deadline_shed_repack(setup, tmp_path):
+    """A deadline shed between batcher cut and dispatch shrinks the
+    live set; the dispatch path re-packs what remains and every
+    surviving request still resolves correctly."""
+    from gnot_tpu.data.batch import PackPlan
+
+    model, params, samples, engine = setup
+    small = _ragged_traffic(setup, [16, 24, 32, 8])
+    plan = PackPlan.from_samples(small, chunk=8, batch_size=4)
+    engine.warmup_packed(small, plan)
+    server, sink, path = make_server(
+        setup, tmp_path, pack_plan=plan,
+        faults=FaultInjector.from_spec("slow_request@1"),
+        default_deadline_ms=150.0,
+    )
+    with sink:
+        server.start()
+        futures = [server.submit(s) for s in small]
+        results = [f.result(timeout=60) for f in futures]
+        server.drain()
+    shed = [r for r in results if not r.ok]
+    ok = [r for r in results if r.ok]
+    assert shed, "the injected straggler should shed at least one deadline"
+    for s, r in zip(small, results):
+        if r.ok:
+            assert r.output.shape[0] == s.coords.shape[0]

@@ -298,7 +298,17 @@ def test_multi_step_dispatch_matches_single_steps():
     lrs = [1e-3, 9e-4, 8e-4, 7e-4]
 
     s1 = init_state(model, optim, batches[0], seed=0)
-    host = jax.device_get(s1.params)
+    # DEEP copies, not jax.device_get: on the CPU backend device_get
+    # returns zero-copy views of the device buffers, and the donated
+    # (donate_argnums=(0,)) train_step below may write its updated
+    # params INto those very buffers — whether it actually does depends
+    # on the executable's buffer assignment, which differs between a
+    # fresh XLA compile and a persistent-compile-cache load. That made
+    # this test fail only with a warm compile cache (losses2 came out
+    # as steps 5-8 of a continued run: s2 silently started from s1's
+    # FINAL params). Root cause of the long-standing tier-1 failure —
+    # use-after-donate through an aliased host view, not numerics.
+    host = jax.tree.map(lambda x: np.array(x, copy=True), s1.params)
     single = make_train_step(model, optim, "rel_l2")
     losses1 = []
     for b, lr in zip(batches, lrs):

@@ -61,11 +61,13 @@ def build_engine(seed: int = 0, max_batch: int = 4):
     return InferenceEngine(model, params, batch_size=max_batch)
 
 
-def mixed_traffic(n: int, seed: int = 0):
-    """Darcy64 queries (64 points) interleaved with elasticity-sized
-    ragged clouds (~300-700 points) in the SAME operator schema — the
-    adversarial mix that makes naive padding pathological (ISSUE 3) and
-    exercises multiple buckets."""
+def mixed_traffic(n: int, seed: int = 0, mesh_lo: int = 300, mesh_hi: int = 700):
+    """Darcy64 queries (64 points) interleaved with ragged clouds
+    (``mesh_lo``..``mesh_hi`` points, default elasticity-sized 300-700)
+    in the SAME operator schema — the adversarial mix that makes naive
+    padding pathological (ISSUE 3) and exercises multiple buckets.
+    Small ``mesh_hi`` (e.g. 200) makes the mixed SMALL-mesh workload the
+    packing A/B (tools/pack_ab.py) measures."""
     from gnot_tpu.data import datasets
     from gnot_tpu.data.batch import MeshSample
 
@@ -76,7 +78,7 @@ def mixed_traffic(n: int, seed: int = 0):
         if i % 2 == 0:
             out.append(darcy[i])
             continue
-        m = int(rng.integers(300, 700))
+        m = int(rng.integers(mesh_lo, mesh_hi))
         coords = rng.uniform(0, 1, size=(m, 2)).astype(np.float32)
         f = rng.uniform(0, 1, size=(m // 4, 3)).astype(np.float32)
         out.append(
@@ -118,8 +120,28 @@ def run(argv=None) -> dict:
              "criterion)"
     )
     p.add_argument("--trace_sample_rate", type=float, default=1.0)
+    p.add_argument(
+        "--packed", action="store_true",
+        help="packed dispatch mode ('pack, don't pad', docs/performance"
+             ".md): derive a PackPlan from the traffic, pack plan-"
+             "fitting requests as chunk-aligned segments into ONE "
+             "fixed-shape program per dispatch; oversize requests fall "
+             "back to the padded per-bucket path. The smoke then ALSO "
+             "asserts packed-dispatch bucket discipline and the "
+             "serve_summary pad-waste rollup"
+    )
+    p.add_argument("--pack_chunk", type=int, default=64,
+                   help="packed-mode segment alignment (tokens)")
+    p.add_argument(
+        "--mesh_lo", type=int, default=300,
+        help="ragged-cloud size range lower bound (with --mesh_hi; "
+             "small values make the mixed small-mesh packing workload)"
+    )
+    p.add_argument("--mesh_hi", type=int, default=700)
     args = p.parse_args(argv)
-    if not args.inject_fault:
+    if args.inject_fault == "none":
+        args.inject_fault = ""
+    elif not args.inject_fault:
         args.inject_fault = f"slow_request@{args.n}"
 
     from gnot_tpu.data.batch import bucket_length
@@ -138,11 +160,21 @@ def run(argv=None) -> dict:
             path=args.trace_path, sample_rate=args.trace_sample_rate
         )
     engine = build_engine(max_batch=args.max_batch)
-    traffic = mixed_traffic(args.n)
+    traffic = mixed_traffic(args.n, mesh_lo=args.mesh_lo, mesh_hi=args.mesh_hi)
     # Precompile every bucket the storm will hit (serving-startup
     # discipline — docs/serving.md): an XLA compile landing under a
     # 200 ms deadline would shed everything queued behind it.
     engine.warmup(traffic, rows=args.max_batch)
+    pack_plan = None
+    if args.packed:
+        from gnot_tpu.data.batch import PackPlan
+
+        pack_plan = PackPlan.from_samples(
+            traffic, chunk=args.pack_chunk, batch_size=args.max_batch
+        )
+        engine.warmup_packed(traffic, pack_plan)
+    import time as _time
+
     with MetricsSink(metrics_path) as sink:
         server = InferenceServer(
             engine,
@@ -153,12 +185,20 @@ def run(argv=None) -> dict:
             sink=sink,
             faults=FaultInjector.from_spec(args.inject_fault),
             tracer=tracer,
+            pack_plan=pack_plan,
         ).start()
+        t_submit = _time.perf_counter()
         futures = [server.submit(s) for s in traffic]
         results = [f.result(timeout=120) for f in futures]
+        wall_s = _time.perf_counter() - t_submit
         summary = server.drain()
         if tracer is not None:
             tracer.flush(sink=sink)
+    # Storm throughput (submit -> last resolve; the pack_ab serve
+    # metric). Not part of the serve_summary event schema — stamped on
+    # the RETURNED dict only, after the sink closed.
+    summary["wall_s"] = wall_s
+    summary["requests_per_s"] = args.n / wall_s if wall_s > 0 else None
 
     # -- assertions (the point of a smoke test) ----------------------------
     failures = []
@@ -185,11 +225,15 @@ def run(argv=None) -> dict:
         and summary["latency_p50_ms"] <= summary["latency_p99_ms"],
         f"latency percentiles malformed: {summary}",
     )
-    # Bucket discipline from the event stream: every dispatch names ONE
-    # bucket, and the engine compiled at most one program per bucket.
+    # Bucket discipline from the event stream: every PADDED dispatch
+    # names ONE bucket, every PACKED dispatch carries the plan's fixed
+    # shape, and the engine compiled at most one program per bucket
+    # (+1 for the pack plan).
     events = [json.loads(l) for l in open(metrics_path)]
     dispatches = [e for e in events if e.get("event") == "queue_depth"]
-    buckets = {(e["bucket_nodes"], e["bucket_funcs"]) for e in dispatches}
+    padded_d = [e for e in dispatches if not e.get("packed")]
+    packed_d = [e for e in dispatches if e.get("packed")]
+    buckets = {(e["bucket_nodes"], e["bucket_funcs"]) for e in padded_d}
     lengths = {s.coords.shape[0] for s in traffic}
     expected = {
         (bucket_length(n), bucket_length(max(f.shape[0] for f in s.funcs)))
@@ -204,10 +248,37 @@ def run(argv=None) -> dict:
     l_max = bucket_length(max(lengths))
     bound = 2 * (int(math.log2(l_max / 64)) + 1)  # ~2 per octave, 2 axes
     check(
-        summary["compiled_shapes"] <= max(len(expected), bound),
+        summary["compiled_shapes"]
+        <= max(len(expected), bound) + (1 if pack_plan is not None else 0),
         f"{summary['compiled_shapes']} compiled shapes exceeds the "
         f"O(log L) bound ({bound}) / bucket count ({len(expected)})",
     )
+    check(
+        all(
+            0 < e["real_tokens"] <= e["capacity_tokens"] for e in dispatches
+        ),
+        "a dispatch reported incoherent real/capacity token counts",
+    )
+    if pack_plan is not None:
+        check(
+            bool(packed_d),
+            "packed mode on but no dispatch rode the pack plan",
+        )
+        check(
+            all(
+                (e["bucket_nodes"], e["bucket_funcs"])
+                == (pack_plan.row_len, pack_plan.pad_funcs)
+                for e in packed_d
+            ),
+            "a packed dispatch escaped the plan's fixed shape",
+        )
+        pw = summary.get("pad_waste_by_bucket") or {}
+        pk = f"packed:{pack_plan.n_rows}x{pack_plan.row_len}"
+        check(
+            pk in pw and pw[pk]["fill_frac"] is not None,
+            f"serve_summary.pad_waste_by_bucket missing the packed "
+            f"bucket {pk}: {sorted(pw)}",
+        )
     check(
         any(e.get("event") == "serve_summary" for e in events),
         "no serve_summary event in the sink",
@@ -294,7 +365,9 @@ def run(argv=None) -> dict:
         f"serve_smoke: {n_ok}/{args.n} ok, shed={summary['shed']}, "
         f"p50={p50 if p50 is None else round(p50, 1)}ms "
         f"p99={p99 if p99 is None else round(p99, 1)}ms, "
-        f"buckets={sorted(buckets)}, compiled={summary['compiled_shapes']}"
+        f"buckets={sorted(buckets)}, compiled={summary['compiled_shapes']}, "
+        f"{len(packed_d)} packed / {len(padded_d)} padded dispatches, "
+        f"{summary['requests_per_s']:.1f} req/s"
     )
     for msg in failures:
         print(f"FAIL: {msg}")
