@@ -348,10 +348,36 @@ class ServeConfig:
     # meshes tighter, larger gives the MXU longer contiguous spans.
     packed: bool = False
     pack_chunk: int = 64
+    # Replicated serving (serve/router.py + serve/replica.py,
+    # docs/serving.md "Replicated serving"): N engine replicas over
+    # disjoint device slices behind a compile-affinity router. 1 = the
+    # single-server tier (unchanged). Each replica gets its own
+    # admission queue/batcher/breaker; max_batch must divide by the
+    # per-replica device-slice size.
+    replicas: int = 1
+    # Router placement policy: "affinity" (prefer the replica that
+    # already compiled the request's bucket — cold compiles land on one
+    # replica, never the pool), "least_loaded", or "round_robin".
+    route_policy: str = "affinity"
+    # Seconds of worker-loop silence (with requests in-system) before
+    # the router treats a replica as wedged and drains its traffic to
+    # siblings.
+    wedge_after_s: float = 2.0
 
     def __post_init__(self) -> None:
         if self.max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {self.max_batch}")
+        if self.replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {self.replicas}")
+        if self.route_policy not in ("affinity", "least_loaded", "round_robin"):
+            raise ValueError(
+                f"unknown route_policy {self.route_policy!r}; one of "
+                "('affinity', 'least_loaded', 'round_robin')"
+            )
+        if self.wedge_after_s <= 0:
+            raise ValueError(
+                f"wedge_after_s must be > 0, got {self.wedge_after_s}"
+            )
         if self.pack_chunk < 8 or self.pack_chunk % 8:
             raise ValueError(
                 f"pack_chunk must be a positive multiple of 8, got "

@@ -201,6 +201,22 @@ def build_parser() -> argparse.ArgumentParser:
              "(multiple of 8)"
     )
     p.add_argument(
+        "--serve_replicas", type=int, default=1,
+        help="serving: engine replicas behind the compile-affinity "
+             "router (serve/router.py) — each replica owns a disjoint "
+             "device slice (GSPMD NamedSharding placement), its own "
+             "queue/batcher/breaker, and reloads roll across the pool "
+             "one replica at a time; 1 = the single-server tier "
+             "(docs/serving.md 'Replicated serving')"
+    )
+    p.add_argument(
+        "--route_policy", type=str, default="affinity",
+        choices=["affinity", "least_loaded", "round_robin"],
+        help="serving: replica placement policy — affinity (prefer the "
+             "replica that already compiled the request's bucket; cold "
+             "compiles never stall the pool), least_loaded, round_robin"
+    )
+    p.add_argument(
         "--serve_reload_every", type=int, default=0,
         help="serving demo traffic: hot-reload the checkpoint after "
              "every N requests (0 = never) — exercises the atomic "
@@ -371,6 +387,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "serve.inject_fault": args.serve_inject_fault,
             "serve.packed": args.serve_packed,
             "serve.pack_chunk": args.serve_pack_chunk,
+            "serve.replicas": args.serve_replicas,
+            "serve.route_policy": args.route_policy,
             "mesh.data": args.mesh_data,
             "mesh.seq": args.mesh_seq,
             "mesh.model": args.mesh_model,
@@ -648,6 +666,11 @@ def main(argv=None) -> float:
             cfg, mc, train_samples, test_samples, metrics_sink=sink,
             checkpointer=checkpointer, tracer=tracer,
         )
+        # Late-arriving manifest fields (e.g. the serve-warmup compile-
+        # cache hit/miss stats — known only after warmup ran); the
+        # post-serve re-write merges them in.
+        manifest_extra: dict = {}
+
         def write_run_manifest():
             # Provenance manifest — docs/observability.md.
             import sys
@@ -675,6 +698,7 @@ def main(argv=None) -> float:
                         if checkpointer is not None
                         else None
                     ),
+                    **manifest_extra,
                 },
             )
 
@@ -686,10 +710,12 @@ def main(argv=None) -> float:
         if args.serve:
             result = _run_serve(
                 args, cfg, trainer, full_test_samples, sink, checkpointer,
-                tracer=tracer,
+                tracer=tracer, manifest_extra=manifest_extra,
             )
-            if manifests_on and checkpointer is not None:
-                # Record which checkpoint serving actually restored.
+            if manifests_on:
+                # Record which checkpoint serving actually restored AND
+                # the warmup compile-cache hit/miss stats (known only
+                # after warmup ran).
                 write_run_manifest()
         elif args.eval_only:
             result = trainer.evaluate_from_checkpoint()
@@ -735,19 +761,31 @@ def main(argv=None) -> float:
 
 
 def _run_serve(
-    args, cfg, trainer, samples, sink, checkpointer, tracer=None
+    args, cfg, trainer, samples, sink, checkpointer, tracer=None,
+    manifest_extra=None,
 ) -> float:
-    """``--serve``: restore weights, start the fault-tolerant
-    InferenceServer, drive the test set through it as a request stream
-    (the in-process demo/smoke traffic — a network transport would sit
-    in front of ``server.submit``), drain gracefully, and report. A
-    SIGTERM mid-stream stops admission and drains in-flight requests
-    (resilience.preemption). Returns the completed-request fraction."""
+    """``--serve``: restore weights, start the fault-tolerant serving
+    tier — ONE InferenceServer, or with ``--serve_replicas N`` the
+    compile-affinity ``ReplicaRouter`` over N mesh-sliced engine
+    replicas — drive the test set through it as a request stream (the
+    in-process demo/smoke traffic; a network transport would sit in
+    front of ``submit``), drain gracefully, and report. A SIGTERM
+    mid-stream stops admission and drains in-flight requests
+    (resilience.preemption). Reloads roll across the replica pool one
+    replica at a time. Warmup runs under the compile-cache probe and
+    records cache hit/miss into the run manifest. Returns the
+    completed-request fraction."""
     import jax
 
     from gnot_tpu.resilience.faults import FaultInjector
     from gnot_tpu.resilience.preemption import PreemptionHandler
-    from gnot_tpu.serve import CheckpointReloader, InferenceServer
+    from gnot_tpu.serve import (
+        CheckpointReloader,
+        InferenceServer,
+        ReplicaRouter,
+        build_replicas,
+    )
+    from gnot_tpu.utils.cache import compile_cache_probe
 
     if jax.process_count() > 1:
         raise ValueError(
@@ -765,28 +803,95 @@ def _run_serve(
         else:
             print("note: no restorable checkpoint — serving fresh weights")
     sc = cfg.serve
-    engine = trainer.inference_engine()
+    if sc.replicas > 1 and trainer.mesh is not None:
+        raise ValueError(
+            "--serve_replicas builds its own per-replica mesh slices; "
+            "drop --distributed (the trainer mesh) when serving "
+            "replicated"
+        )
+    if sc.replicas > 1 and (
+        trainer.model.config.scan_layers or cfg.optim.flat_params
+    ):
+        # build_replicas' forward is the standard-layout apply_batch;
+        # the stacked (scan_layers) and flat [P]-vector param layouts
+        # need the trainer's layout-aware forward, which replicated
+        # serving does not thread yet. Fail with the flag to flip
+        # instead of a flax structure error at warmup.
+        raise ValueError(
+            "--serve_replicas serves the standard param layout only; "
+            "drop --scan_layers/--flat_params for replicated serving "
+            "(single-server --serve supports them)"
+        )
     # Packed dispatch ("pack, don't pad", docs/performance.md): derive
     # the ONE fixed dispatch shape from the traffic itself — the same
     # samples we are about to serve are the representative set.
     pack_plan = None
     if sc.packed:
+        import jax as _jax
+
         from gnot_tpu.data.batch import PackPlan
 
         pack_plan = PackPlan.from_samples(
             samples, chunk=sc.pack_chunk, batch_size=sc.max_batch
         )
+        per = (
+            len(_jax.devices()) // sc.replicas if sc.replicas > 1 else 1
+        )
+        if pack_plan.n_rows % max(1, per):
+            # Packed dispatch rows shard over each replica's device
+            # slice exactly like padded rows; align the plan's row
+            # grid up so every slice gets whole rows.
+            pack_plan = PackPlan.from_samples(
+                samples,
+                chunk=sc.pack_chunk,
+                batch_size=sc.max_batch,
+                n_rows=-(-pack_plan.n_rows // per) * per,
+            )
+    reload_fn = (
+        CheckpointReloader(checkpointer, trainer.state)
+        if checkpointer is not None
+        else None
+    )
+    replicas = None
+    if sc.replicas > 1:
+        tl = trainer.train_loader
+        replicas = build_replicas(
+            trainer.model,
+            trainer.state.params,
+            sc.replicas,
+            batch_size=sc.max_batch,
+            bucket=cfg.data.bucket,
+            pad_nodes=tl.pad_nodes,
+            pad_funcs=tl.pad_funcs,
+        )
+    else:
+        engine = trainer.inference_engine()
     # Serving-startup discipline (docs/serving.md): precompile one
     # program per bucket the traffic will hit — a cold XLA compile
     # landing under a tight deadline would shed everything behind it.
     # Packed mode still warms the padded buckets too (the oversize
-    # fallback path).
-    engine.warmup(samples, rows=sc.max_batch)
-    if pack_plan is not None:
-        engine.warmup_packed(samples, pack_plan)
+    # fallback path). The probe records persistent-compile-cache
+    # hits/misses for the manifest: warm time is THE replica scale-out
+    # cost, and whether it compiled fresh or loaded cached executables
+    # is the number to watch (ROADMAP cold-start item).
+    with compile_cache_probe() as warm_stats:
+        if replicas is not None:
+            warmed = sum(
+                r.warm(samples, rows=sc.max_batch, pack_plan=pack_plan)
+                for r in replicas
+            )
+        else:
+            warmed = engine.warmup(samples, rows=sc.max_batch)
+            if pack_plan is not None:
+                warmed += engine.warmup_packed(samples, pack_plan)
+    if manifest_extra is not None:
+        manifest_extra["warmup_cache"] = {
+            "programs_warmed": warmed,
+            "replicas": sc.replicas,
+            **warm_stats,
+        }
     with PreemptionHandler() as preempt:
-        server = InferenceServer(
-            engine,
+        common = dict(
             max_batch=sc.max_batch,
             max_wait_ms=sc.max_wait_ms,
             queue_limit=sc.queue_limit,
@@ -795,15 +900,20 @@ def _run_serve(
             breaker_cooldown_s=sc.breaker_cooldown_s,
             pack_plan=pack_plan,
             sink=sink,
-            reload_fn=(
-                CheckpointReloader(checkpointer, trainer.state)
-                if checkpointer is not None
-                else None
-            ),
+            reload_fn=reload_fn,
             faults=FaultInjector.from_spec(sc.inject_fault),
             preempt=preempt,
             tracer=tracer,
-        ).start()
+        )
+        if replicas is not None:
+            server = ReplicaRouter(
+                replicas,
+                route_policy=sc.route_policy,
+                wedge_after_s=sc.wedge_after_s,
+                **common,
+            ).start()
+        else:
+            server = InferenceServer(engine, **common).start()
         futures = []
         for i, s in enumerate(samples):
             if preempt.triggered:
@@ -814,16 +924,25 @@ def _run_serve(
                 and checkpointer is not None
                 and (i + 1) % args.serve_reload_every == 0
             ):
+                # On the router this is the ROLLING reload: one replica
+                # warms at a time, old weights keep serving.
                 server.reload(deadline_ms=sc.deadline_ms)
         for f in futures:
             f.result(timeout=sc.drain_timeout_s)
         summary = server.drain(sc.drain_timeout_s)
+    routing = summary.get("routing")
     print(
         f"Serve: {summary['completed']}/{summary['requests']} ok, "
         f"shed={summary['shed']}, breaker_trips={summary['breaker_trips']}, "
         f"reloads={summary['reloads']}, "
         f"p50={summary['latency_p50_ms']}ms p99={summary['latency_p99_ms']}ms, "
         f"compiled_shapes={summary['compiled_shapes']}"
+        + (
+            f", replicas={routing['replicas']} policy={routing['policy']} "
+            f"spills={routing['spills']}"
+            if routing
+            else ""
+        )
     )
     return summary["completed"] / max(1, summary["requests"])
 

@@ -44,6 +44,9 @@ DRAIN_TIMEOUT = "drain_timeout"
 RELOAD = "reload"
 SERVE_SUMMARY = "serve_summary"
 TRACE_FLUSH = "trace_flush"
+ROUTE = "route"
+REPLICA_HEALTH = "replica_health"
+ROLLING_RELOAD = "rolling_reload"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -138,35 +141,37 @@ EVENTS: dict[str, EventSpec] = {
         doc="one serving dispatch (depth at flush, its bucket, and the "
         "dispatch's real-vs-capacity node tokens; `packed` marks a "
         "pack_plan dispatch)",
-        optional=("trace_ids",),
+        optional=("trace_ids", "replica"),
     ),
     "shed": EventSpec(
         fields=("reason",),
         module="gnot_tpu/serve/server.py",
         doc="a request was shed/rejected (reason + per-reason detail)",
-        optional=("trace_id", "trace_ids"),
+        optional=("trace_id", "trace_ids", "replica"),
     ),
     "breaker_open": EventSpec(
         fields=("state", "reason", "detail", "trips"),
         module="gnot_tpu/serve/server.py",
         doc="circuit breaker tripped open (backend unhealthy)",
-        optional=("trace_id",),
+        optional=("trace_id", "replica"),
     ),
     "breaker_close": EventSpec(
         fields=("state",),
         module="gnot_tpu/serve/server.py",
         doc="half-open trial succeeded; breaker closed",
+        optional=("replica",),
     ),
     "drain_timeout": EventSpec(
         fields=("timeout_s",),
         module="gnot_tpu/serve/server.py",
         doc="graceful drain exceeded its budget (wedged dispatch)",
+        optional=("replica",),
     ),
     "reload": EventSpec(
         fields=("ok", "reload", "duration_ms"),
         module="gnot_tpu/serve/server.py",
         doc="hot weight reload (+ restore provenance when ok)",
-        optional=("trace_id",),
+        optional=("trace_id", "replica"),
     ),
     "serve_summary": EventSpec(
         fields=(
@@ -175,8 +180,32 @@ EVENTS: dict[str, EventSpec] = {
             "latency_p50_ms", "latency_p99_ms",
         ),
         module="gnot_tpu/serve/server.py",
-        doc="end-of-serve rollup emitted on drain",
-        optional=("queue_device_by_bucket", "pad_waste_by_bucket"),
+        doc="end-of-serve rollup emitted on drain (one per replica "
+        "server plus one pool-level rollup from the router)",
+        optional=(
+            "queue_device_by_bucket", "pad_waste_by_bucket", "replica",
+            "per_replica", "routing",
+        ),
+    ),
+    "route": EventSpec(
+        fields=("replica", "bucket", "policy", "reason", "depth"),
+        module="gnot_tpu/serve/router.py",
+        doc="one placement decision: which replica got the request and "
+        "why (affinity | cold_assign | spill | least_loaded | "
+        "round_robin | pool_full | no_healthy)",
+    ),
+    "replica_health": EventSpec(
+        fields=("replica", "healthy", "reason"),
+        module="gnot_tpu/serve/router.py",
+        doc="a replica's routability changed (ok | warming | "
+        "breaker_open | wedged | dead); unhealthy replicas drain to "
+        "siblings instead of shedding",
+    ),
+    "rolling_reload": EventSpec(
+        fields=("replica", "ok", "step", "n_replicas", "rollout"),
+        module="gnot_tpu/serve/router.py",
+        doc="one step of a rolling hot-reload (one replica warming at "
+        "a time; a failed step keeps old weights serving)",
     ),
     "trace_flush": EventSpec(
         fields=("path", "spans", "dropped"),

@@ -19,6 +19,19 @@ Four pieces (docs/serving.md):
   ``queue_depth``/``shed``/``breaker_*``/``reload``/``serve_summary``
   events through the ordinary ``MetricsSink``.
 
+Replicated serving (docs/serving.md "Replicated serving") multiplies
+the single-server tier:
+
+* ``replica`` — ``EngineReplica`` + ``build_replicas``: N engines over
+  disjoint device slices (the train stack's GSPMD ``NamedSharding``
+  pattern at sub-mesh scale), each carrying its bucket-affinity set
+  and rolling-reload warming flag.
+* ``router`` — ``ReplicaRouter``: per-request placement over the
+  replicas — health-gated (breaker/wedge/warming signals drain a sick
+  replica to its siblings), bucket-affinity by default (a bucket's
+  one-off compile lands on exactly one replica), with rolling
+  hot-reload across the pool and a pool-level ``serve_summary`` rollup.
+
 Chaos-tested on CPU via the serve-side fault kinds in
 ``resilience.faults`` (``slow_request@N``, ``nan_output@N``,
 ``reload_corrupt@N``) — tests/test_serve.py.
@@ -27,10 +40,14 @@ Chaos-tested on CPU via the serve-side fault kinds in
 from gnot_tpu.serve.batcher import Batcher  # noqa: F401
 from gnot_tpu.serve.engine import InferenceEngine  # noqa: F401
 from gnot_tpu.serve.policies import (  # noqa: F401
+    ROUTE_POLICIES,
     AdmissionController,
     CircuitBreaker,
     Deadline,
+    ReplicaHealthPolicy,
 )
+from gnot_tpu.serve.replica import EngineReplica, build_replicas  # noqa: F401
+from gnot_tpu.serve.router import ReplicaRouter  # noqa: F401
 from gnot_tpu.serve.server import (  # noqa: F401
     CheckpointReloader,
     InferenceServer,

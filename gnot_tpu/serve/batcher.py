@@ -12,8 +12,12 @@ waited ``max_wait_ms``. Two invariants the chaos suite asserts:
   the sample count to a fixed row count), so the compiled-program
   count is bounded by the bucket count: O(log L_max), never O(traffic).
 
-Pure data structure — no thread, no clock of its own (callers pass
-``now``); the server's worker loop drives it. FIFO within a bucket, so
+Pure data structure — no thread, no lock, no clock of its own (callers
+pass ``now``); exactly ONE worker loop drives each instance. Under
+replicated serving (serve/router.py) every replica's ``InferenceServer``
+owns its own ``Batcher`` — queues never span replicas, so the router's
+bucket-affinity decision is the only cross-replica coupling and this
+structure stays single-threaded by construction. FIFO within a bucket, so
 per-bucket latency is arrival-ordered — which also makes the server's
 ``queue_wait`` spans (obs/tracing.py: submit -> dispatch pop, the same
 interval the deadline shed reports as ``waited_ms``) monotone within a

@@ -71,6 +71,7 @@ class InferenceEngine:
         group_pad: bool = False,
         n_proc: int = 1,
         p_idx: int = 0,
+        place_params: Callable | None = None,
     ):
         self.model = model
         self.batch_size = batch_size
@@ -78,6 +79,12 @@ class InferenceEngine:
         self.pad_nodes = pad_nodes
         self.pad_funcs = pad_funcs
         self._device_put = device_put or (lambda b: b)
+        # Optional placement hook applied to every swap_params publish
+        # (serve/replica.py): a replica engine re-places hot-reloaded
+        # host params under its own mesh-slice sharding, so a reload
+        # neither migrates the replica off its devices nor forces a
+        # recompile. Identity when absent.
+        self._place_params = place_params or (lambda p: p)
         if forward is None:
             from gnot_tpu.train.trainer import apply_batch
 
@@ -104,6 +111,7 @@ class InferenceEngine:
         dispatches keep the reference they already read; the next
         dispatch sees the new one. No request is ever dropped or served
         a half-swapped tree."""
+        params = self._place_params(params)
         with self._lock:
             self._params = params
 

@@ -73,6 +73,79 @@ class AdmissionController:
             self._n -= 1
 
 
+#: Router request-placement policies (serve/router.py, docs/serving.md
+#: "Replicated serving"). ``affinity`` is the default: prefer a replica
+#: that has already compiled the request's bucket, so steady-state
+#: recompiles per replica stay O(log L_max) and a cold compile stalls
+#: one replica, never the pool.
+ROUTE_POLICIES = ("affinity", "least_loaded", "round_robin")
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthVerdict:
+    """One replica's routability verdict: ``healthy`` replicas take new
+    traffic; unhealthy ones are DRAINED (siblings absorb their share)
+    rather than shed — the reason names the signal that drained it."""
+
+    healthy: bool
+    reason: str  # "ok" | "warming" | "breaker_open" | "wedged" | "dead"
+
+
+class ReplicaHealthPolicy:
+    """Routability decision for one replica from the signals the serve
+    stack already produces — no new probes, no health-check RPCs:
+
+    * ``breaker_open`` — the replica's own ``CircuitBreaker`` is open
+      (repeated NaN outputs / device errors): it is rejecting anyway,
+      so route around it. Once the cooldown elapses
+      (``breaker_trial_due``) the replica reads healthy again so a
+      half-open trial dispatch can reach it — a drained replica
+      otherwise never dispatches and the breaker could never recover.
+    * ``wedged`` — requests are in the replica's system but its worker
+      loop has not completed an iteration for ``wedge_after_s``
+      (straggling device, runaway compile): drain to siblings instead
+      of queueing behind the stall.
+    * ``warming`` — the rolling hot-reload marks the replica warming;
+      old weights keep serving what it already holds, but new traffic
+      goes to siblings until the swap publishes.
+    * ``dead`` — the worker thread exited (crash): never route to it.
+
+    Stateless and deterministic given the inputs — the router samples
+    the signals and emits ``replica_health`` events on transitions.
+    """
+
+    def __init__(self, *, wedge_after_s: float = 2.0):
+        if wedge_after_s <= 0:
+            raise ValueError(
+                f"wedge_after_s must be > 0, got {wedge_after_s}"
+            )
+        self.wedge_after_s = wedge_after_s
+
+    def assess(
+        self,
+        *,
+        breaker_state: str,
+        warming: bool,
+        progress_age_s: float,
+        depth: int,
+        worker_alive: bool = True,
+        breaker_trial_due: bool = False,
+    ) -> HealthVerdict:
+        if not worker_alive:
+            return HealthVerdict(False, "dead")
+        if warming:
+            return HealthVerdict(False, "warming")
+        if breaker_state == "open" and not breaker_trial_due:
+            return HealthVerdict(False, "breaker_open")
+        if depth > 0 and progress_age_s >= self.wedge_after_s:
+            return HealthVerdict(False, "wedged")
+        if breaker_state == "open":
+            # Cooldown elapsed: routable so the half-open trial can
+            # happen (the reason names why it is being offered traffic).
+            return HealthVerdict(True, "trial")
+        return HealthVerdict(True, "ok")
+
+
 class CircuitBreaker:
     """Trips open after ``threshold`` consecutive dispatch failures
     (non-finite outputs, device errors); while open, requests are
@@ -107,6 +180,19 @@ class CircuitBreaker:
     @property
     def state(self) -> str:
         return self._state
+
+    def trial_due(self) -> bool:
+        """Read-only peek: would ``allow()`` admit a half-open trial
+        right now? The replica router's health check uses this to route
+        ONE trial's worth of traffic back to an open-breaker replica —
+        without it a drained replica never dispatches, ``allow()``
+        never runs, and the breaker (whose only open->half_open
+        transition lives there) could never recover."""
+        with self._lock:
+            return (
+                self._state == "open"
+                and self._clock() - self._opened_at >= self.cooldown_s
+            )
 
     def allow(self) -> bool:
         """May a dispatch proceed right now? Open -> False until the

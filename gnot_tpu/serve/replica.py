@@ -1,0 +1,191 @@
+"""Engine replicas: N independently-placed ``InferenceEngine``s.
+
+One ``InferenceEngine`` drives one worker loop — one compiled-program
+pipeline, one queue, one failure domain. The replica tier multiplies
+that: ``build_replicas`` splits the device set into N slices and builds
+one engine per slice using the SAME GSPMD ``NamedSharding`` pattern the
+train stack uses (``parallel/mesh.py``): each replica owns a sub-mesh
+(one device, or a ``data``-axis slice of several), its params are
+replicated WITHIN the slice, dispatch rows shard over the slice's
+``data`` axis, and outputs replicate back — so a replica is just the
+ordinary sharded forward at a smaller mesh. Replicas never communicate:
+the only cross-replica coupling is the router's placement decision
+(``serve/router.py``).
+
+``EngineReplica`` carries the per-replica state the router routes on:
+
+* **bucket affinity** — the set of bucket keys this replica has
+  compiled (seeded by ``warm()``, extended when the router assigns a
+  cold bucket). Affinity routing keeps each bucket's one-off XLA
+  compile on ONE replica, so steady-state recompiles per replica stay
+  O(log L_max) and a cold compile stalls one replica, never the pool.
+* **warming** — set by the rolling hot-reload while this replica's
+  weights swap; the router drains new traffic to siblings meanwhile
+  (old weights keep serving whatever the replica already holds).
+
+Thread-safety: the affinity set and warming flag are read by every
+submitting thread and written by the router/reload threads — all access
+goes through the replica's lock (graftlint GL004 enforces the
+annotations).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Sequence
+
+from gnot_tpu.config import MeshConfig
+from gnot_tpu.data.batch import MeshSample, PackPlan
+from gnot_tpu.serve.engine import InferenceEngine
+from gnot_tpu.serve.server import PACKED_BUCKET
+
+
+class EngineReplica:
+    """One engine + its routing state. The router attaches the replica's
+    ``InferenceServer`` (``attach_server``) and consults
+    ``has_bucket``/``warming``/``server.*`` probes on every placement
+    decision."""
+
+    def __init__(self, replica_id: int, engine: InferenceEngine):
+        self.replica_id = replica_id
+        self.engine = engine
+        self.server = None  # InferenceServer, attached by the router
+        self._lock = threading.Lock()
+        # Bucket keys this replica has compiled programs for — the
+        # affinity-routing state. Read by every submitting thread,
+        # written on warmup and cold-bucket assignment.
+        self._buckets: set = set()  #: guarded_by _lock
+        # Rolling-reload drain flag: True while THIS replica's weights
+        # are swapping (at most one replica warms at a time).
+        self._warming = False  #: guarded_by _lock
+
+    def attach_server(self, server) -> "EngineReplica":
+        self.server = server
+        return self
+
+    # -- affinity ----------------------------------------------------------
+
+    def warm(
+        self,
+        samples: Sequence[MeshSample],
+        *,
+        rows: int | None = None,
+        pack_plan: PackPlan | None = None,
+    ) -> int:
+        """Precompile one program per bucket in ``samples`` (plus the
+        packed program when a plan is given) and seed the affinity set
+        with the warmed keys. Returns the number of programs warmed."""
+        warmed = self.engine.warmup(samples, rows=rows)
+        keys = {self.engine.bucket_key(s) for s in samples}
+        if pack_plan is not None:
+            warmed += self.engine.warmup_packed(samples, pack_plan)
+            keys.add(PACKED_BUCKET)
+        with self._lock:
+            self._buckets |= keys
+        return warmed
+
+    def has_bucket(self, key) -> bool:
+        with self._lock:
+            return key in self._buckets
+
+    def note_bucket(self, key) -> None:
+        """The router assigned a cold bucket here: record it BEFORE the
+        first request dispatches, so every later request of this bucket
+        prefers this replica and the compile happens exactly once."""
+        with self._lock:
+            self._buckets.add(key)
+
+    # -- rolling-reload drain flag -----------------------------------------
+
+    @property
+    def warming(self) -> bool:
+        with self._lock:
+            return self._warming
+
+    def set_warming(self, value: bool) -> None:
+        with self._lock:
+            self._warming = value
+
+
+def build_replicas(
+    model,
+    params,
+    n_replicas: int,
+    *,
+    batch_size: int,
+    bucket: bool = True,
+    pad_nodes: int = 0,
+    pad_funcs: int = 0,
+    devices: Sequence | None = None,
+    forward_fn: Callable | None = None,
+) -> list[EngineReplica]:
+    """N engine replicas over disjoint device slices.
+
+    The device list splits into ``n_replicas`` contiguous slices (every
+    slice the same size; a remainder is left idle — unequal replicas
+    would skew the router's least-loaded signal). Each replica gets the
+    train stack's GSPMD treatment at its own scale: a sub-``Mesh`` over
+    its slice, params ``device_put`` replicated within it
+    (``NamedSharding(mesh, P())``), batches sharded over the slice's
+    ``data`` axis by ``parallel.mesh.shard_batch``, outputs replicated.
+    A single-device slice degenerates to ordinary placement — same code
+    path, mesh of one.
+
+    ``batch_size`` (the serving dispatch row count) must divide by the
+    slice size — every dispatch row-shards over the slice.
+    ``forward_fn(params, batch)`` overrides the default
+    ``apply_batch`` forward (it is jitted per replica with the slice's
+    out-sharding).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from gnot_tpu.parallel import mesh as mesh_lib
+
+    if n_replicas < 1:
+        raise ValueError(f"n_replicas must be >= 1, got {n_replicas}")
+    devices = list(devices if devices is not None else jax.devices())
+    if n_replicas > len(devices):
+        raise ValueError(
+            f"{n_replicas} replicas need at least one device each; "
+            f"only {len(devices)} visible (CPU: raise "
+            "--xla_force_host_platform_device_count)"
+        )
+    per = len(devices) // n_replicas
+    if batch_size % per:
+        raise ValueError(
+            f"batch_size {batch_size} must divide by the {per}-device "
+            f"replica slice ({len(devices)} devices / {n_replicas} "
+            "replicas): dispatch rows shard over the slice"
+        )
+    if forward_fn is None:
+        from gnot_tpu.train.trainer import apply_batch
+
+        forward_fn = lambda p, b: apply_batch(model, p, b)  # noqa: E731
+
+    replicas = []
+    for i in range(n_replicas):
+        mesh_devices = devices[i * per : (i + 1) * per]
+        rmesh = mesh_lib.make_mesh(MeshConfig(data=per), devices=mesh_devices)
+        replicated = NamedSharding(rmesh, PartitionSpec())
+        rparams = jax.device_put(params, replicated)
+        # One executable per replica is the POINT of this loop (N fixed
+        # placements, not per-request retracing) — the recompile-hazard
+        # rule is right in general and wrong here.
+        forward = jax.jit(forward_fn, out_shardings=replicated)  # graftlint: disable=GL003 — one jit per replica slice, N is the replica count not traffic
+        engine = InferenceEngine(
+            model,
+            rparams,
+            batch_size=batch_size,
+            bucket=bucket,
+            pad_nodes=pad_nodes,
+            pad_funcs=pad_funcs,
+            forward=forward,
+            device_put=lambda b, m=rmesh: mesh_lib.shard_batch(m, b),
+            # Hot-reloaded params arrive as host arrays; re-placing
+            # them under the replica's sharding keeps the swap from
+            # forcing a recompile (and keeps the replica on its slice).
+            place_params=lambda p, s=replicated: jax.device_put(p, s),
+        )
+        replicas.append(EngineReplica(i, engine))
+    return replicas

@@ -1,0 +1,431 @@
+"""Compile-affinity request router over N engine replicas.
+
+The single-``InferenceServer`` tier serializes every dispatch through
+one worker loop; replica parallelism is the remaining throughput
+multiplier after packing killed padding waste (ISSUE 9 / ROADMAP). The
+``ReplicaRouter`` front-ends N replicas (``serve/replica.py`` — one
+engine per device or mesh slice) and decides placement per request:
+
+1. **Health first** (``policies.ReplicaHealthPolicy``): a replica with
+   an open circuit breaker, a wedged worker (requests in-system but the
+   loop stalled), a warming rolling-reload, or a dead worker thread is
+   DRAINED — new traffic flows to its siblings instead of being shed.
+   Transitions emit ``replica_health`` events. When NO replica is
+   healthy the router still routes (least-loaded) so the per-replica
+   policies answer with their own reasons — the router never invents a
+   new failure mode.
+2. **Bucket affinity** (default policy): prefer a replica that already
+   compiled this request's bucket (or ``PackPlan``). A bucket seen for
+   the first time is ASSIGNED to the least-loaded healthy replica and
+   recorded before the request lands, so the one-off XLA compile
+   happens on exactly one replica — steady-state recompiles per replica
+   stay O(log L_max) and a cold compile stalls one replica, never the
+   pool. A full affinity target spills to the least-loaded sibling
+   (``spill``) rather than shedding at a hot replica's door.
+   ``least_loaded`` and ``round_robin`` policies are available for
+   comparison (``--route_policy``).
+3. **Rolling hot-reload** (``reload()``): replicas reload one at a
+   time — the warming replica is drained for NEW traffic while its old
+   weights keep serving what it already holds, siblings carry the load,
+   and a replica whose restore fails (corrupt checkpoint, exhausted
+   retries) keeps its old weights and the rollout continues. At most
+   one replica warms at any moment (the rollout lock). Each step emits
+   a ``rolling_reload`` event.
+
+Every placement is observable: one ``route`` event per submitted
+request (replica, bucket, policy, decision reason, target depth), and
+``drain()`` emits a pool-level ``serve_summary`` whose ``per_replica``
+rollup and ``routing`` block sit beside the per-replica summaries the
+replica servers emit themselves (each tagged ``replica: i``).
+
+Thread-safety: routing counters, health memory and the round-robin
+cursor are shared between submitting threads and the reload/drain
+threads — all access is under ``_lock`` (graftlint GL004 enforces the
+annotations); the rollout sequencing uses its own ``_reload_lock`` so a
+slow restore never blocks request placement.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Sequence
+
+import numpy as np
+
+from gnot_tpu.data.batch import MeshSample, PackPlan
+from gnot_tpu.obs import events
+from gnot_tpu.serve.policies import (
+    ROUTE_POLICIES,
+    ReplicaHealthPolicy,
+)
+from gnot_tpu.serve.replica import EngineReplica
+from gnot_tpu.serve.server import PACKED_BUCKET, InferenceServer
+
+
+class ReplicaRouter:
+    """N per-replica ``InferenceServer``s behind one ``submit()``.
+
+    ``replicas`` are ``EngineReplica``s (``build_replicas``); the router
+    constructs one ``InferenceServer`` per replica with the given
+    serving knobs — per-replica admission (``queue_limit`` each),
+    per-replica batcher, per-replica breaker — and tags each with its
+    ``replica_id`` so the shared sink/tracer attribute every record.
+
+    ``faults`` arms serve-side fault injection per replica: a dict
+    ``{replica_id: FaultInjector}``, or a single injector (applied to
+    replica 0 — the deterministic chaos-test shape). ``reload_fn`` is
+    shared: every replica restores from the same checkpoint source,
+    one at a time.
+    """
+
+    def __init__(
+        self,
+        replicas: Sequence[EngineReplica],
+        *,
+        route_policy: str = "affinity",
+        max_batch: int = 4,
+        max_wait_ms: float = 10.0,
+        queue_limit: int = 64,
+        default_deadline_ms: float = 0.0,
+        breaker_threshold: int = 3,
+        breaker_cooldown_s: float = 1.0,
+        sink=None,
+        reload_fn: Callable | None = None,
+        faults=None,
+        preempt=None,
+        clock: Callable[[], float] = time.monotonic,
+        tracer=None,
+        pack_plan: PackPlan | None = None,
+        wedge_after_s: float = 2.0,
+    ):
+        if not replicas:
+            raise ValueError("ReplicaRouter needs at least one replica")
+        if route_policy not in ROUTE_POLICIES:
+            raise ValueError(
+                f"unknown route_policy {route_policy!r}; "
+                f"one of {ROUTE_POLICIES}"
+            )
+        self.replicas = list(replicas)
+        self.route_policy = route_policy
+        self.pack_plan = pack_plan
+        self.sink = sink
+        self.reload_fn = reload_fn
+        self._clock = clock
+        self.health = ReplicaHealthPolicy(wedge_after_s=wedge_after_s)
+        if faults is None:
+            fault_map: dict = {}
+        elif isinstance(faults, dict):
+            fault_map = dict(faults)
+        else:
+            fault_map = {self.replicas[0].replica_id: faults}
+        for r in self.replicas:
+            r.attach_server(
+                InferenceServer(
+                    r.engine,
+                    max_batch=max_batch,
+                    max_wait_ms=max_wait_ms,
+                    queue_limit=queue_limit,
+                    default_deadline_ms=default_deadline_ms,
+                    breaker_threshold=breaker_threshold,
+                    breaker_cooldown_s=breaker_cooldown_s,
+                    sink=sink,
+                    reload_fn=reload_fn,
+                    faults=fault_map.get(r.replica_id),
+                    preempt=preempt,
+                    clock=clock,
+                    tracer=tracer,
+                    pack_plan=pack_plan,
+                    replica=r.replica_id,
+                )
+            )
+        self._lock = threading.Lock()
+        # Placement counters + health memory, shared between every
+        # submitting thread and the reload/drain threads.
+        self._submitted = 0  #: guarded_by _lock
+        self._routed: dict[int, int] = {}  #: guarded_by _lock
+        self._spills = 0  #: guarded_by _lock
+        self._rr_next = 0  #: guarded_by _lock
+        # Last emitted health reason per replica (transition edges
+        # become replica_health events; steady state stays silent).
+        self._health_seen: dict[int, str] = {}  #: guarded_by _lock
+        self._rollouts = 0  #: guarded_by _lock
+        # Rollout sequencing: holding it means "a rolling reload is in
+        # progress"; one replica warms at a time by construction.
+        self._reload_lock = threading.Lock()
+        self._drained = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ReplicaRouter":
+        for r in self.replicas:
+            r.server.start()
+        return self
+
+    # -- placement ---------------------------------------------------------
+
+    def submit(
+        self, sample: MeshSample, *, deadline_ms: float | None = None
+    ) -> Future:
+        """Route one request to a replica and submit it there. The
+        returned Future resolves exactly as a single server's would —
+        the router adds placement, never a new failure mode."""
+        key, label = self._bucket_of(sample)
+        replica, reason = self._place(key)
+        with self._lock:
+            self._submitted += 1
+            rid = replica.replica_id
+            self._routed[rid] = self._routed.get(rid, 0) + 1
+            if reason == "spill":
+                self._spills += 1
+        self._event(
+            events.ROUTE,
+            replica=replica.replica_id,
+            bucket=label,
+            policy=self.route_policy,
+            reason=reason,
+            depth=replica.server.depth(),
+        )
+        return replica.server.submit(sample, deadline_ms=deadline_ms)
+
+    def _bucket_of(self, sample: MeshSample) -> tuple:
+        """(affinity key, human label) for a request — the same bucket
+        the replica's own server will batch it under."""
+        plan = self.pack_plan
+        if plan is not None and plan.packable(sample):
+            return PACKED_BUCKET, f"packed:{plan.n_rows}x{plan.row_len}"
+        pn, pf = self.replicas[0].engine.bucket_key(sample)
+        return (pn, pf), f"{pn}x{pf}"
+
+    def _place(self, key) -> tuple[EngineReplica, str]:
+        """One placement decision. Health gates the candidate pool
+        (assessed outside the lock — it emits events); the policy then
+        picks UNDER ``_lock`` so two concurrent first requests of the
+        same cold bucket cannot both take the cold_assign path and pin
+        it to two replicas; full targets spill."""
+        now = self._clock()
+        healthy = [r for r in self.replicas if self._assess(r, now).healthy]
+        pool = healthy
+        degraded = not pool
+        if degraded:
+            # Nobody healthy: still place (least-loaded) — the chosen
+            # replica's own breaker/admission answers with its reason.
+            pool = self.replicas
+        with self._lock:
+            if self.route_policy == "round_robin" and not degraded:
+                idx = self._rr_next % len(pool)
+                self._rr_next += 1
+                return pool[idx], "round_robin"
+            open_pool = [r for r in pool if self._has_room(r)]
+            if self.route_policy == "least_loaded" or degraded:
+                target = min(open_pool or pool, key=self._load)
+                return target, ("no_healthy" if degraded else "least_loaded")
+            # affinity (the default)
+            warm = [r for r in open_pool if r.has_bucket(key)]
+            if warm:
+                return min(warm, key=self._load), "affinity"
+            # Assignment is checked over ALL replicas, not the health-
+            # filtered pool: a bucket whose warm replica is temporarily
+            # drained (warming/breaker) is a SPILL — the duplicated
+            # compile the ledger must count — not a fresh cold bucket.
+            assigned = any(r.has_bucket(key) for r in self.replicas)
+            if open_pool:
+                target = min(open_pool, key=self._load)
+                if assigned:
+                    # Its warm replica is full: spill to a sibling
+                    # (which will compile this bucket — bounded by the
+                    # replica count, still never O(traffic)).
+                    target.note_bucket(key)
+                    return target, "spill"
+                # Cold bucket: assign it before the request lands, so
+                # every later request of this bucket prefers the same
+                # replica and the compile happens exactly once in the
+                # pool.
+                target.note_bucket(key)
+                return target, "cold_assign"
+            # Every candidate full: place at the least-loaded anyway;
+            # its admission controller sheds at the door with the
+            # honest reason.
+            return min(pool, key=self._load), "pool_full"
+
+    @staticmethod
+    def _load(r: EngineReplica) -> tuple:
+        # Tie-break on replica_id for determinism under equal load.
+        return (r.server.depth(), r.replica_id)
+
+    @staticmethod
+    def _has_room(r: EngineReplica) -> bool:
+        return r.server.depth() < r.server.admission.limit
+
+    def _assess(self, r: EngineReplica, now: float):
+        """One replica's health verdict from live signals, emitting a
+        ``replica_health`` event when the reason changes."""
+        verdict = self.health.assess(
+            breaker_state=r.server.breaker.state,
+            warming=r.warming,
+            progress_age_s=r.server.progress_age_s(now),
+            depth=r.server.depth(),
+            worker_alive=r.server.worker_alive(),
+            # Post-cooldown open breaker: routable again so the half-
+            # open trial dispatch can happen (a drained replica never
+            # dispatches, and allow() — the only open->half_open
+            # transition — runs only at dispatch).
+            breaker_trial_due=r.server.breaker.trial_due(),
+        )
+        with self._lock:
+            changed = self._health_seen.get(r.replica_id) != verdict.reason
+            if changed:
+                self._health_seen[r.replica_id] = verdict.reason
+                # Emitted UNDER the lock so concurrent assessors can't
+                # interleave edges out of order (the event stream's
+                # last edge must agree with _health_seen); edges are
+                # rare, so the held-lock sink write is cheap.
+                self._event(
+                    events.REPLICA_HEALTH,
+                    replica=r.replica_id,
+                    healthy=verdict.healthy,
+                    reason=verdict.reason,
+                )
+        return verdict
+
+    # -- rolling hot-reload ------------------------------------------------
+
+    def reload(self, *, deadline_ms: float = 0.0) -> int:
+        """Rolling hot-reload across the pool: one replica at a time is
+        marked warming (drained for NEW traffic; its old weights keep
+        serving what it already holds), reloads on THIS caller's
+        thread, and rejoins before the next one starts. A replica whose
+        restore fails keeps its old weights and the rollout continues —
+        the pool never loses more than one replica's worth of capacity,
+        and never sheds a request because of the reload. Returns the
+        number of replicas that reloaded ok.
+
+        Each replica restores from the source INDEPENDENTLY (N reads
+        per rollout, not one shared read): deliberate — a replica's
+        restore failure/fallback stays its own (the chaos contract),
+        and a checkpoint published mid-rollout reaches the replicas
+        still to come instead of pinning the whole rollout to a
+        pre-rollout snapshot. The extra reads cost restore I/O, not
+        serving capacity (only the warming replica is drained)."""
+        if self.reload_fn is None:
+            raise RuntimeError("no reload source configured")
+        with self._reload_lock:
+            with self._lock:
+                self._rollouts += 1
+                rollout = self._rollouts
+            ok_n = 0
+            for step, r in enumerate(self.replicas, 1):
+                r.set_warming(True)
+                self._assess(r, self._clock())  # emit the warming edge
+                try:
+                    ok = r.server.reload(deadline_ms=deadline_ms)
+                finally:
+                    r.set_warming(False)
+                self._assess(r, self._clock())
+                ok_n += bool(ok)
+                self._event(
+                    events.ROLLING_RELOAD,
+                    replica=r.replica_id,
+                    ok=ok,
+                    step=step,
+                    n_replicas=len(self.replicas),
+                    rollout=rollout,
+                )
+            return ok_n
+
+    # -- drain / rollup ----------------------------------------------------
+
+    def drain(self, timeout_s: float = 30.0) -> dict:
+        """Drain every replica, then emit ONE pool-level
+        ``serve_summary`` with the per-replica rollup and the routing
+        ledger. Idempotent (the event fires once)."""
+        # Drain every replica CONCURRENTLY under one shared budget:
+        # sequential drains would either multiply the SIGTERM grace
+        # window by N or starve healthy siblings of their flush time
+        # behind one wedged replica (drain(0) would emit spurious
+        # drain_timeouts and strand their queued Futures). Replica
+        # drains are independent — each touches only its own server.
+        per: dict[int, dict] = {}
+        lat: list[float] = []
+
+        def _drain_one(r):
+            per[r.replica_id] = r.server.drain(timeout_s)
+
+        threads = [
+            threading.Thread(target=_drain_one, args=(r,), daemon=True)
+            for r in self.replicas
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for r in self.replicas:
+            # AFTER the drains: a drain flushes queued requests, whose
+            # latencies must be in the pool percentiles too.
+            lat.extend(r.server.latencies_ms())
+        shed: dict[str, int] = {}
+        for s in per.values():
+            for reason, n in s["shed"].items():
+                shed[reason] = shed.get(reason, 0) + n
+        arr = np.asarray(lat, dtype=np.float64)
+        with self._lock:
+            routed = dict(self._routed)
+            spills = self._spills
+            rollouts = self._rollouts
+            submitted = self._submitted
+        summary = {
+            "requests": sum(s["requests"] for s in per.values()),
+            "admitted": sum(s["admitted"] for s in per.values()),
+            "completed": sum(s["completed"] for s in per.values()),
+            "shed": shed,
+            "dispatches": sum(s["dispatches"] for s in per.values()),
+            "reloads": sum(s["reloads"] for s in per.values()),
+            "breaker_trips": sum(s["breaker_trips"] for s in per.values()),
+            # Pool-wide compiled-program count: affinity keeps this near
+            # the single-server bound instead of replicas x buckets.
+            "compiled_shapes": sum(
+                s["compiled_shapes"] for s in per.values()
+            ),
+            "latency_p50_ms": (
+                float(np.percentile(arr, 50)) if arr.size else None
+            ),
+            "latency_p99_ms": (
+                float(np.percentile(arr, 99)) if arr.size else None
+            ),
+            "per_replica": {
+                str(rid): {
+                    "requests": s["requests"],
+                    "completed": s["completed"],
+                    "shed": s["shed"],
+                    "dispatches": s["dispatches"],
+                    "reloads": s["reloads"],
+                    "breaker_trips": s["breaker_trips"],
+                    "compiled_shapes": s["compiled_shapes"],
+                    "latency_p50_ms": s["latency_p50_ms"],
+                    "latency_p99_ms": s["latency_p99_ms"],
+                    "routed": routed.get(rid, 0),
+                }
+                for rid, s in sorted(per.items())
+            },
+            "routing": {
+                "policy": self.route_policy,
+                "replicas": len(self.replicas),
+                # Router-level submit count: equals the sum of the
+                # per-replica `requests` unless callers also submitted
+                # to replica servers directly.
+                "submitted": submitted,
+                "spills": spills,
+                "rollouts": rollouts,
+            },
+        }
+        if not self._drained.is_set():
+            self._drained.set()
+            self._event(events.SERVE_SUMMARY, **summary)
+            if self.sink is not None:
+                self.sink.flush()
+        return summary
+
+    def _event(self, event: str, **fields) -> None:
+        if self.sink is not None:
+            self.sink.log(event=event, **fields)

@@ -146,9 +146,18 @@ class InferenceServer:
         clock: Callable[[], float] = time.monotonic,
         tracer=None,
         pack_plan: PackPlan | None = None,
+        replica: int | None = None,
     ):
         self.engine = engine
         self.sink = sink
+        # Replica identity (serve/router.py): when set, every event this
+        # server emits and every span it records carries a ``replica``
+        # field/arg, so an N-replica pool's one shared sink/tracer still
+        # attributes each record to its engine (trace_report's
+        # per-replica breakdown and the router's per-replica
+        # serve_summary rollup key on it). None (the default) leaves
+        # single-server output byte-identical to the pre-replica tier.
+        self.replica = replica
         self.reload_fn = reload_fn
         self.faults = faults
         self.preempt = preempt
@@ -226,6 +235,13 @@ class InferenceServer:
         # A/B (tools/pack_ab.py) compares. Mutated by the worker,
         # snapshotted by _summary on the drain thread.
         self._pack_stats: dict = {}  #: guarded_by _lock
+        # Worker liveness stamp for replica health (serve/router.py):
+        # refreshed once per worker-loop iteration, so a worker wedged
+        # INSIDE a dispatch (straggling device, runaway compile) shows
+        # a growing ``progress_age`` while requests sit in the system —
+        # the router's wedge signal. Written by the worker, read by
+        # router threads.
+        self._last_progress = clock()  #: guarded_by _lock
 
     # -- client side -------------------------------------------------------
 
@@ -448,9 +464,19 @@ class InferenceServer:
             except queue.Empty:
                 pass
             now = self._clock()
+            # Liveness stamps: once per poll (an idle worker refreshes
+            # every <= 100 ms) and once per DISPATCH — a backlogged
+            # worker steadily draining many ready batches is making
+            # progress, not wedged; only a worker stuck INSIDE one
+            # dispatch (straggler, runaway compile) stops stamping —
+            # exactly the wedge shape the router's health check wants.
+            with self._lock:
+                self._last_progress = now
             for key, reqs in self.batcher.pop_ready(
                 now, flush_all=self._draining.is_set()
             ):
+                with self._lock:
+                    self._last_progress = self._clock()
                 self._dispatch(key, reqs)
             if (
                 self._draining.is_set()
@@ -757,6 +783,37 @@ class InferenceServer:
                 **({"trace_id": first_trace} if first_trace else {}),
             )
 
+    # -- replica health / rollup probes (serve/router.py) ------------------
+
+    def progress_age_s(self, now: float | None = None) -> float:
+        """Seconds since the worker loop last completed an iteration —
+        the router's wedge signal: a large age while ``depth() > 0``
+        means the worker is stuck inside a dispatch (straggler,
+        runaway compile) and traffic should drain to siblings."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            return max(0.0, now - self._last_progress)
+
+    def depth(self) -> int:
+        """Requests currently in the system (queued + batched + in
+        dispatch) — the router's load signal."""
+        return self.admission.depth
+
+    def latencies_ms(self) -> list[float]:
+        """Snapshot of completed-request latencies (ms). The router's
+        pool-level percentiles need the raw population — per-replica
+        p50/p99 cannot be averaged into a pool p50/p99."""
+        with self._lock:
+            return list(self._latencies_ms)
+
+    def worker_alive(self) -> bool:
+        """False only when a started worker thread has EXITED (a crash
+        — drain sets ``_draining`` first, so a drained server reads as
+        draining, not dead). Not-yet-started reads True: the router
+        assesses replicas it is still warming."""
+        w = self._worker
+        return w.is_alive() if w is not None else True
+
     # -- bookkeeping -------------------------------------------------------
 
     def _trace_span(
@@ -768,6 +825,8 @@ class InferenceServer:
         this request's trace was sampled out. Returns the span id."""
         if self._tracer is None or trace is None:
             return None
+        if self.replica is not None:
+            args = {"replica": self.replica, **args}
         return self._tracer.add_span(
             name,
             start,
@@ -794,6 +853,8 @@ class InferenceServer:
 
     def _event(self, event: str, **fields) -> None:
         if self.sink is not None:
+            if self.replica is not None:
+                fields.setdefault("replica", self.replica)
             self.sink.log(event=event, **fields)
 
     def _summary(self, *, emit: bool) -> dict:

@@ -11,6 +11,7 @@ location, so CLI runs and tests share warm entries.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import tempfile
 
@@ -57,3 +58,73 @@ def enable_compile_cache(path: str | None = None) -> str:
     # Cache everything that took meaningful compile time.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
     return path
+
+
+@contextlib.contextmanager
+def compile_cache_probe():
+    """Count persistent-compile-cache hits/misses across a block — the
+    serve-warmup instrumentation (ISSUE 9 satellite: N-replica warm
+    time is compile-bound, and whether warmup() compiled fresh or
+    loaded cached executables is the difference between seconds and
+    minutes at scale).
+
+    Yields a dict filled IN PLACE (readable after the block):
+    ``requests`` (compiles that consulted the cache), ``hits``,
+    ``misses`` (requests - hits), plus ``dir`` and the cache-dir entry
+    count ``entries_before``/``entries_after`` (new entries are the
+    misses that took long enough to persist —
+    ``jax_persistent_cache_min_compile_time_secs`` gates tiny
+    programs out of the on-disk cache, so ``misses`` can exceed
+    ``new_entries``).
+
+    Counting rides ``jax._src.monitoring``'s cache events (the same
+    counters jax's own telemetry uses); if that private surface moves,
+    the probe degrades to entry-count deltas with ``hits``/``misses``
+    as None rather than breaking warmup."""
+    import jax  # noqa: F401 — the monitoring import below needs jax loaded
+
+    def _count_entries(path):
+        if not path or not os.path.isdir(path):
+            return None
+        try:
+            return sum(1 for de in os.scandir(path) if de.is_file())
+        except OSError:
+            return None
+
+    cache_dir = getattr(jax.config, "jax_compilation_cache_dir", None)
+    stats = {
+        "dir": cache_dir,
+        "entries_before": _count_entries(cache_dir),
+        "entries_after": None,
+        "requests": None,
+        "hits": None,
+        "misses": None,
+    }
+    counts = {"requests": 0, "hits": 0}
+    listener = None
+    try:
+        from jax._src import monitoring
+
+        def listener(event: str, **kw):  # noqa: ARG001 — monitoring API
+            if event == "/jax/compilation_cache/compile_requests_use_cache":
+                counts["requests"] += 1
+            elif event == "/jax/compilation_cache/cache_hits":
+                counts["hits"] += 1
+
+        monitoring.register_event_listener(listener)
+    except Exception:  # pragma: no cover — private API drift
+        listener = None
+    try:
+        yield stats
+    finally:
+        if listener is not None:
+            try:
+                from jax._src import monitoring
+
+                monitoring._unregister_event_listener_by_callback(listener)
+            except Exception:  # pragma: no cover
+                pass
+            stats["requests"] = counts["requests"]
+            stats["hits"] = counts["hits"]
+            stats["misses"] = counts["requests"] - counts["hits"]
+        stats["entries_after"] = _count_entries(stats["dir"])

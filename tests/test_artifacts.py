@@ -107,6 +107,46 @@ def test_pack_ab_artifact_schema():
     assert summary["max_abs_diff"] <= 1e-5
 
 
+def test_serve_bench_artifact_schema():
+    """The committed replicated-serving load bench
+    (tools/serve_bench.py): open-loop runs for the 1- and N-replica
+    arms over a shared offered-load ladder, plus a summary meeting the
+    ISSUE 9 acceptance bar — N=4 replicas sustain >= 2.5x the
+    requests/s of N=1 at equal p99 (both arms held to the same p99
+    SLO), with per-request replicated-vs-solo outputs <= 1e-5."""
+    path = os.path.join(ARTIFACT_DIR, "serve_bench.jsonl")
+    with open(path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    runs = [r for r in recs if "arm" in r]
+    arms = {r["arm"] for r in runs}
+    (summary,) = [r for r in recs if r.get("summary") == "serve_bench"]
+    n = summary["replicas_n"]
+    assert arms == {"replicas_1", f"replicas_{n}"} and n >= 4
+    # Both arms measured over the SAME offered-load ladder.
+    ladder = {r["load_mult"] for r in runs if r["replicas"] == 1}
+    assert ladder == {r["load_mult"] for r in runs if r["replicas"] == n}
+    assert len(ladder) >= 3
+    for r in runs:
+        assert r["submitted"] > 0 and r["offered_rps"] > 0
+        assert r["completed"] + sum(r["shed"].values()) == r["submitted"]
+        if r["completed"]:
+            assert r["p50_ms"] <= r["p99_ms"]
+        # Open-loop honesty: achieved never exceeds offered by more
+        # than Poisson jitter.
+        assert r["achieved_rps"] <= r["offered_rps"] * 1.25
+    # The acceptance bar (not quick mode), at equal p99: both
+    # sustained points meet the same SLO.
+    assert summary["quick"] is False
+    slo = summary["slo_p99_ms"]
+    assert summary["p99_at_sustained_1"] <= slo
+    assert summary["p99_at_sustained_n"] <= slo
+    assert summary["speedup"] == pytest.approx(
+        summary["sustained_rps_n"] / summary["sustained_rps_1"], rel=1e-2
+    )
+    assert summary["speedup"] >= summary["bar_speedup"] == 2.5
+    assert summary["max_abs_diff"] <= summary["bar_numeric"] == 1e-5
+
+
 def test_serve_trace_example_is_complete_chrome_trace():
     """The committed example trace (docs/observability.md "Reading a
     trace"): a real serve-smoke run whose completed requests each carry

@@ -305,6 +305,91 @@ def test_serve_events_validate_against_registry(tmp_path):
         assert events_registry.validate_record(rec) == [], rec
 
 
+def test_router_events_validate_against_registry(tmp_path):
+    """A replicated serving run's event stream (route, replica_health,
+    rolling_reload, per-replica + pool serve_summary) validates against
+    the registry specs. Stub forwards — the events come from the
+    router/server machinery, no XLA compile."""
+    from gnot_tpu.obs import events as events_registry
+    from gnot_tpu.serve import EngineReplica, InferenceEngine, ReplicaRouter
+
+    samples = datasets.synth_darcy2d(4, seed=0, grid_n=8)
+    fake_forward = lambda params, batch: np.zeros(
+        (batch.coords.shape[0], batch.coords.shape[1], 1)
+    )
+    replicas = [
+        EngineReplica(
+            i, InferenceEngine(None, None, batch_size=2, forward=fake_forward)
+        )
+        for i in range(2)
+    ]
+    mp = str(tmp_path / "serve.jsonl")
+    with MetricsSink(mp) as sink:
+        router = ReplicaRouter(
+            replicas,
+            max_batch=2,
+            max_wait_ms=5.0,
+            sink=sink,
+            # Reload source that always succeeds with fresh "params".
+            reload_fn=lambda deadline_ms=None: ({"w": np.ones(2)}, {}),
+        ).start()
+        futs = [router.submit(s) for s in samples]
+        for f in futs:
+            assert f.result(timeout=60).ok
+        assert router.reload() == 2
+        summary = router.drain()
+    recs = read_jsonl(mp)
+    kinds = {r.get("event") for r in recs}
+    assert {"route", "rolling_reload", "replica_health",
+            "serve_summary"} <= kinds
+    for rec in recs:
+        assert events_registry.validate_record(rec) == [], rec
+    # Per-server events carry the replica tag; the pool summary rolls
+    # per-replica summaries up.
+    assert all(
+        "replica" in r for r in recs if r.get("event") == "queue_depth"
+    )
+    assert set(summary["per_replica"]) == {"0", "1"}
+    [pool] = [
+        r for r in recs
+        if r.get("event") == "serve_summary" and "per_replica" in r
+    ]
+    assert pool["requests"] == len(samples)
+
+
+def test_serve_manifest_records_warmup_cache(tmp_path):
+    """--serve --serve_replicas 2: run.json gains the warmup_cache
+    block (programs warmed per pool + persistent-compile-cache
+    hit/miss counts) — the ROADMAP cold-start number."""
+    from gnot_tpu import main as main_mod
+
+    mp = str(tmp_path / "serve.jsonl")
+    main_mod.main([
+        "--serve", "--serve_replicas", "2",
+        "--synthetic", "darcy2d", "--synth_size", "4",
+        "--n_train", "4", "--n_test", "4", "--epochs", "1",
+        "--n_attn_layers", "1", "--n_attn_hidden_dim", "16",
+        "--n_mlp_num_layers", "1", "--n_mlp_hidden_dim", "16",
+        "--n_input_hidden_dim", "16", "--n_expert", "2", "--n_head", "2",
+        "--metrics_path", mp,
+    ])
+    man = json.load(open(tmp_path / "run.json"))
+    assert man["kind"] == "serve"
+    wc = man["warmup_cache"]
+    assert wc["replicas"] == 2
+    assert wc["programs_warmed"] >= 2  # >= one program per replica
+    if wc["requests"] is not None:  # monitoring API present
+        assert wc["hits"] + wc["misses"] == wc["requests"]
+        assert wc["requests"] >= wc["programs_warmed"]
+    # The replicated run's events (route included) validate too.
+    from gnot_tpu.obs import events as events_registry
+
+    recs = read_jsonl(mp)
+    assert any(r.get("event") == "route" for r in recs)
+    for rec in recs:
+        assert events_registry.validate_record(rec) == [], rec
+
+
 # --- health monitors ------------------------------------------------------
 
 

@@ -265,7 +265,14 @@ def test_sharded_multi_step_matches_single_device():
     samples = datasets.synth_ns2d(16, n_points=64)
     batches = list(Loader(samples, 8))[:2]
     state = init_state(model, optim, batches[0], seed=0)
-    host = jax.device_get(state.params)
+    # DEEP copy, not a bare device_get: on CPU device_get returns
+    # zero-copy views of the live device buffers, and the donated
+    # single(...) steps below can write their updated params straight
+    # into those buffers (use-after-donate through an aliased host
+    # view — the PR 6 playbook; root-caused again here, measured
+    # 1.8e-3 of silent drift). The sharded arm must start from the
+    # TRUE initial params, so snapshot by value.
+    host = jax.tree.map(np.array, jax.device_get(state.params))
     lrs = [1e-3, 8e-4]
 
     single = make_train_step(model, optim, "rel_l2")

@@ -473,6 +473,42 @@ def test_trace_report_on_synthetic_serve_trace(tmp_path):
     assert qw["share"] > 0.8
 
 
+def test_trace_report_replica_breakdown(tmp_path):
+    """Spans tagged with a ``replica`` arg (replicated serving) roll up
+    into the per-replica queue-vs-device table; untagged spans (single
+    server) leave it empty; dispatch spans repeated per traced member
+    count once per dispatch ordinal."""
+    trace_report = _tool("trace_report")
+    path = str(tmp_path / "trace.json")
+    tr = Tracer(path=path, clock=FakeClock())
+    for rep, queue_s in ((0, 0.010), (0, 0.020), (1, 0.002)):
+        t = tr.start_trace()
+        args = {"bucket": "64x64", "replica": rep}
+        tr.add_span("queue_wait", 0.0, queue_s, trace=t, args=args)
+        tr.add_span(
+            "dispatch", queue_s, queue_s + 0.005, trace=t,
+            args={**args, "dispatch": 1},  # same dispatch for both r0
+        )
+        tr.add_span(
+            "device", queue_s, queue_s + 0.004, trace=t, args=args
+        )
+    tr.flush()
+    rep = trace_report.report(path)
+    rb = rep["replicas"]
+    assert set(rb) == {"0", "1"}
+    assert rb["0"]["requests"] == 2 and rb["1"]["requests"] == 1
+    assert rb["0"]["dispatches"] == 1  # two member spans, one ordinal
+    assert rb["0"]["queue_p99_ms"] == pytest.approx(20.0)
+    assert rb["0"]["device_p50_ms"] == pytest.approx(4.0)
+    assert rb["1"]["queue_p50_ms"] == pytest.approx(2.0)
+    # No replica args -> empty table (single-server traces).
+    tr2 = Tracer(path=str(tmp_path / "t2.json"), clock=FakeClock())
+    t = tr2.start_trace()
+    tr2.add_span("queue_wait", 0.0, 0.01, trace=t, args={"bucket": "64x64"})
+    tr2.flush()
+    assert trace_report.report(str(tmp_path / "t2.json"))["replicas"] == {}
+
+
 def test_trace_report_cli_and_train_critical_path(tmp_path, capsys):
     """Train-shaped trace: the critical path picks the slowest step and
     its phase children; the CLI prints without error."""

@@ -1,0 +1,443 @@
+"""Open-loop serving load bench: 1 vs N engine replicas.
+
+Closed-loop benches (submit, wait, repeat) hide queueing collapse — the
+bench slows down with the server and never observes overload. This one
+is OPEN-LOOP: request arrivals are a Poisson process at a configured
+offered load (exponential inter-arrival gaps, pre-drawn from a seeded
+rng), submitted on schedule whether or not the pool is keeping up, so
+sustained requests/s and p50/p99 vs offered load mean what they say.
+
+Method (interleaved arms, one offered-load ladder shared by both):
+
+1. Measure one warmed dispatch to estimate the single-replica capacity
+   ``max_batch / dispatch_s``; the ladder is fractions/multiples of it.
+2. For each offered load, run the 1-replica arm then the N-replica arm
+   (same traffic, same seed, same duration). Each arm is a
+   ``ReplicaRouter`` over ``build_replicas`` engines pinned one device
+   each — the arms differ ONLY in replica count; N=1 pays the same
+   router overhead.
+3. A run SUSTAINS its load when shed_frac <= ``--max_shed_frac`` and
+   p99 <= ``--slo_p99_ms``; per arm, sustained rps is the best achieved
+   rps over sustaining runs — "equal p99" means both arms are held to
+   the same p99 SLO.
+4. Numerics: every distinct traffic sample is replayed through the
+   N-replica pool at idle and through a solo engine; the summary
+   records the max per-request |replicated - solo|.
+
+Writes JSONL (one record per run + one summary) for the committed
+artifact ``docs/artifacts/serve_bench.jsonl``
+(tests/test_artifacts.py::test_serve_bench_artifact_schema pins the
+acceptance bar: N-replica sustained >= 2.5x single at equal p99,
+numerics <= 1e-5). With ``--trace_path`` the heaviest N-replica run is
+traced and the per-replica queue-vs-device breakdown
+(tools/trace_report.py) is printed — the bottleneck, named per replica.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/serve_bench.py \
+        --out docs/artifacts/serve_bench.jsonl --replicas 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+import serve_smoke
+
+
+def _ensure_xla_flags(n_replicas: int) -> None:
+    """Pin the CPU backend to ONE intra-op thread per dispatch (and
+    enough virtual devices), BEFORE jax initializes.
+
+    Rationale: with multi-threaded eigen, a single dispatch steals
+    every host core — the 1-replica arm's capacity is then an artifact
+    of intra-op parallelism and the N-replica arm measures threadpool
+    thrash, not the replica tier (and runs show multi-second p99
+    outliers from scheduling collapse). One intra-op thread per device
+    is the honest CPU proxy for per-replica hardware (a TPU replica
+    owns its chip), applied IDENTICALLY to both arms. No-op when jax is
+    already initialized (the flags would silently not apply) — the
+    standalone CLI is the measurement vehicle."""
+    import importlib.util
+    import sys as _sys
+
+    if "jax" in _sys.modules:
+        print(
+            "serve_bench: note — jax already imported; XLA flags "
+            "unchanged (in-process smoke, not a measurement run)"
+        )
+        return
+    assert importlib.util.find_spec("jax") is not None
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags += f" --xla_force_host_platform_device_count={max(8, n_replicas)}"
+    if "xla_cpu_multi_thread_eigen" not in flags:
+        flags += (
+            " --xla_cpu_multi_thread_eigen=false"
+            " intra_op_parallelism_threads=1"
+        )
+    os.environ["XLA_FLAGS"] = flags.strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def make_replicas(model, params, n_replicas, *, max_batch, traffic):
+    """N warmed replicas, one device each (the arms differ ONLY in
+    replica count). Warm compiles are the expensive part — callers
+    build replicas once per arm and put a FRESH router over them per
+    run (jitted executables persist on the engines). Returns
+    (replicas, warm_stats)."""
+    import jax
+
+    from gnot_tpu.serve import build_replicas
+    from gnot_tpu.utils.cache import compile_cache_probe
+
+    devices = jax.devices()
+    if n_replicas > len(devices):
+        raise ValueError(
+            f"{n_replicas} replicas > {len(devices)} devices; raise "
+            "--xla_force_host_platform_device_count"
+        )
+    replicas = build_replicas(
+        model, params, n_replicas,
+        batch_size=max_batch, devices=devices[:n_replicas],
+    )
+    with compile_cache_probe() as warm_stats:
+        warmed = sum(r.warm(traffic, rows=max_batch) for r in replicas)
+    return replicas, {"programs_warmed": warmed, **warm_stats}
+
+
+def fresh_router(replicas, *, max_batch, queue_limit=256, max_wait_ms=4.0,
+                 sink=None, tracer=None):
+    """A new router over already-warm replicas (routers drain once;
+    engines and their compiled programs are reusable)."""
+    from gnot_tpu.serve import ReplicaRouter
+
+    return ReplicaRouter(
+        replicas,
+        max_batch=max_batch,
+        max_wait_ms=max_wait_ms,
+        queue_limit=queue_limit,
+        sink=sink,
+        tracer=tracer,
+    )
+
+
+def run_arm(router, traffic, *, offered_rps, duration_s, seed) -> dict:
+    """One open-loop run: Poisson arrivals at ``offered_rps`` for
+    ``duration_s``, submitted on schedule (never throttled by
+    responses), then wait for every Future and drain."""
+    rng = np.random.default_rng(seed)
+    router.start()
+    futures = []
+    t0 = time.perf_counter()
+    deadline = t0 + duration_s
+    next_at = t0 + float(rng.exponential(1.0 / offered_rps))
+    i = 0
+    while next_at < deadline:
+        now = time.perf_counter()
+        if now < next_at:
+            time.sleep(next_at - now)
+        # Behind schedule? Submit immediately — open loop never waits
+        # for the pool; the backlog is the point.
+        futures.append(router.submit(traffic[i % len(traffic)]))
+        i += 1
+        next_at += float(rng.exponential(1.0 / offered_rps))
+    results = [f.result(timeout=300) for f in futures]
+    last_done = time.perf_counter()
+    summary = router.drain()
+    elapsed = last_done - t0
+    completed = sum(r.ok for r in results)
+    shed = summary["shed"]
+    return {
+        "offered_rps": offered_rps,
+        "duration_s": round(duration_s, 3),
+        "submitted": len(futures),
+        "completed": completed,
+        "shed": shed,
+        "shed_frac": (
+            round(sum(shed.values()) / len(futures), 4) if futures else 0.0
+        ),
+        "achieved_rps": round(completed / elapsed, 2) if elapsed > 0 else None,
+        "p50_ms": (
+            round(summary["latency_p50_ms"], 2)
+            if summary["latency_p50_ms"] is not None
+            else None
+        ),
+        "p99_ms": (
+            round(summary["latency_p99_ms"], 2)
+            if summary["latency_p99_ms"] is not None
+            else None
+        ),
+        "dispatches": summary["dispatches"],
+        "compiled_shapes": summary["compiled_shapes"],
+        "spills": summary["routing"]["spills"],
+    }
+
+
+def numerics_check(model, params, replicas, traffic, *, max_batch) -> float:
+    """Max per-request |replicated - solo| over the distinct traffic
+    set: every request replayed through an idle N-replica pool AND a
+    plain solo engine (default placement). The replicated-vs-solo
+    acceptance number."""
+    from gnot_tpu.serve import InferenceEngine
+
+    router = fresh_router(replicas, max_batch=max_batch)
+    router.start()
+    futs = [router.submit(s) for s in traffic]
+    results = [f.result(timeout=120) for f in futs]
+    router.drain()
+    solo = InferenceEngine(model, params, batch_size=max_batch)
+    solo.warmup(traffic, rows=max_batch)
+    worst = 0.0
+    for s, r in zip(traffic, results):
+        assert r.ok, f"numerics replay shed a request: {r.reason}"
+        key = solo.bucket_key(s)
+        ref = solo.infer(
+            [s], pad_nodes=key[0], pad_funcs=key[1], rows=max_batch
+        )[0]
+        worst = max(worst, float(np.max(np.abs(ref - r.output))))
+    return worst
+
+
+def run(argv=None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--replicas", type=int, default=4,
+                   help="N for the N-replica arm (vs the 1-replica arm)")
+    p.add_argument("--n_traffic", type=int, default=16,
+                   help="distinct request samples cycled by the arrival "
+                        "process (mixed Darcy64 + ragged buckets)")
+    # Mesh sizes + model width sized so a dispatch is COMPUTE-heavy
+    # (tens of ms inside XLA with the GIL released): that is the regime
+    # where replica workers genuinely run concurrently on CPU — a
+    # 2-3 ms dispatch is mostly GIL-held host work and replicas can't
+    # scale it (measured; on TPU slices the compute fraction is higher
+    # still, so CPU is the conservative proxy).
+    p.add_argument("--mesh_lo", type=int, default=600)
+    p.add_argument("--mesh_hi", type=int, default=1000)
+    p.add_argument("--hidden", type=int, default=96)
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--max_batch", type=int, default=4)
+    p.add_argument("--queue_limit", type=int, default=256)
+    p.add_argument("--duration_s", type=float, default=6.0,
+                   help="open-loop window per run")
+    p.add_argument("--loads", type=str, default="0.5,0.8,1.2,2.0,2.6,3.2",
+                   help="offered-load ladder as multiples of the "
+                        "measured single-replica dispatch capacity")
+    p.add_argument("--slo_p99_ms", type=float, default=0.0,
+                   help="p99 SLO a run must meet to count as sustained "
+                        "(0 = auto: 12x the measured solo dispatch time)")
+    p.add_argument("--max_shed_frac", type=float, default=0.02)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", type=str, default="",
+                   help="JSONL output path (the committed artifact)")
+    p.add_argument("--trace_path", type=str, default="",
+                   help="trace the heaviest N-replica run and print the "
+                        "per-replica breakdown (trace_report.py)")
+    p.add_argument("--quick", action="store_true",
+                   help="tiny ladder + short windows (CI smoke, not the "
+                        "committed artifact)")
+    args = p.parse_args(argv)
+    if args.quick:
+        args.duration_s = min(args.duration_s, 2.0)
+        args.loads = "0.6,2.4"
+
+    _ensure_xla_flags(args.replicas)
+
+    from gnot_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
+
+    model, params = _build_model(args)
+    traffic = serve_smoke.mixed_traffic(
+        args.n_traffic, seed=args.seed, mesh_lo=args.mesh_lo,
+        mesh_hi=args.mesh_hi,
+    )
+
+    # Capacity probe: one warmed solo engine, median dispatch time.
+    from gnot_tpu.serve import InferenceEngine
+
+    probe = InferenceEngine(model, params, batch_size=args.max_batch)
+    probe.warmup(traffic, rows=args.max_batch)
+    keys = [probe.bucket_key(s) for s in traffic]
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for s, k in zip(traffic[:8], keys[:8]):
+            probe.infer([s], pad_nodes=k[0], pad_funcs=k[1],
+                        rows=args.max_batch)
+        times.append((time.perf_counter() - t0) / 8)
+    dispatch_s = float(np.median(times))
+    cap1 = args.max_batch / dispatch_s
+    slo = args.slo_p99_ms or round(12 * dispatch_s * 1e3, 1)
+    print(
+        f"serve_bench: dispatch {dispatch_s * 1e3:.1f} ms -> est. "
+        f"1-replica capacity {cap1:.0f} req/s, p99 SLO {slo} ms"
+    )
+
+    loads = [float(x) for x in args.loads.split(",")]
+    records: list[dict] = []
+    # Build + warm each arm's replicas ONCE (compiles are the dominant
+    # cost); each run gets a fresh router over the same warm engines.
+    pools = {}
+    for n in (1, args.replicas):
+        pools[n] = make_replicas(
+            model, params, n, max_batch=args.max_batch, traffic=traffic
+        )
+        warm = pools[n][1]
+        print(
+            f"  warmed n={n}: {warm['programs_warmed']} programs, "
+            f"cache hits={warm.get('hits')} misses={warm.get('misses')}"
+        )
+    for li, mult in enumerate(loads):
+        offered = mult * cap1
+        for n in (1, args.replicas):  # interleaved arms per load
+            replicas_n, warm = pools[n]
+            router = fresh_router(
+                replicas_n, max_batch=args.max_batch,
+                queue_limit=args.queue_limit,
+            )
+            rec = run_arm(
+                router, traffic, offered_rps=offered,
+                duration_s=args.duration_s, seed=args.seed + li,
+            )
+            rec = {
+                "arm": f"replicas_{n}", "replicas": n,
+                "load_mult": mult, **rec,
+                "warm_cache_hits": warm.get("hits"),
+                "warm_cache_misses": warm.get("misses"),
+            }
+            records.append(rec)
+            print(
+                f"  n={n} offered={offered:7.1f}/s -> "
+                f"achieved={rec['achieved_rps']}/s "
+                f"p50={rec['p50_ms']}ms p99={rec['p99_ms']}ms "
+                f"shed={rec['shed_frac']:.1%}"
+            )
+
+    def sustained(n):
+        ok = [
+            r for r in records
+            if r["replicas"] == n
+            and r["shed_frac"] <= args.max_shed_frac
+            and r["p99_ms"] is not None
+            and r["p99_ms"] <= slo
+        ]
+        best = max(ok, key=lambda r: r["achieved_rps"], default=None)
+        return best
+
+    best1, bestn = sustained(1), sustained(args.replicas)
+    worst = numerics_check(
+        model, params, pools[args.replicas][0], traffic,
+        max_batch=args.max_batch,
+    )
+    summary = {
+        "summary": "serve_bench",
+        "replicas_n": args.replicas,
+        "slo_p99_ms": slo,
+        "max_shed_frac": args.max_shed_frac,
+        "dispatch_ms": round(dispatch_s * 1e3, 3),
+        "sustained_rps_1": best1["achieved_rps"] if best1 else None,
+        "p99_at_sustained_1": best1["p99_ms"] if best1 else None,
+        "sustained_rps_n": bestn["achieved_rps"] if bestn else None,
+        "p99_at_sustained_n": bestn["p99_ms"] if bestn else None,
+        "speedup": (
+            round(bestn["achieved_rps"] / best1["achieved_rps"], 3)
+            if best1 and bestn and best1["achieved_rps"]
+            else None
+        ),
+        "max_abs_diff": worst,
+        "bar_speedup": 2.5,
+        "bar_numeric": 1e-5,
+        "quick": bool(args.quick),
+    }
+    records.append(summary)
+    print(
+        f"serve_bench: sustained {summary['sustained_rps_1']} req/s (n=1) "
+        f"vs {summary['sustained_rps_n']} req/s (n={args.replicas}) at "
+        f"p99<={slo}ms -> speedup {summary['speedup']}x; "
+        f"max |replicated-solo| {worst:.2e}"
+    )
+
+    if args.trace_path and bestn is not None:
+        _traced_run(args, pools[args.replicas][0], traffic, bestn, cap1)
+
+    if args.out:
+        if d := os.path.dirname(args.out):
+            os.makedirs(d, exist_ok=True)
+        with open(args.out, "w") as f:
+            for r in records:
+                f.write(json.dumps(r) + "\n")
+        print(f"serve_bench: wrote {len(records)} records to {args.out}")
+    return summary
+
+
+def _traced_run(args, replicas, traffic, bestn, cap1) -> None:
+    """Re-run the best sustained N-replica load with the span tracer on
+    and print the per-replica breakdown — the 'name the bottleneck per
+    replica' view."""
+    import trace_report
+
+    from gnot_tpu.obs.tracing import Tracer
+
+    tracer = Tracer(path=args.trace_path)
+    router = fresh_router(
+        replicas, max_batch=args.max_batch,
+        queue_limit=args.queue_limit, tracer=tracer,
+    )
+    run_arm(
+        router, traffic, offered_rps=bestn["load_mult"] * cap1,
+        duration_s=min(args.duration_s, 3.0), seed=args.seed,
+    )
+    tracer.flush()
+    rep = trace_report.report(args.trace_path)
+    trace_report.print_report(rep)
+
+
+def _build_model(args):
+    """A mid-size GNOT on the Darcy operator schema — big enough that a
+    dispatch is compute-bound (see the --mesh_lo help), untrained
+    (serving throughput is about plumbing, not accuracy)."""
+    from gnot_tpu.config import ModelConfig
+    from gnot_tpu.data import datasets
+    from gnot_tpu.data.batch import collate
+    from gnot_tpu.models.gnot import GNOT
+    from gnot_tpu.train.trainer import init_params
+
+    samples = datasets.synth_darcy2d(args.max_batch, seed=0, grid_n=8)
+    mc = ModelConfig(
+        n_attn_layers=args.layers,
+        n_attn_hidden_dim=args.hidden,
+        n_mlp_num_layers=2,
+        n_mlp_hidden_dim=args.hidden,
+        n_input_hidden_dim=args.hidden,
+        n_expert=2,
+        n_head=2,
+        **datasets.infer_model_dims(samples),
+    )
+    model = GNOT(mc)
+    return model, init_params(model, collate(samples), args.seed)
+
+
+def main(argv=None) -> int:
+    s = run(argv)
+    ok = (
+        s["speedup"] is not None
+        and s["speedup"] >= s["bar_speedup"]
+        and s["max_abs_diff"] <= s["bar_numeric"]
+    )
+    if not ok:
+        print(f"FAIL: acceptance bar not met: {s}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

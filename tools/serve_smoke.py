@@ -138,6 +138,19 @@ def run(argv=None) -> dict:
              "small values make the mixed small-mesh packing workload)"
     )
     p.add_argument("--mesh_hi", type=int, default=700)
+    p.add_argument(
+        "--replicas", type=int, default=1,
+        help="run the storm through the compile-affinity ReplicaRouter "
+             "over N mesh-sliced engine replicas (serve/router.py) "
+             "instead of one InferenceServer; the smoke then ALSO "
+             "asserts per-replica compiled-program bounds, one route "
+             "event per request, and the pool-level serve_summary "
+             "per-replica rollup"
+    )
+    p.add_argument(
+        "--route_policy", type=str, default="affinity",
+        choices=["affinity", "least_loaded", "round_robin"],
+    )
     args = p.parse_args(argv)
     if args.inject_fault == "none":
         args.inject_fault = ""
@@ -161,10 +174,6 @@ def run(argv=None) -> dict:
         )
     engine = build_engine(max_batch=args.max_batch)
     traffic = mixed_traffic(args.n, mesh_lo=args.mesh_lo, mesh_hi=args.mesh_hi)
-    # Precompile every bucket the storm will hit (serving-startup
-    # discipline — docs/serving.md): an XLA compile landing under a
-    # 200 ms deadline would shed everything queued behind it.
-    engine.warmup(traffic, rows=args.max_batch)
     pack_plan = None
     if args.packed:
         from gnot_tpu.data.batch import PackPlan
@@ -172,12 +181,28 @@ def run(argv=None) -> dict:
         pack_plan = PackPlan.from_samples(
             traffic, chunk=args.pack_chunk, batch_size=args.max_batch
         )
-        engine.warmup_packed(traffic, pack_plan)
+    # Precompile every bucket the storm will hit (serving-startup
+    # discipline — docs/serving.md): an XLA compile landing under a
+    # 200 ms deadline would shed everything queued behind it. Replicas
+    # each warm their own executables (placement differs per slice).
+    replicas = None
+    if args.replicas > 1:
+        from gnot_tpu.serve import build_replicas
+
+        replicas = build_replicas(
+            engine.model, engine.params, args.replicas,
+            batch_size=args.max_batch,
+        )
+        for r in replicas:
+            r.warm(traffic, rows=args.max_batch, pack_plan=pack_plan)
+    else:
+        engine.warmup(traffic, rows=args.max_batch)
+        if pack_plan is not None:
+            engine.warmup_packed(traffic, pack_plan)
     import time as _time
 
     with MetricsSink(metrics_path) as sink:
-        server = InferenceServer(
-            engine,
+        common = dict(
             max_batch=args.max_batch,
             max_wait_ms=args.max_wait_ms,
             queue_limit=args.queue_limit,
@@ -186,7 +211,15 @@ def run(argv=None) -> dict:
             faults=FaultInjector.from_spec(args.inject_fault),
             tracer=tracer,
             pack_plan=pack_plan,
-        ).start()
+        )
+        if replicas is not None:
+            from gnot_tpu.serve import ReplicaRouter
+
+            server = ReplicaRouter(
+                replicas, route_policy=args.route_policy, **common
+            ).start()
+        else:
+            server = InferenceServer(engine, **common).start()
         t_submit = _time.perf_counter()
         futures = [server.submit(s) for s in traffic]
         results = [f.result(timeout=120) for f in futures]
@@ -247,12 +280,41 @@ def run(argv=None) -> dict:
     )
     l_max = bucket_length(max(lengths))
     bound = 2 * (int(math.log2(l_max / 64)) + 1)  # ~2 per octave, 2 axes
-    check(
-        summary["compiled_shapes"]
-        <= max(len(expected), bound) + (1 if pack_plan is not None else 0),
-        f"{summary['compiled_shapes']} compiled shapes exceeds the "
-        f"O(log L) bound ({bound}) / bucket count ({len(expected)})",
-    )
+    per_bound = max(len(expected), bound) + (1 if pack_plan is not None else 0)
+    if replicas is not None:
+        # Bounded PER-REPLICA compile counts under the mixed-bucket
+        # storm: each replica compiles at most one program per bucket
+        # it warmed/was assigned — never O(traffic) — and the pool
+        # total is bounded by replicas x the single-server bound.
+        for r in replicas:
+            check(
+                r.engine.compiled_shapes <= per_bound,
+                f"replica {r.replica_id} compiled "
+                f"{r.engine.compiled_shapes} shapes > per-replica bound "
+                f"{per_bound}",
+            )
+        check(
+            summary["compiled_shapes"] <= per_bound * args.replicas,
+            f"pool compiled {summary['compiled_shapes']} shapes exceeds "
+            f"{per_bound} x {args.replicas} replicas",
+        )
+        routes = [e for e in events if e.get("event") == "route"]
+        check(
+            len(routes) == args.n,
+            f"{len(routes)} route events != {args.n} submitted requests",
+        )
+        check(
+            set(summary.get("per_replica", {}))
+            == {str(r.replica_id) for r in replicas},
+            f"serve_summary.per_replica rollup malformed: "
+            f"{sorted(summary.get('per_replica', {}))}",
+        )
+    else:
+        check(
+            summary["compiled_shapes"] <= per_bound,
+            f"{summary['compiled_shapes']} compiled shapes exceeds the "
+            f"O(log L) bound ({bound}) / bucket count ({len(expected)})",
+        )
     check(
         all(
             0 < e["real_tokens"] <= e["capacity_tokens"] for e in dispatches

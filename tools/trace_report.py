@@ -80,25 +80,59 @@ def kind_stats(spans: list[dict]) -> dict[str, dict]:
     return out
 
 
-def bucket_breakdown(spans: list[dict]) -> dict[str, dict]:
-    """bucket -> queue-wait vs device-time percentiles (serve traces:
-    ``queue_wait`` and ``device`` spans carry a ``bucket`` arg)."""
-    buckets: dict[str, dict[str, list[float]]] = {}
+def _queue_device_stats(spans: list[dict], arg_key: str) -> dict[str, dict]:
+    """Group ``queue_wait``/``device`` spans by an args key and roll
+    each group into queue-vs-device percentiles — the shared population
+    definition behind both the per-bucket and per-replica views (change
+    it here and both stay in agreement)."""
+    groups: dict[str, dict[str, list[float]]] = {}
     for s in spans:
-        bucket = s["args"].get("bucket")
-        if bucket is None or s["name"] not in ("queue_wait", "device"):
+        key = s["args"].get(arg_key)
+        if key is None or s["name"] not in ("queue_wait", "device"):
             continue
-        st = buckets.setdefault(bucket, {"queue_wait": [], "device": []})
+        st = groups.setdefault(str(key), {"queue_wait": [], "device": []})
         st[s["name"]].append(s["dur_ms"])
     out = {}
-    for bucket, st in sorted(buckets.items()):
+    for key, st in sorted(groups.items()):
         q, d = percentiles(st["queue_wait"]), percentiles(st["device"])
-        out[bucket] = {
+        out[key] = {
             "requests": len(st["queue_wait"]),
             "queue_p50_ms": q["p50_ms"],
             "queue_p99_ms": q["p99_ms"],
             "device_p50_ms": d["p50_ms"],
             "device_p99_ms": d["p99_ms"],
+        }
+    return out
+
+
+def bucket_breakdown(spans: list[dict]) -> dict[str, dict]:
+    """bucket -> queue-wait vs device-time percentiles (serve traces:
+    ``queue_wait`` and ``device`` spans carry a ``bucket`` arg)."""
+    return _queue_device_stats(spans, "bucket")
+
+
+def replica_breakdown(spans: list[dict]) -> dict[str, dict]:
+    """replica -> queue-wait vs device-time percentiles plus dispatch
+    count (replicated serve traces: every span a replica server records
+    carries a ``replica`` arg). This is how the load bench names the
+    bottleneck PER REPLICA: a replica whose queue p99 dwarfs its device
+    p99 is starved by placement or wedged, one whose device p99 grew is
+    the sick engine. Empty for single-server traces (no replica args)."""
+    out = {}
+    dispatches: dict[str, set] = {}
+    for s in spans:
+        rep = s["args"].get("replica")
+        if rep is not None and s["name"] == "dispatch":
+            # dispatch spans repeat once per traced member; the
+            # ``dispatch`` ordinal arg identifies the real dispatch.
+            dispatches.setdefault(str(rep), set()).add(
+                s["args"].get("dispatch", s["span_id"])
+            )
+    for rep, st in _queue_device_stats(spans, "replica").items():
+        out[rep] = {
+            "requests": st["requests"],
+            "dispatches": len(dispatches.get(rep, ())),
+            **{k: v for k, v in st.items() if k != "requests"},
         }
     return out
 
@@ -182,6 +216,7 @@ def report(path: str) -> dict:
         "spans": len(spans),
         "kinds": kind_stats(spans),
         "buckets": bucket_breakdown(spans),
+        "replicas": replica_breakdown(spans),
         "critical_path": critical_path(spans),
     }
 
@@ -208,6 +243,18 @@ def print_report(rep: dict) -> None:
         for bucket, st in rep["buckets"].items():
             print(
                 f"  {bucket:<12} {st['requests']:>5} "
+                f"{_fmt(st['queue_p50_ms'])} {_fmt(st['queue_p99_ms'])} "
+                f" {_fmt(st['device_p50_ms'])}  {_fmt(st['device_p99_ms'])}"
+            )
+    if rep.get("replicas"):
+        print("\nqueue-wait vs device-time per replica (ms):")
+        print(
+            f"  {'replica':<8} {'reqs':>5} {'disp':>5} {'queue p50':>10} "
+            f"{'queue p99':>10} {'device p50':>11} {'device p99':>11}"
+        )
+        for rid, st in rep["replicas"].items():
+            print(
+                f"  {rid:<8} {st['requests']:>5} {st['dispatches']:>5} "
                 f"{_fmt(st['queue_p50_ms'])} {_fmt(st['queue_p99_ms'])} "
                 f" {_fmt(st['device_p50_ms'])}  {_fmt(st['device_p99_ms'])}"
             )
