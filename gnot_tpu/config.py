@@ -363,6 +363,16 @@ class ServeConfig:
     # the router treats a replica as wedged and drains its traffic to
     # siblings.
     wedge_after_s: float = 2.0
+    # Deploy-time AOT prewarm manifest (tools/aot_prewarm.py,
+    # docs/serving.md "Deploy-time prewarm"): when set, serving
+    # hydrates each engine's executables from the manifest's
+    # warm-replica snapshots before warmup — a covered program costs a
+    # snapshot load (no trace, no XLA compile); warmup then only
+    # compiles buckets the manifest missed. "" = cold warmup (the
+    # classical path). The manifest must match the serving topology
+    # (replica count) and model; a model mismatch degrades to cold
+    # warmup, loudly.
+    prewarm_manifest: str = ""
 
     def __post_init__(self) -> None:
         if self.max_batch < 1:

@@ -365,6 +365,32 @@ class PackPlan:
         """Token capacity of one dispatch (the pad-waste denominator)."""
         return self.n_rows * self.row_len
 
+    @classmethod
+    def for_slices(
+        cls,
+        samples: Sequence["MeshSample"],
+        *,
+        chunk: int,
+        batch_size: int,
+        per_devices: int,
+    ) -> "PackPlan":
+        """``from_samples`` whose row grid divides over a
+        ``per_devices``-wide replica slice — packed dispatch rows shard
+        over the slice exactly like padded rows, so every slice must
+        get whole rows. THE single source of the alignment rule
+        (``main._run_serve`` and ``tools/serve_smoke.py`` both call
+        this)."""
+        plan = cls.from_samples(samples, chunk=chunk, batch_size=batch_size)
+        per = max(1, per_devices)
+        if plan.n_rows % per:
+            plan = cls.from_samples(
+                samples,
+                chunk=chunk,
+                batch_size=batch_size,
+                n_rows=-(-plan.n_rows // per) * per,
+            )
+        return plan
+
 
 def pack_prefix(
     sizes: Sequence[int], plan: PackPlan

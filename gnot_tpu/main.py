@@ -217,6 +217,15 @@ def build_parser() -> argparse.ArgumentParser:
              "compiles never stall the pool), least_loaded, round_robin"
     )
     p.add_argument(
+        "--serve_prewarm", type=str, default="",
+        help="serving: deploy-time AOT prewarm manifest "
+             "(tools/aot_prewarm.py) — hydrate each engine's compiled "
+             "executables from the manifest's warm-replica snapshots "
+             "before warmup, so startup/scale-out pays snapshot loads "
+             "instead of XLA compiles (docs/serving.md 'Deploy-time "
+             "prewarm'); must match the serving topology and model"
+    )
+    p.add_argument(
         "--serve_reload_every", type=int, default=0,
         help="serving demo traffic: hot-reload the checkpoint after "
              "every N requests (0 = never) — exercises the atomic "
@@ -389,6 +398,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "serve.pack_chunk": args.serve_pack_chunk,
             "serve.replicas": args.serve_replicas,
             "serve.route_policy": args.route_policy,
+            "serve.prewarm_manifest": args.serve_prewarm,
             "mesh.data": args.mesh_data,
             "mesh.seq": args.mesh_seq,
             "mesh.model": args.mesh_model,
@@ -831,22 +841,14 @@ def _run_serve(
 
         from gnot_tpu.data.batch import PackPlan
 
-        pack_plan = PackPlan.from_samples(
-            samples, chunk=sc.pack_chunk, batch_size=sc.max_batch
+        pack_plan = PackPlan.for_slices(
+            samples,
+            chunk=sc.pack_chunk,
+            batch_size=sc.max_batch,
+            per_devices=(
+                len(_jax.devices()) // sc.replicas if sc.replicas > 1 else 1
+            ),
         )
-        per = (
-            len(_jax.devices()) // sc.replicas if sc.replicas > 1 else 1
-        )
-        if pack_plan.n_rows % max(1, per):
-            # Packed dispatch rows shard over each replica's device
-            # slice exactly like padded rows; align the plan's row
-            # grid up so every slice gets whole rows.
-            pack_plan = PackPlan.from_samples(
-                samples,
-                chunk=sc.pack_chunk,
-                batch_size=sc.max_batch,
-                n_rows=-(-pack_plan.n_rows // per) * per,
-            )
     reload_fn = (
         CheckpointReloader(checkpointer, trainer.state)
         if checkpointer is not None
@@ -866,30 +868,23 @@ def _run_serve(
         )
     else:
         engine = trainer.inference_engine()
-    # Serving-startup discipline (docs/serving.md): precompile one
-    # program per bucket the traffic will hit — a cold XLA compile
-    # landing under a tight deadline would shed everything behind it.
-    # Packed mode still warms the padded buckets too (the oversize
-    # fallback path). The probe records persistent-compile-cache
-    # hits/misses for the manifest: warm time is THE replica scale-out
-    # cost, and whether it compiled fresh or loaded cached executables
-    # is the number to watch (ROADMAP cold-start item).
-    with compile_cache_probe() as warm_stats:
-        if replicas is not None:
-            warmed = sum(
-                r.warm(samples, rows=sc.max_batch, pack_plan=pack_plan)
-                for r in replicas
+    prewarm = None
+    if sc.prewarm_manifest:
+        # Deploy-time AOT prewarm (serve/aot.py): validate the
+        # manifest against this topology up front — snapshots are
+        # device-assignment-bound, so a manifest compiled for a
+        # different replica count cannot hydrate this pool.
+        from gnot_tpu.serve import aot
+
+        prewarm = aot.load_manifest(sc.prewarm_manifest)
+        expect = sc.replicas if sc.replicas > 1 else 1
+        if prewarm["replicas"] != expect:
+            raise ValueError(
+                f"--serve_prewarm manifest was compiled for "
+                f"{prewarm['replicas']} replicas; this run serves "
+                f"{expect} — re-run tools/aot_prewarm.py for the "
+                "target topology"
             )
-        else:
-            warmed = engine.warmup(samples, rows=sc.max_batch)
-            if pack_plan is not None:
-                warmed += engine.warmup_packed(samples, pack_plan)
-    if manifest_extra is not None:
-        manifest_extra["warmup_cache"] = {
-            "programs_warmed": warmed,
-            "replicas": sc.replicas,
-            **warm_stats,
-        }
     with PreemptionHandler() as preempt:
         common = dict(
             max_batch=sc.max_batch,
@@ -911,9 +906,70 @@ def _run_serve(
                 route_policy=sc.route_policy,
                 wedge_after_s=sc.wedge_after_s,
                 **common,
-            ).start()
+            )
         else:
-            server = InferenceServer(engine, **common).start()
+            server = InferenceServer(engine, **common)
+        # Serving-startup discipline (docs/serving.md): precompile one
+        # program per bucket the traffic will hit — a cold XLA compile
+        # landing under a tight deadline would shed everything behind
+        # it. Packed mode still warms the padded buckets too (the
+        # oversize fallback path). With a prewarm manifest the
+        # executables hydrate from warm-replica snapshots FIRST (no
+        # traces, no compiles; replica_warm events flow to the sink),
+        # and warmup only compiles whatever the manifest missed. The
+        # probe records persistent-compile-cache hits/misses for the
+        # run manifest: warm time is THE replica scale-out cost, and
+        # whether it compiled fresh, loaded cached executables, or
+        # skipped compiling entirely is the number to watch (ROADMAP
+        # cold-start item).
+        prewarm_stats = None
+        with compile_cache_probe() as warm_stats:
+            if prewarm is not None:
+                if replicas is not None:
+                    prewarm_stats = server.prewarm_from(prewarm)
+                    mismatched = [
+                        rid
+                        for rid, st in prewarm_stats.items()
+                        if st.get("reason") == "params_mismatch"
+                    ]
+                    if mismatched:
+                        print(
+                            "note: --serve_prewarm manifest was built "
+                            "for a different model/param layout; "
+                            f"replicas {mismatched} fall back to cold "
+                            "warmup"
+                        )
+                else:
+                    from gnot_tpu.serve import aot
+
+                    prewarm_stats = aot.hydrate_block(engine, prewarm, 0)
+                    if prewarm_stats.get("reason") == "params_mismatch":
+                        print(
+                            "note: --serve_prewarm manifest was built "
+                            "for a different model/param layout; "
+                            "falling back to cold warmup"
+                        )
+            if replicas is not None:
+                warmed = sum(
+                    r.warm(samples, rows=sc.max_batch, pack_plan=pack_plan)
+                    for r in replicas
+                )
+            else:
+                warmed = engine.warmup(samples, rows=sc.max_batch)
+                if pack_plan is not None:
+                    warmed += engine.warmup_packed(samples, pack_plan)
+        if manifest_extra is not None:
+            manifest_extra["warmup_cache"] = {
+                "programs_warmed": warmed,
+                "replicas": sc.replicas,
+                **(
+                    {"prewarm": prewarm_stats}
+                    if prewarm_stats is not None
+                    else {}
+                ),
+                **warm_stats,
+            }
+        server.start()
         futures = []
         for i, s in enumerate(samples):
             if preempt.triggered:

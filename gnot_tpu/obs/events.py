@@ -47,6 +47,8 @@ TRACE_FLUSH = "trace_flush"
 ROUTE = "route"
 REPLICA_HEALTH = "replica_health"
 ROLLING_RELOAD = "rolling_reload"
+AOT_PREWARM = "aot_prewarm"
+REPLICA_WARM = "replica_warm"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -206,6 +208,25 @@ EVENTS: dict[str, EventSpec] = {
         module="gnot_tpu/serve/router.py",
         doc="one step of a rolling hot-reload (one replica warming at "
         "a time; a failed step keeps old weights serving)",
+    ),
+    "aot_prewarm": EventSpec(
+        fields=("replicas", "programs", "compile_s", "cache_dir"),
+        module="gnot_tpu/serve/aot.py",
+        doc="deploy-time AOT compile pass: the whole serving program "
+        "family lowered + compiled into the persistent cache (and "
+        "snapshotted) before any replica serves",
+        optional=("snapshot_dir", "hits", "misses", "manifest",
+                  "snapshot_bytes"),
+    ),
+    "replica_warm": EventSpec(
+        fields=("replica", "source", "programs", "seconds"),
+        module="gnot_tpu/serve/router.py",
+        doc="one replica became serve-ready: `source` says how — "
+        "'snapshot' (hydrated AOT executables, no compiles), "
+        "'compile' (cold warmup dispatches), or 'none' (hydration "
+        "refused; `reason` says why); emitted at pool prewarm "
+        "and at every scale-out add_replica",
+        optional=("hits", "misses", "reason"),
     ),
     "trace_flush": EventSpec(
         fields=("path", "spans", "dropped"),

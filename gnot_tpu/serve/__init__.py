@@ -30,13 +30,21 @@ the single-server tier:
   replicas — health-gated (breaker/wedge/warming signals drain a sick
   replica to its siblings), bucket-affinity by default (a bucket's
   one-off compile lands on exactly one replica), with rolling
-  hot-reload across the pool and a pool-level ``serve_summary`` rollup.
+  hot-reload across the pool, live scale-out (``add_replica``), and a
+  pool-level ``serve_summary`` rollup.
+* ``aot`` — the deploy-time cold-start pipeline (docs/serving.md
+  "Deploy-time prewarm"): enumerate the serving program family,
+  ``jit(...).lower().compile()`` it into the persistent compile cache,
+  snapshot the executables, and hydrate warm replicas from the
+  manifest (``prewarm_from``) so scale-out/reload never pays an XLA
+  compile.
 
 Chaos-tested on CPU via the serve-side fault kinds in
 ``resilience.faults`` (``slow_request@N``, ``nan_output@N``,
 ``reload_corrupt@N``) — tests/test_serve.py.
 """
 
+from gnot_tpu.serve import aot  # noqa: F401
 from gnot_tpu.serve.batcher import Batcher  # noqa: F401
 from gnot_tpu.serve.engine import InferenceEngine  # noqa: F401
 from gnot_tpu.serve.policies import (  # noqa: F401
@@ -46,7 +54,11 @@ from gnot_tpu.serve.policies import (  # noqa: F401
     Deadline,
     ReplicaHealthPolicy,
 )
-from gnot_tpu.serve.replica import EngineReplica, build_replicas  # noqa: F401
+from gnot_tpu.serve.replica import (  # noqa: F401
+    EngineReplica,
+    build_replica,
+    build_replicas,
+)
 from gnot_tpu.serve.router import ReplicaRouter  # noqa: F401
 from gnot_tpu.serve.server import (  # noqa: F401
     CheckpointReloader,

@@ -1,0 +1,515 @@
+"""Ahead-of-time compile pipeline + warm-replica snapshots.
+
+Serving correctness already *depends* on warmup — a cold XLA compile
+landing under a tight deadline sheds everything queued behind it — and
+the replica tier multiplies the cost by N at every scale-out and
+rolling reload. This module makes cold start a deploy-time artifact
+instead of a first-request tax:
+
+* **Enumerate** (``enumerate_programs``): the exact program family a
+  deployment will serve — one program per bucket the representative
+  traffic hits (at the serving row count), plus the one ``PackPlan``
+  program under packed mode. The family is O(log L_max) by the
+  bucketing contract, so enumerating it is cheap and complete.
+* **Compile** (``aot_compile``): ``jit(...).lower(...).compile()`` each
+  program at deploy time — lowered against the engine's REAL placed
+  batch signature (mesh-slice sharding included), so the persistent
+  compile cache entry it writes is the one a live dispatch would look
+  up. Runs under ``utils.cache.warm_cache`` with the cache admission
+  threshold at 0 so every serving program persists, and records
+  per-program compile seconds + cache hit/miss.
+* **Snapshot**: each compiled executable is additionally serialized
+  (``jax.experimental.serialize_executable``) into ``snapshot_dir`` —
+  the warm-replica snapshot. Hydrating one (``hydrate``) deserializes
+  the executable and installs it in the engine's AOT table
+  (``InferenceEngine.install_program``): a prewarmed replica's first
+  request runs the executable DIRECTLY — no trace, no compile, no
+  cache lookup. Snapshots are device-assignment-bound (the XLA
+  executable is compiled for its replica's device slice), which is why
+  the manifest is keyed per replica.
+* **Manifest** (``save_manifest``/``load_manifest``): the deploy
+  artifact — program keys, compile seconds, snapshot bytes, cache-dir
+  occupancy — consumed by ``EngineReplica.prewarm_from`` /
+  ``ReplicaRouter.prewarm_from`` and recorded into ``run.json``.
+
+Snapshots use pickle (the upstream ``serialize_executable`` format):
+they are local, same-machine deploy artifacts like the compile cache
+itself — load them only from a directory you wrote.
+
+CLI: ``tools/aot_prewarm.py`` drives this end to end; the cold-start
+A/B lives in ``tools/coldstart_ab.py`` (docs/performance.md "Cold
+start").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import pickle
+import time
+from typing import Sequence
+
+import numpy as np
+
+from gnot_tpu.data.batch import (
+    MeshSample,
+    PackPlan,
+    collate,
+    pack_collate,
+    pack_prefix,
+)
+from gnot_tpu.utils.cache import cache_dir_manifest, warm_cache
+
+#: Manifest schema version (bump on incompatible changes; load_manifest
+#: rejects unknown versions loudly instead of hydrating garbage).
+MANIFEST_VERSION = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramSpec:
+    """One compiled serving program: a padded bucket (``kind="bucket"``,
+    one program per ``(pad_nodes, pad_funcs)`` at ``rows`` dispatch
+    rows) or THE packed program (``kind="packed"``, the ``PackPlan``'s
+    fixed grid). ``dims`` carries the sample schema (coordinate /
+    theta / function / target widths) so a dummy batch with the exact
+    dispatch signature can be rebuilt in any process — the manifest
+    round-trips without the original traffic."""
+
+    key: str
+    kind: str  # "bucket" | "packed"
+    pad_nodes: int
+    pad_funcs: int
+    rows: int
+    dims: dict
+    plan: dict | None = None  # PackPlan fields when kind == "packed"
+
+    def dummy_samples(self) -> list[MeshSample]:
+        """Zero-filled sample(s) whose collated batch has this
+        program's dispatch signature (values never matter — programs
+        are shape-keyed)."""
+        d = self.dims
+        n = self.pad_nodes if self.kind == "bucket" else self.plan["chunk"]
+        funcs = tuple(
+            np.zeros((max(1, self.pad_funcs), d["func_dim"]), np.float32)
+            for _ in range(d["n_funcs"])
+        ) if d["n_funcs"] else ()
+        return [
+            MeshSample(
+                coords=np.zeros((n, d["input_dim"]), np.float32),
+                y=np.zeros((n, d["out_dim"]), np.float32),
+                theta=np.zeros((d["theta_dim"],), np.float32),
+                funcs=funcs,
+            )
+        ]
+
+    def dummy_batch(self):
+        """The collated (host-side) batch at this program's exact
+        static shape — what the engine lowers/dispatches."""
+        samples = self.dummy_samples()
+        if self.kind == "packed":
+            plan = PackPlan(**self.plan)
+            placements = pack_prefix(
+                [s.coords.shape[0] for s in samples], plan
+            )
+            return pack_collate(
+                samples,
+                placements,
+                n_rows=plan.n_rows,
+                row_len=plan.row_len,
+                chunk=plan.chunk,
+                n_slots=plan.n_slots,
+                pad_funcs=plan.pad_funcs,
+            )
+        reqs = samples * self.rows
+        return collate(
+            reqs,
+            bucket=False,
+            pad_nodes=self.pad_nodes,
+            pad_funcs=self.pad_funcs,
+        )
+
+
+def params_signature(params) -> str:
+    """Structure fingerprint of a param tree (paths + shapes + dtypes,
+    values excluded — snapshots take params as a runtime argument). A
+    snapshot compiled for one model must not hydrate an engine serving
+    another: the loaded executable would reject (or worse, misread)
+    the foreign param tree at dispatch time, mid-traffic. Checked at
+    ``hydrate``; a mismatch skips the snapshot and the engine stays on
+    the ordinary jit path."""
+    import hashlib
+
+    import jax
+
+    leaves = jax.tree_util.tree_leaves_with_path(params)
+    desc = ";".join(
+        f"{jax.tree_util.keystr(path)}:{np.shape(leaf)}:"
+        f"{getattr(leaf, 'dtype', type(leaf).__name__)}"
+        for path, leaf in sorted(leaves, key=lambda kv: str(kv[0]))
+    )
+    return hashlib.sha256(desc.encode()).hexdigest()[:16]
+
+
+def sample_dims(sample: MeshSample) -> dict:
+    """The schema widths of one representative sample (ProgramSpec.dims)."""
+    return {
+        "input_dim": int(sample.coords.shape[1]),
+        "out_dim": int(sample.y.shape[1]),
+        "theta_dim": int(np.atleast_1d(sample.theta).shape[0]),
+        "n_funcs": len(sample.funcs),
+        "func_dim": int(sample.funcs[0].shape[1]) if sample.funcs else 0,
+    }
+
+
+def enumerate_programs(
+    engine,
+    samples: Sequence[MeshSample],
+    *,
+    rows: int | None = None,
+    pack_plan: PackPlan | None = None,
+) -> list[ProgramSpec]:
+    """The program family a deployment serving ``samples``-shaped
+    traffic needs: one bucket program per distinct ``bucket_key`` in
+    the representative set (the oversize-fallback path stays warm even
+    under packed mode — mirroring ``EngineReplica.warm``), plus the one
+    packed program when a plan is given."""
+    if not samples:
+        raise ValueError("enumerate_programs needs representative samples")
+    rows = rows or engine.batch_size
+    dims = sample_dims(samples[0])
+    specs = []
+    seen: set[tuple[int, int]] = set()
+    for s in samples:
+        key = engine.bucket_key(s)
+        if key in seen:
+            continue
+        seen.add(key)
+        pn, pf = key
+        specs.append(
+            ProgramSpec(
+                key=f"bucket:{pn}x{pf}@{rows}",
+                kind="bucket",
+                pad_nodes=pn,
+                pad_funcs=pf,
+                rows=rows,
+                dims=dims,
+            )
+        )
+    specs.sort(key=lambda sp: sp.key)
+    if pack_plan is not None:
+        specs.append(
+            ProgramSpec(
+                key=f"packed:{pack_plan.n_rows}x{pack_plan.row_len}",
+                kind="packed",
+                pad_nodes=0,
+                pad_funcs=pack_plan.pad_funcs,
+                rows=pack_plan.n_rows,
+                dims=dims,
+                plan=dataclasses.asdict(pack_plan),
+            )
+        )
+    return specs
+
+
+def _snapshot_file(snapshot_dir: str, replica_id: int, key: str) -> str:
+    safe = key.replace(":", "_").replace("@", "_")
+    return os.path.join(snapshot_dir, f"r{replica_id}_{safe}.xsnap")
+
+
+def aot_compile(
+    engine,
+    specs: Sequence[ProgramSpec],
+    *,
+    replica_id: int = 0,
+    snapshot_dir: str | None = None,
+) -> dict:
+    """Compile every program in ``specs`` for ``engine`` ahead of time:
+    ``lower()`` at the REAL placed dispatch signature, ``.compile()``
+    into the persistent cache (admission threshold 0 — every serving
+    program persists), and — with ``snapshot_dir`` — serialize each
+    executable as a warm-replica snapshot. Returns the manifest block
+    for this engine: per-program entries (key, compile seconds,
+    snapshot file/bytes) plus the aggregated cache stats."""
+    from jax.experimental import serialize_executable
+
+    compiled: dict[str, object] = {}
+
+    def thunk(spec):
+        def run():
+            placed = engine.place_batch(spec.dummy_batch())
+            compiled[spec.key] = engine.lower_program(placed).compile()
+
+        return run
+
+    stats = warm_cache((spec.key, thunk(spec)) for spec in specs)
+    by_key = {p["key"]: p["seconds"] for p in stats["programs"]}
+    entries = []
+    for spec in specs:
+        entry = {
+            **dataclasses.asdict(spec),
+            "compile_s": by_key[spec.key],
+            "snapshot": None,
+            "snapshot_bytes": None,
+        }
+        if snapshot_dir is not None:
+            os.makedirs(snapshot_dir, exist_ok=True)
+            path = _snapshot_file(snapshot_dir, replica_id, spec.key)
+            blob = _snapshot_blob(engine, spec, compiled[spec.key])
+            tmp = f"{path}.tmp"
+            with open(tmp, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+            entry["snapshot"] = os.path.basename(path)
+            entry["snapshot_bytes"] = len(blob)
+        entries.append(entry)
+    return {
+        "replica": replica_id,
+        "params_sig": params_signature(engine.params),
+        "programs": entries,
+        "compile_s": stats["seconds"],
+        "cache": {
+            k: stats[k]
+            for k in ("requests", "hits", "misses", "dir",
+                      "entries_before", "entries_after")
+        },
+    }
+
+
+#: Process-unique tags for snapshot recompiles (see _snapshot_blob).
+_SNAP_TAGS = itertools.count()
+
+
+def _snapshot_blob(engine, spec: ProgramSpec, compiled) -> bytes:
+    """Serialize one executable as a warm-replica snapshot, VALIDATED
+    by an in-process test load. On CPU jaxlib 0.4.x an executable whose
+    program was ever LOADED in this process (a persistent-cache hit, a
+    prior snapshot hydration) re-serializes without its jitted kernel
+    symbols — deserialization then fails with "Symbols not found", and
+    the kernel dedup is keyed by HLO module NAME, so even a fresh
+    recompile of the same-named module stays thin. When validation
+    catches that, the program is recompiled genuinely fresh: new jit
+    object, persistent cache disabled, and a process-unique module
+    name (``rename_forward``) the dedup cannot match. A deploy pass
+    over a warm cache therefore still emits loadable snapshots."""
+    import pickle as _pickle
+
+    from jax.experimental import serialize_executable
+
+    from gnot_tpu.utils.cache import compile_cache_disabled
+
+    blob = _pickle.dumps(serialize_executable.serialize(compiled))
+    try:
+        serialize_executable.deserialize_and_load(*_pickle.loads(blob))
+        return blob
+    except Exception:  # noqa: BLE001 — fall through to the fresh compile
+        pass
+    tag = f"p{os.getpid()}_{next(_SNAP_TAGS)}"
+    with compile_cache_disabled():
+        placed = engine.place_batch(spec.dummy_batch())
+        fresh = engine.lower_fresh(placed, tag=tag).compile()
+    blob = _pickle.dumps(serialize_executable.serialize(fresh))
+    # A snapshot that STILL fails to load is a deploy-time error — far
+    # better than N replicas discovering it at scale-out.
+    serialize_executable.deserialize_and_load(*_pickle.loads(blob))
+    return blob
+
+
+def hydrate(
+    engine,
+    programs: Sequence[dict],
+    snapshot_dir: str,
+    *,
+    params_sig: str | None = None,
+) -> dict:
+    """Warm-replica hydration: deserialize each program's snapshot and
+    install it in the engine's AOT table — no trace, no compile, no
+    cache lookup on any later dispatch of that signature. Programs
+    without a snapshot (or with an unreadable one) are SKIPPED and
+    counted, not fatal: a missing snapshot degrades that one program to
+    the ordinary jit-plus-persistent-cache path, exactly the cold
+    behavior serving already survives. Returns ``{"installed",
+    "skipped", "seconds", "keys"}``."""
+    from jax.experimental import serialize_executable
+
+    t0 = time.monotonic()
+    if params_sig is not None and params_sig != params_signature(
+        engine.params
+    ):
+        # Snapshots from a different model/param layout: refuse them
+        # ALL — the engine serves cold (jit + persistent cache), which
+        # is slow but correct.
+        return {
+            "installed": 0,
+            "skipped": len(list(programs)),
+            "seconds": time.monotonic() - t0,
+            "keys": [],
+            "reason": "params_mismatch",
+        }
+    installed, skipped, keys, errors = 0, 0, [], []
+    for entry in programs:
+        name = entry.get("snapshot")
+        path = os.path.join(snapshot_dir, name) if name else None
+        try:
+            if path is None:
+                raise FileNotFoundError("no snapshot recorded")
+            with open(path, "rb") as f:
+                payload, in_tree, out_tree = pickle.loads(f.read())
+            loaded = serialize_executable.deserialize_and_load(
+                payload, in_tree, out_tree
+            )
+        except Exception as err:  # noqa: BLE001 — degrade to the jit path
+            skipped += 1
+            errors.append(f"{entry.get('key')}: {type(err).__name__}: {err}")
+            continue
+        spec = ProgramSpec(
+            **{
+                k: entry[k]
+                for k in ("key", "kind", "pad_nodes", "pad_funcs",
+                          "rows", "dims", "plan")
+            }
+        )
+        # Keyed on the PLACED signature, mirroring aot_compile's
+        # lowering and _run_forward's lookup — an engine whose
+        # device_put hook reshapes leaves (e.g. multi-process global
+        # batch assembly) would otherwise install keys no dispatch
+        # ever matches.
+        signature = engine.signature_of(
+            engine.place_batch(spec.dummy_batch())
+        )
+        engine.install_program(signature, loaded)
+        installed += 1
+        keys.append(spec.key)
+    return {
+        "installed": installed,
+        "skipped": skipped,
+        "seconds": time.monotonic() - t0,
+        "keys": keys,
+        **({"errors": errors} if errors else {}),
+    }
+
+
+def prewarm_deployment(
+    engines,
+    samples: Sequence[MeshSample],
+    *,
+    rows: int,
+    pack_plan: PackPlan | None = None,
+    snapshot_dir: str,
+    manifest_path: str | None = None,
+    sink=None,
+    extra: dict | None = None,
+) -> dict:
+    """The deploy-time pass, end to end: enumerate the program family
+    once, AOT-compile + snapshot it for EVERY engine of the target
+    topology (``engines`` is ``[(replica_id, InferenceEngine), ...]`` —
+    snapshots are device-bound, so each replica slice compiles its
+    own), write the manifest, and emit one ``aot_prewarm`` event.
+    Returns the manifest document (also written to ``manifest_path``
+    when given)."""
+    from gnot_tpu.obs import events
+
+    engines = list(engines)
+    if not engines:
+        raise ValueError("prewarm_deployment needs at least one engine")
+    specs = enumerate_programs(
+        engines[0][1], samples, rows=rows, pack_plan=pack_plan
+    )
+    per_replica = {}
+    for rid, engine in engines:
+        per_replica[str(rid)] = aot_compile(
+            engine, specs, replica_id=rid, snapshot_dir=snapshot_dir
+        )
+    blocks = per_replica.values()
+    doc = {
+        "version": MANIFEST_VERSION,
+        "cache_dir": cache_dir_manifest(),
+        "replicas": len(engines),
+        "rows": rows,
+        "packed": pack_plan is not None,
+        "snapshot_dir": os.path.abspath(snapshot_dir),
+        "program_keys": [sp.key for sp in specs],
+        "compile_s": sum(b["compile_s"] for b in blocks),
+        "snapshot_bytes": sum(
+            e["snapshot_bytes"] or 0
+            for b in blocks
+            for e in b["programs"]
+        ),
+        "cache": {
+            "hits": _sum_opt(b["cache"]["hits"] for b in blocks),
+            "misses": _sum_opt(b["cache"]["misses"] for b in blocks),
+        },
+        **(extra or {}),
+        "per_replica": per_replica,
+    }
+    if manifest_path:
+        save_manifest(manifest_path, doc)
+    if sink is not None:
+        sink.log(
+            event=events.AOT_PREWARM,
+            replicas=doc["replicas"],
+            programs=len(specs) * len(engines),
+            compile_s=doc["compile_s"],
+            cache_dir=cache_dir_manifest().get("dir"),
+            snapshot_dir=doc["snapshot_dir"],
+            snapshot_bytes=doc["snapshot_bytes"],
+            hits=doc["cache"]["hits"],
+            misses=doc["cache"]["misses"],
+            **({"manifest": manifest_path} if manifest_path else {}),
+        )
+    return doc
+
+
+def _sum_opt(values) -> int | None:
+    """Sum that degrades to None when any addend is None (the probe's
+    private-API degradation contract)."""
+    total = 0
+    for v in values:
+        if v is None:
+            return None
+        total += v
+    return total
+
+
+def hydrate_block(engine, manifest: dict, replica_id: int) -> dict:
+    """Hydrate one engine from its manifest block — THE shared entry
+    point for both ``EngineReplica.prewarm_from`` and the
+    single-server ``--serve_prewarm`` path, so params-guard threading
+    and skip accounting cannot drift between them."""
+    block = manifest["per_replica"][str(replica_id)]
+    return hydrate(
+        engine,
+        block["programs"],
+        manifest["snapshot_dir"],
+        params_sig=block.get("params_sig"),
+    )
+
+
+def save_manifest(path: str, doc: dict) -> str:
+    """Atomically write the deploy manifest (fills in the schema
+    version and the cache-dir occupancy snapshot when absent)."""
+    doc = {
+        "version": MANIFEST_VERSION,
+        "cache_dir": cache_dir_manifest(),
+        **doc,
+    }
+    if d := os.path.dirname(path):
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def load_manifest(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("version") != MANIFEST_VERSION:
+        raise ValueError(
+            f"manifest {path} has version {doc.get('version')!r}; this "
+            f"build reads version {MANIFEST_VERSION} — re-run "
+            "tools/aot_prewarm.py against the current tree"
+        )
+    return doc

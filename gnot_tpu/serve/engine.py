@@ -45,6 +45,23 @@ from gnot_tpu.data.batch import (
 )
 
 
+def rename_forward(fn: Callable, tag: str | None) -> Callable:
+    """Wrap ``fn`` under a distinct ``__name__`` (hence a distinct HLO
+    module name) when ``tag`` is set. The XLA CPU backend dedups
+    compiles of an identically-named module against kernels already
+    loaded in the process, which makes their executables
+    unserializable — a unique name forces genuinely fresh codegen
+    (serve/aot.py snapshot compiles). Identity when ``tag`` is None."""
+    if tag is None:
+        return fn
+
+    def _renamed(p, b):
+        return fn(p, b)
+
+    _renamed.__name__ = _renamed.__qualname__ = f"gnot_snapshot_{tag}"
+    return _renamed
+
+
 class InferenceEngine:
     """Validated, bucketed, statically-shaped batched forward.
 
@@ -67,6 +84,7 @@ class InferenceEngine:
         pad_nodes: int = 0,
         pad_funcs: int = 0,
         forward: Callable | None = None,
+        forward_builder: Callable | None = None,
         device_put: Callable | None = None,
         group_pad: bool = False,
         n_proc: int = 1,
@@ -85,11 +103,29 @@ class InferenceEngine:
         # neither migrates the replica off its devices nor forces a
         # recompile. Identity when absent.
         self._place_params = place_params or (lambda p: p)
-        if forward is None:
+        if forward is None and forward_builder is None:
             from gnot_tpu.train.trainer import apply_batch
 
-            forward = jax.jit(lambda p, b: apply_batch(model, p, b))
+            def forward_builder(tag=None):
+                fn = rename_forward(
+                    lambda p, b: apply_batch(model, p, b), tag
+                )
+                return jax.jit(fn)
+
+        if forward is None:
+            forward = forward_builder()
         self._forward = forward
+        # Factory for a FRESH jitted forward with identical options
+        # (serve/aot.py): once a program has been LOADED in-process
+        # (persistent-cache hit, snapshot hydration), the CPU backend
+        # dedups later compiles of the same-named HLO module against
+        # the loaded kernels and their executables re-serialize
+        # without kernel code ("Symbols not found") — snapshot
+        # compiles therefore need a brand-new jit object AND, via
+        # ``tag``, a unique module name. None when the caller passed
+        # only a prebuilt `forward` (AOT snapshots then degrade to
+        # whatever that object compiles).
+        self._forward_builder = forward_builder
         self.group_pad = group_pad
         self.n_proc = n_proc
         self.p_idx = p_idx
@@ -103,6 +139,19 @@ class InferenceEngine:
         # suite bounds it by the bucket count; mutated by whichever
         # thread dispatches, read by the server's summary thread.
         self._shapes: set[tuple] = set()  #: guarded_by _lock
+        # AOT-hydrated executables (serve/aot.py warm-replica
+        # snapshots): dispatch-signature key -> loaded executable.
+        # Dispatches whose signature is installed here run the
+        # executable DIRECTLY — no trace, no compile, no cache lookup —
+        # so a prewarmed replica's first request never waits on XLA.
+        # Written by the prewarm path (router/CLI thread), read by the
+        # worker's dispatches.
+        self._aot: dict[tuple, Callable] = {}  #: guarded_by _lock
+        # Dispatch provenance counters for the prewarm assertions
+        # (serve_smoke --prewarm): how many dispatches ran through an
+        # installed snapshot vs fell back to the jitted forward.
+        self._aot_calls = 0  #: guarded_by _lock
+        self._jit_calls = 0  #: guarded_by _lock
 
     # -- params ------------------------------------------------------------
 
@@ -156,6 +205,74 @@ class InferenceEngine:
         with self._lock:
             return len(self._shapes)
 
+    # -- ahead-of-time programs (serve/aot.py) -----------------------------
+
+    def place_batch(self, batch):
+        """Place a host batch exactly as a live dispatch would (the
+        trainer/replica mesh-sharding hook; identity otherwise) — the
+        AOT pipeline lowers against THIS so the compiled signature is
+        the one real dispatches hit."""
+        return self._device_put(batch)
+
+    def lower_program(self, batch):
+        """``jit(...).lower()`` of the serving forward at ``batch``'s
+        (already placed) signature — no execution, no compile. The AOT
+        pipeline calls ``.compile()`` on the result at deploy time so
+        the persistent cache (and the warm-replica snapshot) holds the
+        executable before any replica serves."""
+        return self._forward.lower(self.params, batch)
+
+    def lower_fresh(self, batch, *, tag: str | None = None):
+        """Like ``lower_program`` but on a brand-new jit object (see
+        ``forward_builder``), optionally under a unique HLO module name
+        (``tag``) — the compile this produces is genuinely fresh (and
+        serializable) even when this program was already compiled or
+        cache-loaded in this process."""
+        fwd = (
+            self._forward_builder(tag=tag)
+            if self._forward_builder is not None
+            else self._forward
+        )
+        return fwd.lower(self.params, batch)
+
+    @staticmethod
+    def signature_of(batch) -> tuple:
+        """The dispatch-signature key of a (host or placed) batch —
+        what the AOT executable table and ``compiled_shapes`` key on."""
+        return tuple(np.shape(l) for l in jax.tree.leaves(batch))
+
+    def install_program(self, signature: tuple, loaded: Callable) -> None:
+        """Hydrate one AOT executable: dispatches whose batch matches
+        ``signature`` run ``loaded(params, batch)`` directly instead of
+        the jitted forward — zero trace/compile on the hot path."""
+        with self._lock:
+            self._aot[signature] = loaded
+
+    @property
+    def aot_programs(self) -> int:
+        with self._lock:
+            return len(self._aot)
+
+    @property
+    def dispatch_counts(self) -> dict:
+        """``{"aot": n, "jit": m}`` — how many dispatches ran through an
+        installed snapshot vs the jitted forward (the serve_smoke
+        --prewarm assertion: a fully prewarmed storm has ``jit == 0``)."""
+        with self._lock:
+            return {"aot": self._aot_calls, "jit": self._jit_calls}
+
+    def _run_forward(self, params, placed):
+        """One forward execution: the installed AOT executable when this
+        signature was hydrated, the jitted forward otherwise."""
+        sig = self.signature_of(placed)
+        with self._lock:
+            loaded = self._aot.get(sig)
+            if loaded is not None:
+                self._aot_calls += 1
+            else:
+                self._jit_calls += 1
+        return (loaded or self._forward)(params, placed)
+
     # -- the serving hot path ----------------------------------------------
 
     def infer(
@@ -206,7 +323,7 @@ class InferenceEngine:
         if timings is not None:
             t1 = tick()
             timings["batch_assembly"] = (t0, t1)
-        out = np.asarray(self._forward(params, self._device_put(batch)))
+        out = np.asarray(self._run_forward(params, self._device_put(batch)))
         if timings is not None:
             t2 = tick()
             timings["device"] = (t1, t2)
@@ -263,7 +380,7 @@ class InferenceEngine:
         if timings is not None:
             t1 = tick()
             timings["batch_assembly"] = (t0, t1)
-        out = np.asarray(self._forward(params, self._device_put(batch)))
+        out = np.asarray(self._run_forward(params, self._device_put(batch)))
         if timings is not None:
             t2 = tick()
             timings["device"] = (t1, t2)
@@ -350,7 +467,7 @@ class InferenceEngine:
             # the per-host slices; the forward runs sharded and returns
             # the replicated [group, L, out] prediction.
             self._note_shape(batch)
-            out = np.asarray(self._forward(params, self._device_put(batch)))
+            out = np.asarray(self._run_forward(params, self._device_put(batch)))
             for j in range(out.shape[0]):
                 idx = bi * group + j
                 outs.append(out[j, : samples[idx].coords.shape[0]])

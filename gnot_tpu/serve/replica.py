@@ -36,7 +36,7 @@ from typing import Callable, Sequence
 
 from gnot_tpu.config import MeshConfig
 from gnot_tpu.data.batch import MeshSample, PackPlan
-from gnot_tpu.serve.engine import InferenceEngine
+from gnot_tpu.serve.engine import InferenceEngine, rename_forward
 from gnot_tpu.serve.server import PACKED_BUCKET
 
 
@@ -58,6 +58,12 @@ class EngineReplica:
         # Rolling-reload drain flag: True while THIS replica's weights
         # are swapping (at most one replica warms at a time).
         self._warming = False  #: guarded_by _lock
+        # How this replica became serve-ready — written once by
+        # warm()/prewarm_from() before the replica takes traffic, read
+        # by the router's serve_summary rollup and replica_warm event:
+        # {"source": "compile"|"snapshot", "programs", "seconds",
+        # "hits", "misses", ...}. None until warmed.
+        self._warm_stats: dict | None = None  #: guarded_by _lock
 
     def attach_server(self, server) -> "EngineReplica":
         self.server = server
@@ -74,15 +80,111 @@ class EngineReplica:
     ) -> int:
         """Precompile one program per bucket in ``samples`` (plus the
         packed program when a plan is given) and seed the affinity set
-        with the warmed keys. Returns the number of programs warmed."""
-        warmed = self.engine.warmup(samples, rows=rows)
-        keys = {self.engine.bucket_key(s) for s in samples}
-        if pack_plan is not None:
-            warmed += self.engine.warmup_packed(samples, pack_plan)
-            keys.add(PACKED_BUCKET)
+        with the warmed keys — the COLD path: each program pays a real
+        trace + XLA compile (or a persistent-cache load) here. Records
+        ``warm_stats`` (source "compile", cache hit/miss breakdown).
+        Returns the number of programs warmed."""
+        import time
+
+        from gnot_tpu.utils.cache import compile_cache_probe
+
+        t0 = time.monotonic()
+        with compile_cache_probe() as cache:
+            warmed = self.engine.warmup(samples, rows=rows)
+            keys = {self.engine.bucket_key(s) for s in samples}
+            if pack_plan is not None:
+                warmed += self.engine.warmup_packed(samples, pack_plan)
+                keys.add(PACKED_BUCKET)
+        stats = {
+            "source": "compile",
+            "programs": warmed,
+            "seconds": time.monotonic() - t0,
+            "hits": cache["hits"],
+            "misses": cache["misses"],
+        }
         with self._lock:
             self._buckets |= keys
+            if (
+                self._warm_stats is not None
+                and self._warm_stats.get("source") == "snapshot"
+            ):
+                # A warmup AFTER snapshot hydration is the residual
+                # pass (buckets the manifest missed run their one cold
+                # compile; hydrated ones dispatch through the AOT
+                # table). Keep the snapshot provenance, record the
+                # residual.
+                self._warm_stats["warmup_after"] = stats
+            else:
+                self._warm_stats = stats
         return warmed
+
+    def prewarm_from(
+        self, manifest: dict, *, snapshot_dir: str | None = None
+    ) -> dict:
+        """Warm-replica hydration (serve/aot.py): install this
+        replica's AOT-compiled executables from the deploy manifest's
+        snapshots and seed the affinity set from the program list — no
+        trace, no compile, no dispatch. A program whose snapshot is
+        missing/unreadable degrades to the ordinary jit path (counted
+        in ``skipped``), so a stale manifest can only make a replica
+        colder, never wrong. Returns the recorded ``warm_stats``."""
+        from gnot_tpu.serve import aot
+
+        block = manifest.get("per_replica", {}).get(str(self.replica_id))
+        if block is None:
+            # Scale-out past the manifest's topology (e.g. a 5th
+            # replica on a 4-replica manifest): colder, never wrong —
+            # the replica warms via ordinary compiles.
+            warm_stats = {
+                "source": "none",
+                "programs": 0,
+                "skipped": 0,
+                "seconds": 0.0,
+                "hits": 0,
+                "misses": 0,
+                "reason": "no_manifest_block",
+            }
+            with self._lock:
+                self._warm_stats = warm_stats
+            return warm_stats
+        if snapshot_dir is not None:
+            manifest = {**manifest, "snapshot_dir": snapshot_dir}
+        stats = aot.hydrate_block(self.engine, manifest, self.replica_id)
+        keys = set()
+        for entry in block["programs"]:
+            if entry["key"] not in stats["keys"]:
+                continue
+            if entry["kind"] == "packed":
+                keys.add(PACKED_BUCKET)
+            else:
+                keys.add((entry["pad_nodes"], entry["pad_funcs"]))
+        warm_stats = {
+            # A replica that installed nothing did NOT hydrate — its
+            # warm provenance must not claim "snapshot" (the operator
+            # reading replica_warm events would conclude the pool was
+            # warm when every program compiles cold).
+            "source": "snapshot" if stats["installed"] else "none",
+            "programs": stats["installed"],
+            "skipped": stats["skipped"],
+            "seconds": stats["seconds"],
+            # Snapshot hydration never consults the compile cache —
+            # zero misses BY CONSTRUCTION, the number the prewarm smoke
+            # asserts.
+            "hits": stats["installed"],
+            "misses": 0,
+            # Wholesale-refusal provenance (e.g. params_mismatch): the
+            # router/CLI surface it instead of silently serving cold.
+            **({"reason": stats["reason"]} if "reason" in stats else {}),
+        }
+        with self._lock:
+            self._buckets |= keys
+            self._warm_stats = warm_stats
+        return warm_stats
+
+    @property
+    def warm_stats(self) -> dict | None:
+        with self._lock:
+            return dict(self._warm_stats) if self._warm_stats else None
 
     def has_bucket(self, key) -> bool:
         with self._lock:
@@ -158,34 +260,88 @@ def build_replicas(
             f"replica slice ({len(devices)} devices / {n_replicas} "
             "replicas): dispatch rows shard over the slice"
         )
-    if forward_fn is None:
-        from gnot_tpu.train.trainer import apply_batch
-
-        forward_fn = lambda p, b: apply_batch(model, p, b)  # noqa: E731
-
-    replicas = []
-    for i in range(n_replicas):
-        mesh_devices = devices[i * per : (i + 1) * per]
-        rmesh = mesh_lib.make_mesh(MeshConfig(data=per), devices=mesh_devices)
-        replicated = NamedSharding(rmesh, PartitionSpec())
-        rparams = jax.device_put(params, replicated)
-        # One executable per replica is the POINT of this loop (N fixed
-        # placements, not per-request retracing) — the recompile-hazard
-        # rule is right in general and wrong here.
-        forward = jax.jit(forward_fn, out_shardings=replicated)  # graftlint: disable=GL003 — one jit per replica slice, N is the replica count not traffic
-        engine = InferenceEngine(
+    replicas = [
+        build_replica(
             model,
-            rparams,
+            params,
+            i,
+            devices[i * per : (i + 1) * per],
             batch_size=batch_size,
             bucket=bucket,
             pad_nodes=pad_nodes,
             pad_funcs=pad_funcs,
-            forward=forward,
-            device_put=lambda b, m=rmesh: mesh_lib.shard_batch(m, b),
-            # Hot-reloaded params arrive as host arrays; re-placing
-            # them under the replica's sharding keeps the swap from
-            # forcing a recompile (and keeps the replica on its slice).
-            place_params=lambda p, s=replicated: jax.device_put(p, s),
+            forward_fn=forward_fn,
         )
-        replicas.append(EngineReplica(i, engine))
+        for i in range(n_replicas)
+    ]
     return replicas
+
+
+def build_replica(
+    model,
+    params,
+    replica_id: int,
+    slice_devices: Sequence,
+    *,
+    batch_size: int,
+    bucket: bool = True,
+    pad_nodes: int = 0,
+    pad_funcs: int = 0,
+    forward_fn: Callable | None = None,
+) -> EngineReplica:
+    """ONE replica on an explicit device slice — the scale-out unit.
+
+    ``build_replicas`` is this in a loop over contiguous slices; the
+    AOT prewarm CLI and a live scale-out (``ReplicaRouter.add_replica``)
+    build individual replicas for slices of the SAME target topology,
+    so replica ``i`` here and replica ``i`` at deploy-time prewarm sit
+    on identical device assignments — the condition for its warm
+    snapshot (device-bound XLA executables) to hydrate."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from gnot_tpu.parallel import mesh as mesh_lib
+
+    if forward_fn is None:
+        from gnot_tpu.train.trainer import apply_batch
+
+        forward_fn = lambda p, b: apply_batch(model, p, b)  # noqa: E731
+    per = len(slice_devices)
+    if per < 1:
+        raise ValueError("a replica needs at least one device")
+    if batch_size % per:
+        raise ValueError(
+            f"batch_size {batch_size} must divide by the {per}-device "
+            "replica slice: dispatch rows shard over the slice"
+        )
+    rmesh = mesh_lib.make_mesh(
+        MeshConfig(data=per), devices=list(slice_devices)
+    )
+    replicated = NamedSharding(rmesh, PartitionSpec())
+    rparams = jax.device_put(params, replicated)
+    # One executable per replica is the POINT of the replica tier (N
+    # fixed placements, not per-request retracing) — the
+    # recompile-hazard rule is right in general and wrong here.
+    forward = jax.jit(forward_fn, out_shardings=replicated)  # graftlint: disable=GL003 — one jit per replica slice, N is the replica count not traffic
+    engine = InferenceEngine(
+        model,
+        rparams,
+        batch_size=batch_size,
+        bucket=bucket,
+        pad_nodes=pad_nodes,
+        pad_funcs=pad_funcs,
+        forward=forward,
+        # Fresh-jit factory for AOT snapshot compiles (serve/aot.py):
+        # same fn, same out-sharding, NEW jit object (uniquely named
+        # under a tag so the CPU backend cannot dedup it against
+        # already-loaded kernels).
+        forward_builder=lambda tag=None: jax.jit(
+            rename_forward(forward_fn, tag), out_shardings=replicated
+        ),
+        device_put=lambda b, m=rmesh: mesh_lib.shard_batch(m, b),
+        # Hot-reloaded params arrive as host arrays; re-placing
+        # them under the replica's sharding keeps the swap from
+        # forcing a recompile (and keeps the replica on its slice).
+        place_params=lambda p, s=replicated: jax.device_put(p, s),
+    )
+    return EngineReplica(replica_id, engine)

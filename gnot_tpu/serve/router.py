@@ -113,6 +113,7 @@ class ReplicaRouter:
         self.sink = sink
         self.reload_fn = reload_fn
         self._clock = clock
+        self._tracer = tracer
         self.health = ReplicaHealthPolicy(wedge_after_s=wedge_after_s)
         if faults is None:
             fault_map: dict = {}
@@ -120,24 +121,32 @@ class ReplicaRouter:
             fault_map = dict(faults)
         else:
             fault_map = {self.replicas[0].replica_id: faults}
+        # Per-replica server construction knobs, kept so a scale-out
+        # replica (add_replica) gets an identically-configured server.
+        # Injected faults stay with the FOUNDING replicas only — a
+        # scale-out replica is a fresh process-alike, not a chaos
+        # target.
+        self._server_kwargs = dict(
+            max_batch=max_batch,
+            max_wait_ms=max_wait_ms,
+            queue_limit=queue_limit,
+            default_deadline_ms=default_deadline_ms,
+            breaker_threshold=breaker_threshold,
+            breaker_cooldown_s=breaker_cooldown_s,
+            sink=sink,
+            reload_fn=reload_fn,
+            preempt=preempt,
+            clock=clock,
+            tracer=tracer,
+            pack_plan=pack_plan,
+        )
         for r in self.replicas:
             r.attach_server(
                 InferenceServer(
                     r.engine,
-                    max_batch=max_batch,
-                    max_wait_ms=max_wait_ms,
-                    queue_limit=queue_limit,
-                    default_deadline_ms=default_deadline_ms,
-                    breaker_threshold=breaker_threshold,
-                    breaker_cooldown_s=breaker_cooldown_s,
-                    sink=sink,
-                    reload_fn=reload_fn,
                     faults=fault_map.get(r.replica_id),
-                    preempt=preempt,
-                    clock=clock,
-                    tracer=tracer,
-                    pack_plan=pack_plan,
                     replica=r.replica_id,
+                    **self._server_kwargs,
                 )
             )
         self._lock = threading.Lock()
@@ -159,9 +168,118 @@ class ReplicaRouter:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> "ReplicaRouter":
-        for r in self.replicas:
+        for r in self._pool():
             r.server.start()
         return self
+
+    def _pool(self) -> list[EngineReplica]:
+        """Snapshot of the replica list — ``add_replica`` grows it
+        while submit/reload/drain threads iterate."""
+        with self._lock:
+            return list(self.replicas)
+
+    def prewarm_from(self, manifest: dict) -> dict:
+        """Hydrate EVERY pool replica from the deploy manifest's
+        warm-replica snapshots (``tools/aot_prewarm.py`` →
+        ``EngineReplica.prewarm_from``): each replica installs its
+        AOT-compiled executables and seeds its affinity set without a
+        single trace, compile, or dispatch. Emits one ``replica_warm``
+        event (and a warm-vs-cold tracer span) per replica. Returns
+        ``{replica_id: warm_stats}``."""
+        stats = {}
+        for r in self._pool():
+            t0 = self._clock()
+            stats[r.replica_id] = r.prewarm_from(manifest)
+            self._note_warm(r, t0)
+        return stats
+
+    def add_replica(self, replica: EngineReplica) -> EngineReplica:
+        """Scale-out: attach an identically-configured server to an
+        already-warmed replica (``build_replica`` + ``warm`` or
+        ``prewarm_from``), start it, and place it in the live pool —
+        submitted traffic can route to it from the next placement on.
+        Emits the replica's ``replica_warm`` event so the scale-out's
+        warm provenance (cold compile vs snapshot hydration) is in the
+        event stream. The replica must be warmed BEFORE it joins: an
+        un-warmed replica would take affinity assignments straight
+        into cold compiles — the stall this tier exists to prevent."""
+        t0 = self._clock()
+        # Duplicate guard FIRST: attaching/starting before it would
+        # clobber the pooled replica's live server (stranding its
+        # queued futures) and leak a running worker thread.
+        with self._lock:
+            if any(
+                r.replica_id == replica.replica_id for r in self.replicas
+            ):
+                raise ValueError(
+                    f"replica {replica.replica_id} is already in the pool"
+                )
+        replica.attach_server(
+            InferenceServer(
+                replica.engine,
+                replica=replica.replica_id,
+                **self._server_kwargs,
+            )
+        )
+        replica.server.start()
+        with self._lock:
+            if any(
+                r.replica_id == replica.replica_id for r in self.replicas
+            ):
+                # Racing add of the same id slipped between the checks:
+                # shut our server down before refusing.
+                replica.server.drain(timeout_s=0.0)
+                raise ValueError(
+                    f"replica {replica.replica_id} is already in the pool"
+                )
+            self.replicas.append(replica)
+        self._note_warm(replica, None)
+        return replica
+
+    def _note_warm(self, r: EngineReplica, t0: float | None) -> None:
+        """One replica's warm provenance into the event stream + trace:
+        a ``replica_warm`` event with the replica's warm_stats, and a
+        span on the aux ("r") stream whose ``source`` arg says snapshot
+        (prewarmed) vs compile (cold) — the warm-vs-cold latency is
+        readable straight off the trace timeline."""
+        stats = r.warm_stats or {
+            "source": "none", "programs": 0, "seconds": 0.0,
+            "hits": None, "misses": None,
+        }
+        self._event(
+            events.REPLICA_WARM,
+            replica=r.replica_id,
+            source=stats["source"],
+            programs=stats["programs"],
+            seconds=stats["seconds"],
+            hits=stats.get("hits"),
+            misses=stats.get("misses"),
+            # Why a replica did NOT hydrate (params_mismatch /
+            # no_manifest_block) — the difference between "warm pool"
+            # and "silently cold pool" in the event stream.
+            **(
+                {"reason": stats["reason"]} if stats.get("reason") else {}
+            ),
+        )
+        if self._tracer is not None:
+            trace = self._tracer.start_trace(stream="r")
+            if trace is not None:
+                # add_replica warms BEFORE joining the pool (t0=None):
+                # anchor the span at now - warm duration so its length
+                # still reads as the warm cost on the timeline.
+                now = self._clock()
+                start = t0 if t0 is not None else now - stats["seconds"]
+                self._tracer.add_span(
+                    "replica_warm",
+                    start,
+                    now,
+                    trace=trace,
+                    args={
+                        "replica": r.replica_id,
+                        "source": stats["source"],
+                        "programs": stats["programs"],
+                    },
+                )
 
     # -- placement ---------------------------------------------------------
 
@@ -205,13 +323,14 @@ class ReplicaRouter:
         same cold bucket cannot both take the cold_assign path and pin
         it to two replicas; full targets spill."""
         now = self._clock()
-        healthy = [r for r in self.replicas if self._assess(r, now).healthy]
+        replicas = self._pool()
+        healthy = [r for r in replicas if self._assess(r, now).healthy]
         pool = healthy
         degraded = not pool
         if degraded:
             # Nobody healthy: still place (least-loaded) — the chosen
             # replica's own breaker/admission answers with its reason.
-            pool = self.replicas
+            pool = replicas
         with self._lock:
             if self.route_policy == "round_robin" and not degraded:
                 idx = self._rr_next % len(pool)
@@ -229,7 +348,7 @@ class ReplicaRouter:
             # filtered pool: a bucket whose warm replica is temporarily
             # drained (warming/breaker) is a SPILL — the duplicated
             # compile the ledger must count — not a fresh cold bucket.
-            assigned = any(r.has_bucket(key) for r in self.replicas)
+            assigned = any(r.has_bucket(key) for r in replicas)
             if open_pool:
                 target = min(open_pool, key=self._load)
                 if assigned:
@@ -315,7 +434,8 @@ class ReplicaRouter:
                 self._rollouts += 1
                 rollout = self._rollouts
             ok_n = 0
-            for step, r in enumerate(self.replicas, 1):
+            rollout_pool = self._pool()
+            for step, r in enumerate(rollout_pool, 1):
                 r.set_warming(True)
                 self._assess(r, self._clock())  # emit the warming edge
                 try:
@@ -329,7 +449,7 @@ class ReplicaRouter:
                     replica=r.replica_id,
                     ok=ok,
                     step=step,
-                    n_replicas=len(self.replicas),
+                    n_replicas=len(rollout_pool),
                     rollout=rollout,
                 )
             return ok_n
@@ -348,19 +468,20 @@ class ReplicaRouter:
         # drains are independent — each touches only its own server.
         per: dict[int, dict] = {}
         lat: list[float] = []
+        pool = self._pool()
 
         def _drain_one(r):
             per[r.replica_id] = r.server.drain(timeout_s)
 
         threads = [
             threading.Thread(target=_drain_one, args=(r,), daemon=True)
-            for r in self.replicas
+            for r in pool
         ]
         for t in threads:
             t.start()
         for t in threads:
             t.join()
-        for r in self.replicas:
+        for r in pool:
             # AFTER the drains: a drain flushes queued requests, whose
             # latencies must be in the pool percentiles too.
             lat.extend(r.server.latencies_ms())
@@ -368,7 +489,28 @@ class ReplicaRouter:
         for s in per.values():
             for reason, n in s["shed"].items():
                 shed[reason] = shed.get(reason, 0) + n
+        # Pool-level packing efficiency: merge the per-replica
+        # pad-waste rollups by bucket (sum the token counters,
+        # recompute the fractions) so the packed A/B reads ONE number
+        # off the pool summary, replicated or not.
+        pad_waste: dict[str, dict] = {}
+        for s in per.values():
+            for key, st in (s.get("pad_waste_by_bucket") or {}).items():
+                agg = pad_waste.setdefault(
+                    key,
+                    {"dispatches": 0, "real_tokens": 0,
+                     "capacity_tokens": 0},
+                )
+                for k in agg:
+                    agg[k] += st[k]
+        for st in pad_waste.values():
+            cap = st["capacity_tokens"]
+            st["fill_frac"] = st["real_tokens"] / cap if cap else None
+            st["pad_waste_frac"] = (
+                1.0 - st["real_tokens"] / cap if cap else None
+            )
         arr = np.asarray(lat, dtype=np.float64)
+        warm_by_id = {r.replica_id: r.warm_stats for r in pool}
         with self._lock:
             routed = dict(self._routed)
             spills = self._spills
@@ -393,6 +535,11 @@ class ReplicaRouter:
             "latency_p99_ms": (
                 float(np.percentile(arr, 99)) if arr.size else None
             ),
+            **(
+                {"pad_waste_by_bucket": dict(sorted(pad_waste.items()))}
+                if pad_waste
+                else {}
+            ),
             "per_replica": {
                 str(rid): {
                     "requests": s["requests"],
@@ -405,12 +552,16 @@ class ReplicaRouter:
                     "latency_p50_ms": s["latency_p50_ms"],
                     "latency_p99_ms": s["latency_p99_ms"],
                     "routed": routed.get(rid, 0),
+                    # Warm provenance (serve/aot.py): how this replica
+                    # became serve-ready — cold compiles vs snapshot
+                    # hydration, with the cache hit/miss breakdown.
+                    "warmup_cache": warm_by_id.get(rid),
                 }
                 for rid, s in sorted(per.items())
             },
             "routing": {
                 "policy": self.route_policy,
-                "replicas": len(self.replicas),
+                "replicas": len(pool),
                 # Router-level submit count: equals the sum of the
                 # per-replica `requests` unless callers also submitted
                 # to replica servers directly.

@@ -671,6 +671,7 @@ class Trainer:
         self._tail_eval_step = None
         self.state: TrainState | None = None
         self._forward = None  # jitted inference fn, built on first predict()
+        self._forward_builder = None  # fresh-jit factory (serve/aot.py)
         self._engine = None  # serve.InferenceEngine, built on first predict()
         self.best_metric = float("inf")
         self.start_epoch = 0
@@ -1007,11 +1008,19 @@ class Trainer:
                 # are needed (single-process mesh).
                 from jax.sharding import NamedSharding, PartitionSpec
 
-                self._forward = jax.jit(
-                    fwd, out_shardings=NamedSharding(self.mesh, PartitionSpec())
+                from gnot_tpu.serve.engine import rename_forward
+
+                replicated = NamedSharding(self.mesh, PartitionSpec())
+                self._forward_builder = lambda tag=None: jax.jit(
+                    rename_forward(fwd, tag), out_shardings=replicated
                 )
             else:
-                self._forward = jax.jit(fwd)
+                from gnot_tpu.serve.engine import rename_forward
+
+                self._forward_builder = lambda tag=None: jax.jit(
+                    rename_forward(fwd, tag)
+                )
+            self._forward = self._forward_builder()
         if self._engine is None:
             from gnot_tpu.serve.engine import InferenceEngine
 
@@ -1023,6 +1032,7 @@ class Trainer:
                 pad_nodes=self.train_loader.pad_nodes,
                 pad_funcs=self.train_loader.pad_funcs,
                 forward=self._forward,
+                forward_builder=self._forward_builder,
                 device_put=self._device_batch,
                 group_pad=self.mesh is not None,
                 n_proc=jax.process_count(),

@@ -54,10 +54,126 @@ def enable_compile_cache(path: str | None = None) -> str:
             if existing:
                 return existing
             path = default_cache_dir()
+    previous = getattr(jax.config, "jax_compilation_cache_dir", None)
     jax.config.update("jax_compilation_cache_dir", path)
     # Cache everything that took meaningful compile time.
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    if previous and previous != path:
+        # jax binds its cache object to the dir on FIRST use and then
+        # ignores config changes — without a reset, a mid-process dir
+        # switch (the cold-start A/B's per-arm caches, the scratch-dir
+        # tests) keeps writing to the old path while the probe reports
+        # the new one.
+        _reset_cache_binding()
     return path
+
+
+def _reset_cache_binding() -> None:
+    """Drop jax's dir-bound cache object so the next compile rebinds
+    to the configured ``jax_compilation_cache_dir``. Private surface:
+    degrades to 'config updated, old binding kept' if it moves."""
+    try:
+        from jax._src import compilation_cache
+
+        compilation_cache.reset_cache()
+    except Exception:  # pragma: no cover — private API drift
+        pass
+
+
+@contextlib.contextmanager
+def compile_cache_disabled():
+    """Temporarily disable the persistent compile cache (reads and
+    writes). The AOT snapshot path needs genuinely FRESH executables:
+    on CPU jaxlib 0.4.x an executable loaded from a persistent-cache
+    hit re-serializes without its jitted kernel symbols, producing a
+    snapshot that fails to deserialize ("Symbols not found") — see
+    serve/aot.py::aot_compile, which validates every snapshot and
+    recompiles under this context when the cache-integrated compile
+    produced an unserializable executable."""
+    import jax
+
+    previous = getattr(jax.config, "jax_compilation_cache_dir", None)
+    if not previous:
+        yield
+        return
+    jax.config.update("jax_compilation_cache_dir", None)
+    _reset_cache_binding()
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", previous)
+        _reset_cache_binding()
+
+
+def cache_dir_manifest(path: str | None = None) -> dict:
+    """Size/occupancy snapshot of the persistent compile cache — the
+    deploy-time artifact ``tools/aot_prewarm.py`` records alongside its
+    program table. ``path=None`` reads the configured
+    ``jax_compilation_cache_dir``. Returns ``{"dir", "entries",
+    "bytes"}`` with Nones when the dir is unset/absent/unreadable (a
+    corrupt or missing cache dir is a cold start, not a crash)."""
+    if path is None:
+        import jax
+
+        path = getattr(jax.config, "jax_compilation_cache_dir", None)
+    out = {"dir": path, "entries": None, "bytes": None}
+    if not path or not os.path.isdir(path):
+        return out
+    try:
+        entries = [de for de in os.scandir(path) if de.is_file()]
+        out["entries"] = len(entries)
+        out["bytes"] = sum(de.stat().st_size for de in entries)
+    except OSError:
+        pass
+    return out
+
+
+def warm_cache(thunks, *, min_compile_secs: float = 0.0) -> dict:
+    """Run a sequence of ``(key, thunk)`` compile thunks under ONE
+    probe with the persistent-cache admission threshold lowered to
+    ``min_compile_secs`` — the deploy-time AOT pipeline (serve/aot.py).
+
+    The default threshold (0.5 s, ``enable_compile_cache``) keeps tiny
+    throwaway programs out of the on-disk cache; a deploy-time prewarm
+    wants EVERY serving program persisted — a bucket program that
+    compiles in 0.4 s still sheds a whole queue when it lands under a
+    200 ms deadline. The old threshold is restored afterwards.
+
+    Returns ``{"programs": [{"key", "seconds"}...], "seconds",
+    "requests", "hits", "misses", "dir", "entries_before",
+    "entries_after"}`` (the probe fields have None degradation
+    semantics — see ``compile_cache_probe``)."""
+    import time
+
+    import jax
+
+    old = getattr(
+        jax.config, "jax_persistent_cache_min_compile_time_secs", None
+    )
+    programs = []
+    try:
+        if old is not None:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs",
+                min_compile_secs,
+            )
+        with compile_cache_probe() as stats:
+            for key, thunk in thunks:
+                t0 = time.monotonic()
+                thunk()
+                programs.append(
+                    {"key": key, "seconds": time.monotonic() - t0}
+                )
+    finally:
+        if old is not None:
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", old
+            )
+    return {
+        "programs": programs,
+        "seconds": sum(p["seconds"] for p in programs),
+        **stats,
+    }
 
 
 @contextlib.contextmanager

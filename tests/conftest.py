@@ -26,13 +26,49 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-# Persistent compile cache: the suite is dominated by XLA compiles of
-# near-identical tiny programs; re-runs hit the cache instead. Shared
-# per-user location with the CLI (gnot_tpu/utils/cache.py), so tests
-# and CLI runs warm each other. GNOT_COMPILE_CACHE (alias:
-# GNOT_TEST_CACHE) overrides the path; "off" or empty gives
-# clean-compile runs — honored inside enable_compile_cache, so tests
-# that call main() in-process can't silently re-enable the cache.
-from gnot_tpu.utils.cache import enable_compile_cache
+# Session-scoped persistent compile cache for tier-1 (ISSUE 10
+# satellite): the suite is dominated by XLA compiles of near-identical
+# tiny programs — the compile-bound sharding/pipeline tests pay
+# 8-12 s each when cold. The cache lives at a STABLE /tmp path so
+# every tier-1 run (and every worker of one) shares the same warm
+# entries: populated once, hit thereafter. First use seeds it from the
+# per-user CLI cache (gnot_tpu/utils/cache.py default) via hardlinks
+# when one exists, so tests and CLI runs keep warming each other.
+# GNOT_COMPILE_CACHE (alias: GNOT_TEST_CACHE) still overrides the
+# path; "off" or empty gives clean-compile runs — honored inside
+# enable_compile_cache, so tests that call main() in-process can't
+# silently re-enable the cache.
+from gnot_tpu.utils.cache import default_cache_dir, enable_compile_cache
 
+
+def _tier1_cache_dir() -> str:
+    path = os.path.join(
+        "/tmp" if os.path.isdir("/tmp") else os.path.expanduser("~"),
+        f"gnot_tier1_cache_{os.getuid()}",
+    )
+    try:
+        os.makedirs(path, exist_ok=True)
+        st = os.stat(path)
+        if st.st_uid != os.getuid() or (st.st_mode & 0o022):
+            # A pre-created dir we don't exclusively own would mean
+            # deserializing executables another user could write (the
+            # utils/cache.py hazard); fall back to the per-user cache.
+            return ""
+        user_cache = default_cache_dir()
+        if not os.listdir(path) and os.path.isdir(user_cache):
+            for de in os.scandir(user_cache):
+                if de.is_file():
+                    try:
+                        os.link(de.path, os.path.join(path, de.name))
+                    except OSError:
+                        pass  # cross-device or racing writer: seed less
+    except OSError:
+        return ""  # unusable /tmp: fall through to the default resolution
+    return path
+
+
+if not (os.environ.get("GNOT_COMPILE_CACHE") or os.environ.get("GNOT_TEST_CACHE")):
+    seeded = _tier1_cache_dir()
+    if seeded:
+        os.environ["GNOT_COMPILE_CACHE"] = seeded
 enable_compile_cache()

@@ -147,6 +147,49 @@ def test_serve_bench_artifact_schema():
     assert summary["max_abs_diff"] <= summary["bar_numeric"] == 1e-5
 
 
+def test_coldstart_ab_artifact_schema():
+    """The committed cold-start A/B (tools/coldstart_ab.py): scale-out
+    1->N under open-loop overload, cold compiles vs deploy-time AOT
+    prewarm — the ISSUE 10 acceptance bar: prewarmed replica
+    time-to-first-served >= 5x faster than cold, ZERO requests shed
+    during the prewarmed scale-out (the cold arm sheds for the whole
+    compile window), every scale-out probe served ok."""
+    path = os.path.join(ARTIFACT_DIR, "coldstart_ab.jsonl")
+    with open(path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    (summary,) = [r for r in recs if r.get("summary") == "coldstart_ab"]
+    assert summary["quick"] is False
+    assert summary["replicas_from"] == 1 and summary["replicas_to"] >= 4
+    (deploy,) = [r for r in recs if r.get("arm") == "deploy"]
+    assert deploy["programs"] > 0 and deploy["snapshot_bytes"] > 0
+    per = [r for r in recs if "replica" in r and "ttfs_s" in r]
+    by_arm: dict = {}
+    for r in per:
+        assert r["probe_ok"] is True
+        assert r["ttfs_s"] > 0
+        by_arm.setdefault(r["arm"], []).append(r)
+    n_new = summary["replicas_to"] - 1
+    assert len(by_arm["cold"]) == len(by_arm["prewarmed"]) == n_new
+    assert all(r["warm_source"] == "compile" for r in by_arm["cold"])
+    assert all(r["warm_source"] == "snapshot" for r in by_arm["prewarmed"])
+    arms = {r["arm"]: r for r in recs if r.get("arm") in ("cold", "prewarmed")
+            and "submitted" in r}
+    assert set(arms) == {"cold", "prewarmed"}
+    for r in arms.values():
+        # Both arms ran the SAME calibrated offered load, and every
+        # submitted request resolved one way or the other.
+        assert r["offered_rps"] == summary["offered_rps"] > 0
+        assert r["completed"] + r["shed_total"] == r["submitted"]
+    # The acceptance bars.
+    assert summary["speedup"] == pytest.approx(
+        summary["ttfs_cold_s"] / summary["ttfs_prewarmed_s"], rel=1e-2
+    )
+    assert summary["speedup"] >= summary["bar_speedup"] == 5.0
+    assert summary["shed_prewarmed"] == 0
+    # The cold arm's compile window genuinely overloaded the pool.
+    assert summary["shed_cold"] > 0
+
+
 def test_serve_trace_example_is_complete_chrome_trace():
     """The committed example trace (docs/observability.md "Reading a
     trace"): a real serve-smoke run whose completed requests each carry

@@ -178,6 +178,11 @@ def test_empty_test_set_trains_without_nan():
     assert best == float("inf")  # no eval, but training completed
 
 
+@pytest.mark.slow  # wall-clock timing comparison: the ISSUE 6 median
+# deflake narrowed but could not close the flake window on loaded CI
+# boxes (host scheduling can still starve one arm's 3-sample median),
+# so the comparison runs outside tier-1 where a loaded box can't turn
+# scheduler noise into a red gate (ISSUE 10 satellite).
 def test_bench_scan_marginal_matches_persstep_on_cpu():
     """The bench's scan_marginal estimator (two K-step scanned windows,
     marginal difference) must agree with the per-step dispatch loop on a

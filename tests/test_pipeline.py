@@ -91,7 +91,12 @@ def test_pipelined_step_matches_single_device(mesh_cfg, n_layers, micro):
     optim = OptimConfig()
     batch = make_batch()
     state = init_state(model, optim, batch, seed=0)
-    host_params = jax.device_get(state.params)
+    # Copied BY VALUE (np.array), not the zero-copy device_get view: the
+    # donating single-device step below would otherwise write its
+    # updated params straight into this "initial" snapshot, so the
+    # pipelined arm would start one optimizer step ahead (the round-6/7
+    # use-after-donate playbook; docs/parallelism.md parity-debt ledger).
+    host_params = jax.tree.map(np.array, jax.device_get(state.params))
     lr = jnp.asarray(1e-3, jnp.float32)
 
     single = make_train_step(model, optim, "rel_l2")
@@ -370,7 +375,11 @@ def test_convert_state_layout_roundtrip_resumes_training():
     s_ref = init_state(model, optim, batch, seed=0)
     single = make_train_step(model, optim, "rel_l2")
     s_ref, _ = single(s_ref, batch, lr)
-    s_mid = jax.device_get(s_ref)  # post-step state, nonzero moments
+    # Post-step state with nonzero moments, copied BY VALUE: the second
+    # donating step below would otherwise write the step-2 state into
+    # this device_get view (the round-6/7 use-after-donate playbook),
+    # and the stacked continuation would start from the wrong state.
+    s_mid = jax.tree.map(np.array, jax.device_get(s_ref))
     s_ref, _ = single(s_ref, batch, lr)
 
     # Round-trip identity on the mid-training state.

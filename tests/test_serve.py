@@ -1167,3 +1167,264 @@ def test_packed_server_deadline_shed_repack(setup, tmp_path):
     for s, r in zip(small, results):
         if r.ok:
             assert r.output.shape[0] == s.coords.shape[0]
+
+
+# --- deploy-time AOT prewarm + warm-replica snapshots (ISSUE 10) ----------
+
+
+def _prewarm_manifest(setup, tmp_path, n=2, traffic=None):
+    """Deploy-time pass for an n-replica topology: AOT-compile +
+    snapshot the program family, return (manifest, traffic)."""
+    from gnot_tpu.serve import aot
+
+    if traffic is None:
+        import serve_smoke
+
+        traffic = serve_smoke.mixed_traffic(8)
+    deploy = _make_replicas(setup, n)
+    manifest = aot.prewarm_deployment(
+        [(r.replica_id, r.engine) for r in deploy],
+        traffic,
+        rows=MAX_BATCH,
+        snapshot_dir=str(tmp_path / "snap"),
+    )
+    return manifest, traffic
+
+
+def test_aot_manifest_roundtrip_and_params_guard(setup, tmp_path):
+    """The deploy manifest round-trips through disk (version-checked),
+    and a snapshot compiled for a DIFFERENT param structure refuses to
+    hydrate — the engine stays on the (correct, cold) jit path instead
+    of feeding a foreign executable a mismatched tree mid-traffic."""
+    from gnot_tpu.serve import aot
+
+    model, params, samples, _ = setup
+    manifest, traffic = _prewarm_manifest(setup, tmp_path, n=1)
+    path = str(tmp_path / "manifest.json")
+    aot.save_manifest(path, manifest)
+    loaded = aot.load_manifest(path)
+    assert loaded["program_keys"] == manifest["program_keys"]
+    assert loaded["per_replica"]["0"]["params_sig"]
+    # Unknown schema versions are rejected loudly.
+    bad = dict(loaded, version=99)
+    aot.save_manifest(str(tmp_path / "bad.json"), bad)
+    with pytest.raises(ValueError, match="version"):
+        aot.load_manifest(str(tmp_path / "bad.json"))
+    # A params-structure mismatch skips every snapshot.
+    (twin,) = _make_replicas(setup, 1)
+    block = loaded["per_replica"]["0"]
+    stats = aot.hydrate(
+        twin.engine, block["programs"], loaded["snapshot_dir"],
+        params_sig="definitely-not-this-model",
+    )
+    assert stats == {
+        "installed": 0,
+        "skipped": len(block["programs"]),
+        "seconds": stats["seconds"],
+        "keys": [],
+        "reason": "params_mismatch",
+    }
+    # A mismatch surfaced through a replica's warm_stats carries the
+    # reason (the router/CLI print it instead of silently serving cold).
+    (guarded,) = _make_replicas(setup, 1)
+    doctored = dict(loaded)
+    doctored["per_replica"] = {
+        "0": {**block, "params_sig": "some-other-model"}
+    }
+    ws = guarded.prewarm_from(doctored)
+    assert ws["reason"] == "params_mismatch" and ws["programs"] == 0
+    assert ws["source"] == "none"  # a refused hydration is NOT "snapshot"
+    # Scale-out past the manifest's topology: degrade-to-cold, no crash.
+    nb = guarded.prewarm_from({"per_replica": {}, "snapshot_dir": "/x"})
+    assert nb["reason"] == "no_manifest_block" and nb["source"] == "none"
+    # The honest signature hydrates everything.
+    ok = aot.hydrate(
+        twin.engine, block["programs"], loaded["snapshot_dir"],
+        params_sig=block["params_sig"],
+    )
+    assert ok["installed"] == len(block["programs"]) and ok["skipped"] == 0
+    assert twin.engine.aot_programs == len(block["programs"])
+
+
+def test_router_prewarm_first_request_never_compiles(setup, tmp_path):
+    """ISSUE 10 acceptance: a prewarmed replica's first request never
+    waits on a compile — hydration + the whole first-request path make
+    ZERO compile-cache consultations and zero jit dispatches — and its
+    time-to-ready beats a cold twin warming the same program family
+    against an empty cache."""
+    import serve_smoke
+
+    from gnot_tpu.serve import ReplicaRouter
+    from gnot_tpu.utils.cache import compile_cache_probe, enable_compile_cache
+
+    manifest, traffic = _prewarm_manifest(setup, tmp_path, n=2)
+    replicas = _make_replicas(setup, 2)
+    sink = MetricsSink(str(tmp_path / "serve.jsonl"))
+    router = ReplicaRouter(
+        replicas, max_batch=MAX_BATCH, max_wait_ms=2.0, sink=sink
+    )
+    with compile_cache_probe() as probe:
+        t0 = time.perf_counter()
+        stats = router.prewarm_from(manifest)
+        prewarm_s = time.perf_counter() - t0
+        router.start()
+        futs = [router.submit(s) for s in traffic]
+        results = [f.result(timeout=60) for f in futs]
+    summary = router.drain()
+    sink.close()
+    assert all(r.ok for r in results)
+    # Zero cache misses — in fact zero cache REQUESTS: snapshots never
+    # reach the compile path at all.
+    assert probe["requests"] == 0 and probe["misses"] == 0
+    for r in replicas:
+        assert r.engine.dispatch_counts["jit"] == 0
+        assert r.warm_stats["source"] == "snapshot"
+        assert r.warm_stats["misses"] == 0 and not r.warm_stats["skipped"]
+    assert sum(r.engine.dispatch_counts["aot"] for r in replicas) > 0
+    assert set(stats) == {0, 1}
+    # Event stream: one replica_warm per replica, snapshot provenance,
+    # and the per-replica serve_summary rollup carries warmup_cache.
+    warms = [
+        e for e in _read_all(str(tmp_path / "serve.jsonl"))
+        if e.get("event") == "replica_warm"
+    ]
+    assert {e["replica"] for e in warms} == {0, 1}
+    assert all(e["source"] == "snapshot" and e["misses"] == 0 for e in warms)
+    for rid in ("0", "1"):
+        assert summary["per_replica"][rid]["warmup_cache"]["source"] == "snapshot"
+    # Bounded time-to-ready vs a cold twin: the cold arm traces AND
+    # compiles every program against an empty cache; hydration does
+    # neither.
+    before = getattr(jax.config, "jax_compilation_cache_dir", None)
+    try:
+        enable_compile_cache(str(tmp_path / "cold_cache"))
+        (cold,) = _make_replicas(setup, 1)
+        t0 = time.perf_counter()
+        cold.warm(traffic, rows=MAX_BATCH)
+        cold_s = time.perf_counter() - t0
+    finally:
+        if before:
+            enable_compile_cache(before)
+    assert cold.warm_stats["source"] == "compile"
+    assert cold.warm_stats["misses"] > 0
+    assert prewarm_s < cold_s, (prewarm_s, cold_s)
+
+
+def test_rolling_reload_of_prewarmed_pool_sheds_nothing(setup, tmp_path):
+    """Rolling hot-reload across a PREWARMED pool under a live submit
+    storm: zero requests shed, the swapped params keep dispatching
+    through the hydrated AOT executables (the re-placed tree has the
+    same structure/sharding, so no jit fallback and no recompile)."""
+    from gnot_tpu.serve import ReplicaRouter
+
+    model, params, samples, _ = setup
+    manifest, traffic = _prewarm_manifest(setup, tmp_path, n=2)
+    replicas = _make_replicas(setup, 2)
+    host_params = jax.tree.map(np.array, jax.device_get(params))
+    reloads = []
+
+    def reload_fn(deadline_ms=None):
+        reloads.append(1)
+        return host_params, {"epoch": len(reloads)}
+
+    sink = MetricsSink(str(tmp_path / "serve.jsonl"))
+    router = ReplicaRouter(
+        replicas, max_batch=MAX_BATCH, max_wait_ms=2.0, sink=sink,
+        reload_fn=reload_fn,
+    )
+    router.prewarm_from(manifest)
+    router.start()
+    futs = [router.submit(s) for s in traffic]
+    assert router.reload() == 2  # both replicas swapped mid-storm
+    futs += [router.submit(s) for s in traffic]
+    results = [f.result(timeout=60) for f in futs]
+    summary = router.drain()
+    sink.close()
+    assert all(r.ok for r in results)
+    assert summary["shed"] == {}
+    assert summary["reloads"] == 2
+    for r in replicas:
+        # Post-reload dispatches still ride the snapshot executables.
+        assert r.engine.dispatch_counts["jit"] == 0
+    events = _read_all(str(tmp_path / "serve.jsonl"))
+    steps = [e for e in events if e.get("event") == "rolling_reload"]
+    assert [e["ok"] for e in steps] == [True, True]
+
+
+def test_router_add_replica_scale_out(setup, tmp_path):
+    """Live scale-out: a snapshot-hydrated replica joins a serving
+    pool via add_replica and takes traffic — no shed, a replica_warm
+    event with snapshot provenance, and both replicas in the rollup."""
+    from gnot_tpu.serve import ReplicaRouter, build_replica
+
+    model, params, samples, _ = setup
+    manifest, traffic = _prewarm_manifest(setup, tmp_path, n=2)
+    (r0,) = _make_replicas(setup, 1)
+    sink = MetricsSink(str(tmp_path / "serve.jsonl"))
+    router = ReplicaRouter(
+        [r0], max_batch=MAX_BATCH, max_wait_ms=2.0, sink=sink
+    )
+    router.prewarm_from(manifest)
+    router.start()
+    futs = [router.submit(s) for s in traffic]
+    r1 = build_replica(
+        model, params, 1, jax.devices()[1:2], batch_size=MAX_BATCH
+    )
+    r1.prewarm_from(manifest)
+    router.add_replica(r1)
+    with pytest.raises(ValueError, match="already in the pool"):
+        router.add_replica(r1)
+    probe = r1.server.submit(traffic[0])
+    assert probe.result(timeout=60).ok
+    futs += [router.submit(s) for s in traffic]
+    results = [f.result(timeout=60) for f in futs]
+    summary = router.drain()
+    sink.close()
+    assert all(r.ok for r in results)
+    assert summary["shed"] == {}
+    assert set(summary["per_replica"]) == {"0", "1"}
+    assert summary["per_replica"]["1"]["warmup_cache"]["source"] == "snapshot"
+    events = _read_all(str(tmp_path / "serve.jsonl"))
+    warms = [e for e in events if e.get("event") == "replica_warm"]
+    assert {e["replica"] for e in warms} == {0, 1}
+    routed_to_new = [
+        e for e in events
+        if e.get("event") == "route" and e["replica"] == 1
+    ]
+    assert routed_to_new, "scale-out replica never took routed traffic"
+
+
+def test_serve_smoke_tool_prewarm(tmp_path):
+    """Tier-1 wiring of serve_smoke --prewarm (ISSUE 10 CI criterion):
+    the mixed-bucket storm over a snapshot-hydrated replica pool passes
+    with ZERO per-replica compiles — the smoke asserts zero
+    compile-cache consultations and zero jit-fallback dispatches."""
+    import serve_smoke
+
+    summary = serve_smoke.run(
+        [
+            "--n", "10", "--replicas", "2", "--prewarm",
+            "--inject_fault", "slow_request@3",
+            "--metrics_path", str(tmp_path / "smoke.jsonl"),
+        ]
+    )
+    assert summary["failures"] == []
+    assert summary["shed"].get("shed_deadline", 0) >= 1
+
+
+@pytest.mark.slow
+def test_coldstart_ab_quick_smoke(tmp_path):
+    """tools/coldstart_ab.py --quick end-to-end (in-process: structure
+    and bookkeeping, not the committed artifact's 5x bar, which
+    test_artifacts pins): both arms scale out 1->2, the prewarmed arm
+    sheds nothing, and the speedup is positive."""
+    import coldstart_ab
+
+    out = str(tmp_path / "ab.jsonl")
+    summary = coldstart_ab.run(["--quick", "--out", out])
+    assert summary["failures"] == []
+    recs = [json.loads(l) for l in open(out) if l.strip()]
+    arms = {r["arm"] for r in recs if "arm" in r}
+    assert arms == {"deploy", "cold", "prewarmed"}
+    assert summary["shed_prewarmed"] == 0
+    assert summary["speedup"] > 1.0

@@ -61,6 +61,120 @@ def test_annotate_outside_trace_is_harmless():
     assert x == 1.0
 
 
+@pytest.fixture
+def scratch_cache(tmp_path):
+    """Point the persistent compile cache at a fresh dir for one test,
+    restoring the session cache afterwards (the suite's warm /tmp
+    cache must not absorb or lose entries through these tests)."""
+    import jax
+
+    from gnot_tpu.utils.cache import enable_compile_cache
+
+    before = getattr(jax.config, "jax_compilation_cache_dir", None)
+    path = str(tmp_path / "cache")
+    enable_compile_cache(path)
+    try:
+        yield path
+    finally:
+        if before:
+            enable_compile_cache(before)
+
+
+def test_warm_cache_miss_then_hit(scratch_cache):
+    """warm_cache: a fresh dir misses (and persists) every program; a
+    second pass over FRESH jit objects of the same programs hits the
+    on-disk entries — the deploy-time AOT prewarm contract."""
+    import jax
+    import jax.numpy as jnp
+
+    from gnot_tpu.utils.cache import warm_cache
+
+    def thunks():
+        # Fresh jit objects each call: the second pass must hit the
+        # PERSISTENT cache, not the in-process dispatch cache.
+        f = jax.jit(lambda x: jnp.sin(x) @ x.T + 2.0)
+        g = jax.jit(lambda x: jnp.cos(x).sum(0) * 3.0)
+        x = jnp.ones((32, 32))
+        return [
+            ("f", lambda: f.lower(x).compile()),
+            ("g", lambda: g.lower(x).compile()),
+        ]
+
+    cold = warm_cache(thunks())
+    assert [p["key"] for p in cold["programs"]] == ["f", "g"]
+    assert all(p["seconds"] > 0 for p in cold["programs"])
+    assert cold["misses"] == 2 and cold["hits"] == 0
+    # min_compile_time was dropped to 0 inside warm_cache, so even
+    # these trivial programs persisted...
+    assert cold["entries_after"] >= 2
+    # ...and the old threshold is restored afterwards.
+    assert jax.config.jax_persistent_cache_min_compile_time_secs == 0.5
+    warm = warm_cache(thunks())
+    assert warm["hits"] == 2 and warm["misses"] == 0
+
+
+def test_warm_cache_corrupt_entries_degrade_to_recompile(scratch_cache):
+    """Corrupt on-disk cache entries are a MISS (jax warns and
+    recompiles), never a crash — a mangled cache dir costs cold-start
+    time, not serving correctness."""
+    import os as _os
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+
+    from gnot_tpu.utils.cache import warm_cache
+
+    def thunks():
+        f = jax.jit(lambda x: jnp.tanh(x) @ x + 1.0)
+        x = jnp.ones((16, 16))
+        return [("f", lambda: f.lower(x).compile())]
+
+    assert warm_cache(thunks())["misses"] == 1
+    for de in _os.scandir(scratch_cache):
+        with open(de.path, "wb") as fh:
+            fh.write(b"not an executable")
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")  # jax's corrupt-entry warning
+        again = warm_cache(thunks())
+    assert again["misses"] == 1 and again["hits"] == 0
+
+
+def test_compile_cache_probe_missing_dir():
+    """Probe on an unset/absent cache dir: entry counts degrade to
+    None, the hit/miss counters still work."""
+    import jax
+
+    from gnot_tpu.utils.cache import compile_cache_probe
+
+    before = getattr(jax.config, "jax_compilation_cache_dir", None)
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir", "/nonexistent/gnot-cache-dir"
+        )
+        with compile_cache_probe() as stats:
+            pass
+        assert stats["entries_before"] is None
+        assert stats["entries_after"] is None
+        assert stats["requests"] == 0 and stats["misses"] == 0
+    finally:
+        if before:
+            jax.config.update("jax_compilation_cache_dir", before)
+
+
+def test_cache_dir_manifest(scratch_cache, tmp_path):
+    """cache_dir_manifest: occupancy of a real dir; Nones for a
+    missing one (a corrupt/absent cache is a cold start, not a crash)."""
+    from gnot_tpu.utils.cache import cache_dir_manifest
+
+    (tmp_path / "cache").mkdir(exist_ok=True)
+    (tmp_path / "cache" / "entry").write_bytes(b"x" * 64)
+    m = cache_dir_manifest(str(tmp_path / "cache"))
+    assert m["entries"] == 1 and m["bytes"] == 64
+    missing = cache_dir_manifest(str(tmp_path / "nope"))
+    assert missing["entries"] is None and missing["bytes"] is None
+
+
 def test_eval_only_roundtrip(tmp_path):
     """Train 2 epochs with checkpointing, then eval-only from the best
     checkpoint reproduces the best metric."""

@@ -1,0 +1,162 @@
+"""Deploy-time AOT prewarm CLI: compile the whole serving program
+family before any replica serves.
+
+Enumerates every bucket and ``PackPlan`` program the given traffic
+shape needs (``serve/aot.py``), ``jit(...).lower().compile()``s each of
+them for EVERY replica slice of the target topology into the
+persistent compile cache, serializes the executables as warm-replica
+snapshots, and writes the deploy manifest — program keys, per-program
+compile seconds, snapshot bytes, cache-dir occupancy. A serving
+process (or a live scale-out) then hydrates replicas from the manifest
+(``ReplicaRouter.prewarm_from`` / ``--serve_prewarm``) and answers its
+first request without a single XLA compile.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/aot_prewarm.py \
+        --replicas 4 --n 16 --snapshot_dir /tmp/snap \
+        --manifest /tmp/snap/manifest.json --metrics_path /tmp/aot.jsonl
+
+With ``--metrics_path`` the run also emits the ``aot_prewarm`` event
+and a ``run.json`` manifest whose ``aot_prewarm`` block carries the
+compile/cache stats (docs/serving.md "Deploy-time prewarm").
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(argv=None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument(
+        "--replicas", type=int, default=1,
+        help="target serving topology: programs are compiled (and "
+             "snapshotted) per replica slice — XLA executables are "
+             "device-bound, so the manifest must match the topology "
+             "the deployment will serve"
+    )
+    p.add_argument("--max_batch", type=int, default=4)
+    p.add_argument(
+        "--n", type=int, default=16,
+        help="representative traffic size (the bucket family is "
+             "derived from it — same generator as serve_smoke)"
+    )
+    p.add_argument("--mesh_lo", type=int, default=300)
+    p.add_argument("--mesh_hi", type=int, default=700)
+    p.add_argument("--packed", action="store_true",
+                   help="also compile the PackPlan program")
+    p.add_argument("--pack_chunk", type=int, default=64)
+    p.add_argument("--snapshot_dir", type=str, required=True)
+    p.add_argument(
+        "--manifest", type=str, default="",
+        help="manifest path (default: <snapshot_dir>/manifest.json)"
+    )
+    p.add_argument("--metrics_path", type=str, default="")
+    args = p.parse_args(argv)
+    manifest_path = args.manifest or os.path.join(
+        args.snapshot_dir, "manifest.json"
+    )
+    if "jax" not in sys.modules:
+        # Standalone CLI on a bare host: virtual CPU devices for the
+        # replica slices, same idiom as serve_bench (a no-op when jax
+        # is already imported — the in-process test path).
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            flags += (
+                " --xla_force_host_platform_device_count="
+                f"{max(8, args.replicas)}"
+            )
+        os.environ["XLA_FLAGS"] = flags.strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+    from gnot_tpu.serve import aot, build_replicas
+    from gnot_tpu.utils.cache import enable_compile_cache
+    from gnot_tpu.utils.metrics import MetricsSink
+    from serve_smoke import build_engine, mixed_traffic
+
+    cache_dir = enable_compile_cache()
+    engine = build_engine(max_batch=args.max_batch)
+    traffic = mixed_traffic(
+        args.n, mesh_lo=args.mesh_lo, mesh_hi=args.mesh_hi
+    )
+    pack_plan = None
+    if args.packed:
+        from gnot_tpu.data.batch import PackPlan
+
+        pack_plan = PackPlan.from_samples(
+            traffic, chunk=args.pack_chunk, batch_size=args.max_batch
+        )
+    if args.replicas > 1:
+        replicas = build_replicas(
+            engine.model, engine.params, args.replicas,
+            batch_size=args.max_batch,
+        )
+        engines = [(r.replica_id, r.engine) for r in replicas]
+    else:
+        engines = [(0, engine)]
+
+    sink = MetricsSink(args.metrics_path) if args.metrics_path else None
+    try:
+        doc = aot.prewarm_deployment(
+            engines,
+            traffic,
+            rows=args.max_batch,
+            pack_plan=pack_plan,
+            snapshot_dir=args.snapshot_dir,
+            manifest_path=manifest_path,
+            sink=sink,
+        )
+        if sink is not None:
+            from gnot_tpu.obs import manifest as manifest_lib
+
+            manifest_lib.write_manifest(
+                manifest_lib.manifest_path_for(args.metrics_path),
+                argv=list(argv) if argv is not None else sys.argv[1:],
+                extra={
+                    "kind": "aot_prewarm",
+                    "aot_prewarm": {
+                        "manifest": manifest_path,
+                        "replicas": doc["replicas"],
+                        "program_keys": doc["program_keys"],
+                        "compile_s": doc["compile_s"],
+                        "snapshot_bytes": doc["snapshot_bytes"],
+                        "cache": doc["cache"],
+                        "cache_dir": doc["cache_dir"],
+                    },
+                },
+            )
+    finally:
+        if sink is not None:
+            sink.close()
+    n_prog = len(doc["program_keys"]) * doc["replicas"]
+    print(
+        f"aot_prewarm: {n_prog} programs "
+        f"({len(doc['program_keys'])} keys x {doc['replicas']} replicas) "
+        f"compiled in {doc['compile_s']:.2f}s, cache {cache_dir} "
+        f"(misses={doc['cache']['misses']}), snapshots "
+        f"{doc['snapshot_bytes']} bytes -> {manifest_path}"
+    )
+    for key in doc["program_keys"]:
+        secs = [
+            p["compile_s"]
+            for b in doc["per_replica"].values()
+            for p in b["programs"]
+            if p["key"] == key
+        ]
+        print(f"  {key}: {min(secs):.3f}-{max(secs):.3f}s per replica")
+    return doc
+
+
+def main(argv=None) -> int:
+    run(argv)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -151,6 +151,16 @@ def run(argv=None) -> dict:
         "--route_policy", type=str, default="affinity",
         choices=["affinity", "least_loaded", "round_robin"],
     )
+    p.add_argument(
+        "--prewarm", action="store_true",
+        help="deploy-time AOT prewarm (serve/aot.py): compile + "
+             "snapshot the whole program family for the target "
+             "topology first, then serve the storm from FRESH "
+             "hydrated engines; the smoke then ALSO asserts the "
+             "prewarmed tier compiled NOTHING — zero compile-cache "
+             "requests and zero jit-fallback dispatches per replica "
+             "during hydration + storm"
+    )
     args = p.parse_args(argv)
     if args.inject_fault == "none":
         args.inject_fault = ""
@@ -176,57 +186,111 @@ def run(argv=None) -> dict:
     traffic = mixed_traffic(args.n, mesh_lo=args.mesh_lo, mesh_hi=args.mesh_hi)
     pack_plan = None
     if args.packed:
+        import jax as _jax
+
         from gnot_tpu.data.batch import PackPlan
 
-        pack_plan = PackPlan.from_samples(
-            traffic, chunk=args.pack_chunk, batch_size=args.max_batch
+        pack_plan = PackPlan.for_slices(
+            traffic,
+            chunk=args.pack_chunk,
+            batch_size=args.max_batch,
+            per_devices=(
+                len(_jax.devices()) // args.replicas
+                if args.replicas > 1
+                else 1
+            ),
         )
     # Precompile every bucket the storm will hit (serving-startup
     # discipline — docs/serving.md): an XLA compile landing under a
     # 200 ms deadline would shed everything queued behind it. Replicas
     # each warm their own executables (placement differs per slice).
-    replicas = None
-    if args.replicas > 1:
-        from gnot_tpu.serve import build_replicas
+    # Under --prewarm the compiles happen in a DEPLOY pass instead
+    # (AOT compile + snapshot), and the serving engines below are
+    # fresh twins that hydrate executables without compiling anything.
+    manifest = None
+    if args.prewarm:
+        from gnot_tpu.serve import aot, build_replicas
 
-        replicas = build_replicas(
-            engine.model, engine.params, args.replicas,
-            batch_size=args.max_batch,
+        snap_dir = tempfile.mkdtemp(prefix="serve_smoke_snap_")
+        if args.replicas > 1:
+            deploy = build_replicas(
+                engine.model, engine.params, args.replicas,
+                batch_size=args.max_batch,
+            )
+            engines = [(r.replica_id, r.engine) for r in deploy]
+        else:
+            engines = [(0, engine)]
+        manifest = aot.prewarm_deployment(
+            engines, traffic, rows=args.max_batch, pack_plan=pack_plan,
+            snapshot_dir=snap_dir,
         )
-        for r in replicas:
-            r.warm(traffic, rows=args.max_batch, pack_plan=pack_plan)
-    else:
-        engine.warmup(traffic, rows=args.max_batch)
-        if pack_plan is not None:
-            engine.warmup_packed(traffic, pack_plan)
+        if args.replicas <= 1:
+            # The single-server arm reuses `engine` for the deploy
+            # compile; serve from a fresh twin so the storm proves the
+            # snapshots (not the deploy engine's in-process jit cache).
+            engine = build_engine(max_batch=args.max_batch)
+    import contextlib
     import time as _time
 
-    with MetricsSink(metrics_path) as sink:
-        common = dict(
-            max_batch=args.max_batch,
-            max_wait_ms=args.max_wait_ms,
-            queue_limit=args.queue_limit,
-            default_deadline_ms=args.deadline_ms,
-            sink=sink,
-            faults=FaultInjector.from_spec(args.inject_fault),
-            tracer=tracer,
-            pack_plan=pack_plan,
-        )
-        if replicas is not None:
-            from gnot_tpu.serve import ReplicaRouter
+    from gnot_tpu.utils.cache import compile_cache_probe
 
-            server = ReplicaRouter(
-                replicas, route_policy=args.route_policy, **common
-            ).start()
+    # Under --prewarm the probe spans replica build + hydration + the
+    # whole storm: the assertion below is "the serving tier compiled
+    # NOTHING", not just "warmup was warm".
+    with contextlib.ExitStack() as serve_stack:
+        serve_cache = serve_stack.enter_context(compile_cache_probe())
+        replicas = None
+        if args.replicas > 1:
+            from gnot_tpu.serve import build_replicas
+
+            replicas = build_replicas(
+                engine.model, engine.params, args.replicas,
+                batch_size=args.max_batch,
+            )
+            if manifest is None:
+                for r in replicas:
+                    r.warm(traffic, rows=args.max_batch, pack_plan=pack_plan)
+        elif manifest is not None:
+            from gnot_tpu.serve import aot
+
+            aot.hydrate_block(engine, manifest, 0)
         else:
-            server = InferenceServer(engine, **common).start()
-        t_submit = _time.perf_counter()
-        futures = [server.submit(s) for s in traffic]
-        results = [f.result(timeout=120) for f in futures]
-        wall_s = _time.perf_counter() - t_submit
-        summary = server.drain()
-        if tracer is not None:
-            tracer.flush(sink=sink)
+            engine.warmup(traffic, rows=args.max_batch)
+            if pack_plan is not None:
+                engine.warmup_packed(traffic, pack_plan)
+
+        with MetricsSink(metrics_path) as sink:
+            common = dict(
+                max_batch=args.max_batch,
+                max_wait_ms=args.max_wait_ms,
+                queue_limit=args.queue_limit,
+                default_deadline_ms=args.deadline_ms,
+                sink=sink,
+                faults=FaultInjector.from_spec(args.inject_fault),
+                tracer=tracer,
+                pack_plan=pack_plan,
+            )
+            if replicas is not None:
+                from gnot_tpu.serve import ReplicaRouter
+
+                server = ReplicaRouter(
+                    replicas, route_policy=args.route_policy, **common
+                )
+                if manifest is not None:
+                    # Warm-replica hydration through the router so each
+                    # replica's replica_warm event (source "snapshot")
+                    # lands in the sink.
+                    server.prewarm_from(manifest)
+                server.start()
+            else:
+                server = InferenceServer(engine, **common).start()
+            t_submit = _time.perf_counter()
+            futures = [server.submit(s) for s in traffic]
+            results = [f.result(timeout=120) for f in futures]
+            wall_s = _time.perf_counter() - t_submit
+            summary = server.drain()
+            if tracer is not None:
+                tracer.flush(sink=sink)
     # Storm throughput (submit -> last resolve; the pack_ab serve
     # metric). Not part of the serve_summary event schema — stamped on
     # the RETURNED dict only, after the sink closed.
@@ -345,6 +409,53 @@ def run(argv=None) -> dict:
         any(e.get("event") == "serve_summary" for e in events),
         "no serve_summary event in the sink",
     )
+    if args.prewarm:
+        # The prewarmed tier must have compiled NOTHING: hydration is
+        # snapshot deserialization (zero compile-cache consultations),
+        # and every storm dispatch runs an installed AOT executable
+        # (zero jit fallbacks — the only path that can reach XLA).
+        check(
+            serve_cache["requests"] == 0,
+            f"prewarmed hydration consulted the compile cache "
+            f"{serve_cache['requests']} times (misses="
+            f"{serve_cache['misses']}) — snapshots must not compile",
+        )
+        serving = (
+            [(r.replica_id, r.engine) for r in replicas]
+            if replicas is not None
+            else [(0, engine)]
+        )
+        for rid, eng in serving:
+            counts = eng.dispatch_counts
+            check(
+                counts["jit"] == 0,
+                f"replica {rid} dispatch provenance {counts}: a "
+                "prewarmed storm must run entirely through installed "
+                "AOT executables",
+            )
+        check(
+            sum(e.dispatch_counts["aot"] for _, e in serving) > 0,
+            "prewarmed storm never exercised an AOT executable",
+        )
+        if replicas is not None:
+            for r in replicas:
+                ws = r.warm_stats or {}
+                check(
+                    ws.get("source") == "snapshot"
+                    and ws.get("misses") == 0
+                    and not ws.get("skipped"),
+                    f"replica {r.replica_id} warm_stats {ws}: expected "
+                    "a clean snapshot hydration",
+                )
+            warms = [
+                e for e in events if e.get("event") == "replica_warm"
+            ]
+            check(
+                {e["replica"] for e in warms}
+                == {r.replica_id for r in replicas}
+                and all(e["source"] == "snapshot" for e in warms),
+                f"replica_warm events malformed: {warms}",
+            )
 
     if tracer is not None:
         # Trace-file assertions (ISSUE 5 acceptance): every completed
