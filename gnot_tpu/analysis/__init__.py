@@ -43,6 +43,7 @@ from gnot_tpu.analysis.core import (  # noqa: F401
 )
 
 # Importing the rule modules registers them.
+from gnot_tpu.analysis import aliasing  # noqa: F401
 from gnot_tpu.analysis import donation  # noqa: F401
 from gnot_tpu.analysis import hostsync  # noqa: F401
 from gnot_tpu.analysis import locks  # noqa: F401

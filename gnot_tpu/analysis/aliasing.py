@@ -1,0 +1,280 @@
+"""GL006 — aliased-host-view: use-after-donate through a host alias.
+
+The nine-times-root-caused bug shape of this repo's history (PR 6/7/10
+parity failures, the PR 2 checkpoint corruption): on CPU,
+``jax.device_get`` / ``np.asarray`` over a device value return
+**zero-copy NumPy views** of the live device buffers. Snapshot such a
+view, run a donating dispatch on the aliased state, and the "snapshot"
+silently advances (or turns to garbage) — surfacing as ~1e-3 parity
+drift three layers from the actual bug:
+
+.. code-block:: python
+
+    host = jax.device_get(state.params)   # zero-copy view
+    state, loss = train_step(state, b, lr)  # donates state's buffers
+    np.testing.assert_allclose(host, ...)   # GL006: stale host view
+
+The rule runs an intra-function, source-order dataflow pass:
+
+* **alias seeding** — an assignment whose RHS is ``jax.device_get(X)``,
+  ``np.asarray(X)`` / ``jnp.asarray(X)``, or a view-preserving
+  ``jax.tree.map`` over either, links the target name to the source
+  expression key ``X`` (chains and name-to-name propagation included).
+  Copying forms (``np.array``, ``np.copy``, ``copy.deepcopy``,
+  ``jax.tree.map(np.array, ...)``) break the chain — they are the fix.
+* **donation** — any statement invoking a donating callable (resolved
+  via ``core.donors_for_file``: configured names, intra-file jit
+  donors, the project call graph's wrapper/factory donors, and
+  self-attribute donors like ``Trainer.fit`` donating ``self.state``)
+  on a source related to a live alias poisons that alias.
+* **stale read** — the first later read of a poisoned alias is the
+  finding, at the read's own line.
+
+Rebinding an alias clears it; rebinding the *source* before the
+donation breaks the link (the view points at the old buffers, which
+the donating call never touches). Reads inside the donating statement
+itself (the call's own arguments) are evaluated before the donation
+and stay clean.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from gnot_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    Rule,
+    donated_keys_of_call,
+    donors_for_file,
+    dotted_name,
+    full_key,
+    keys_related,
+    register,
+    terminal_name,
+)
+
+#: Callable terminal names that COPY their input — assignments through
+#: these break the alias chain (they are exactly the committed fixes).
+_COPY_FNS = ("array", "copy", "deepcopy")
+
+#: numpy-ish module heads whose ``asarray`` is view-preserving.
+_NP_HEADS = ("np", "numpy", "jnp", "jax.numpy")
+
+
+def _is_np_asarray(call: ast.Call) -> bool:
+    if terminal_name(call.func) != "asarray":
+        return False
+    if not isinstance(call.func, ast.Attribute):
+        return False
+    return dotted_name(call.func.value) in _NP_HEADS
+
+
+def _alias_source(node: ast.AST, alias: dict[str, str]) -> str | None:
+    """Device-expression key ``node`` evaluates to a host VIEW of, or
+    None when it is a copy / untracked value. ``alias`` resolves names
+    that are themselves host views back to their device source."""
+    if not isinstance(node, ast.Call):
+        return None
+    fname = terminal_name(node.func)
+    if fname == "device_get" and node.args:
+        return _source_or_key(node.args[0], alias)
+    if _is_np_asarray(node) and node.args:
+        return _source_or_key(node.args[0], alias)
+    if fname == "map" and "tree" in dotted_name(node.func):
+        # jax.tree.map(f, X): aliasing only for a PROVABLY
+        # view-preserving f (`asarray`). Anything else — np.array,
+        # copying lambdas, arbitrary transforms — is assumed to copy:
+        # the rule must hold zero false positives over the clean tree,
+        # and the committed fixes are exactly the copying maps.
+        if len(node.args) >= 2 and terminal_name(node.args[0]) == "asarray":
+            return _source_or_key(node.args[1], alias)
+    return None
+
+
+def _source_or_key(node: ast.AST, alias: dict[str, str]) -> str | None:
+    src = _alias_source(node, alias)
+    if src is not None:
+        return src
+    key = full_key(node)
+    if key is None:
+        return None
+    # A name that is itself a host view aliases that view's source.
+    return alias.get(key, key)
+
+
+def _scope_statements(scope: ast.AST) -> list[ast.stmt]:
+    """Statements of one scope in source order, without descending into
+    nested function/class bodies (those are their own scopes)."""
+    out: list[ast.stmt] = []
+
+    def visit(body):
+        for stmt in body:
+            if isinstance(
+                stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                continue
+            out.append(stmt)
+            for field in ("body", "orelse", "finalbody"):
+                visit(getattr(stmt, field, []) or [])
+            for handler in getattr(stmt, "handlers", []) or []:
+                visit(handler.body)
+            for case in getattr(stmt, "cases", []) or []:
+                visit(case.body)  # match arms (ast.Match)
+
+    body = scope.body if isinstance(scope.body, list) else [scope.body]
+    visit(body)
+    out.sort(key=lambda s: (s.lineno, s.col_offset))
+    return out
+
+
+def _shallow_nodes(stmt: ast.stmt):
+    """Nodes of ``stmt`` without nested def/lambda bodies (their reads
+    execute later, in their own scope)."""
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            stack.append(child)
+
+
+def _assigned_entries(stmt: ast.stmt) -> list[tuple[str, ast.AST | None]]:
+    """(target key, RHS expr or None) pairs this statement binds. The
+    RHS is only attached for the single-target ``name = value`` shape —
+    tuple unpacking and loop targets just clear their keys."""
+    if isinstance(stmt, ast.Assign):
+        if len(stmt.targets) == 1 and full_key(stmt.targets[0]) is not None:
+            return [(full_key(stmt.targets[0]), stmt.value)]
+        out = []
+        for t in stmt.targets:
+            for node in ast.walk(t):
+                key = full_key(node)
+                if key is not None and isinstance(
+                    node.ctx, ast.Store
+                ):
+                    out.append((key, None))
+        return out
+    if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        key = full_key(stmt.target)
+        value = stmt.value if isinstance(stmt, ast.AnnAssign) else None
+        return [(key, value)] if key else []
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [
+            (full_key(n), None)
+            for n in ast.walk(stmt.target)
+            if full_key(n) is not None
+        ]
+    if isinstance(stmt, ast.With):
+        return [
+            (full_key(i.optional_vars), None)
+            for i in stmt.items
+            if i.optional_vars is not None
+            and full_key(i.optional_vars) is not None
+        ]
+    if isinstance(stmt, ast.Delete):
+        return [
+            (full_key(t), None)
+            for t in stmt.targets
+            if full_key(t) is not None
+        ]
+    return []
+
+
+@register
+class AliasedHostView(Rule):
+    id = "GL006"
+    title = "aliased-host-view"
+    hint = (
+        "copy the host snapshot by value before the donating call "
+        "(`jax.tree.map(np.array, jax.device_get(x))`, or `np.array(x)` "
+        "for one array) — a zero-copy view of donated buffers is "
+        "undefined after the dispatch"
+    )
+
+    def check_file(self, ctx: FileContext) -> list[Finding]:
+        donors = donors_for_file(ctx)
+        findings: list[Finding] = []
+        scopes: list[ast.AST] = [ctx.tree]
+        scopes += [
+            n
+            for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            findings.extend(self._check_scope(ctx, scope, donors))
+        return findings
+
+    def _check_scope(
+        self, ctx: FileContext, scope: ast.AST, donors
+    ) -> list[Finding]:
+        findings: list[Finding] = []
+        alias: dict[str, str] = {}  # view name -> device source key
+        poisoned: dict[str, dict] = {}  # view name -> donation info
+        for stmt in _scope_statements(scope):
+            # (a) reads of already-poisoned views — the finding, at the
+            # read's own line (first read per view).
+            for node in _shallow_nodes(stmt):
+                if not isinstance(node, (ast.Name, ast.Attribute)):
+                    continue
+                if not isinstance(node.ctx, ast.Load):
+                    continue
+                key = full_key(node)
+                info = poisoned.get(key) if key else None
+                if info is None:
+                    continue
+                findings.append(
+                    Finding(
+                        rule=self.id,
+                        path=ctx.path,
+                        line=node.lineno,
+                        message=(
+                            f"`{key}` is a host view of `{info['source']}`"
+                            f", whose buffers were donated to "
+                            f"`{info['donor']}(...)` at line "
+                            f"{info['line']}; the view is stale"
+                        ),
+                        hint=self.hint,
+                    )
+                )
+                poisoned.pop(key, None)
+                alias.pop(key, None)
+            # (b) donations in this statement poison related aliases
+            # (the statement's own argument reads happened before the
+            # donation and stay clean by the (a)-before-(b) ordering).
+            for node in _shallow_nodes(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                for dkey in donated_keys_of_call(node, donors):
+                    for name, src in list(alias.items()):
+                        if keys_related(src, dkey):
+                            poisoned.setdefault(
+                                name,
+                                {
+                                    "source": src,
+                                    "donor": terminal_name(node.func),
+                                    "line": node.lineno,
+                                },
+                            )
+            # (c) bindings: seed new aliases, clear rebound ones, break
+            # source links whose device value was replaced.
+            for key, rhs in _assigned_entries(stmt):
+                alias.pop(key, None)
+                poisoned.pop(key, None)
+                # Rebinding a SOURCE breaks its links: views of the old
+                # value are untouched by donations of the new one.
+                for name, src in list(alias.items()):
+                    if keys_related(src, key):
+                        alias.pop(name, None)
+                if rhs is not None:
+                    src = _alias_source(rhs, alias)
+                    if src is None and full_key(rhs) is not None:
+                        # name-to-name propagation: h2 = host
+                        src = alias.get(full_key(rhs))
+                    if src is not None:
+                        alias[key] = src
+        return findings

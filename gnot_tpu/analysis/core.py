@@ -37,13 +37,19 @@ _SUPPRESS_FILE_RE = re.compile(rf"#\s*graftlint:\s*disable-file=({_IDS})")
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    """One rule violation, anchored to ``file:line`` with a fix hint."""
+    """One rule violation, anchored to ``file:line`` with a fix hint.
+
+    ``project_level`` marks findings from a rule's cross-file pass
+    (GL005 registry/docs drift): they are caused by the change set as
+    a whole, so diff-scoped reporting (``tools/lint.py --changed``)
+    must never filter them by path."""
 
     rule: str  # "GL001"
     path: str  # repo-relative
     line: int
     message: str
     hint: str = ""
+    project_level: bool = False
 
     def format(self) -> str:
         s = f"{self.path}:{self.line}: {self.rule} {self.message}"
@@ -68,6 +74,12 @@ class LintConfig:
     enable: list[str] = dataclasses.field(default_factory=list)  # [] = all
     disable: list[str] = dataclasses.field(default_factory=list)
     exclude: list[str] = dataclasses.field(default_factory=list)
+    # Default scan roots for the CLI (no positional paths) and the
+    # tier-1 repo-tree-clean gate. tests/ and tools/ are in: every
+    # historical use-after-donate instance lived there.
+    paths: list[str] = dataclasses.field(
+        default_factory=lambda: ["gnot_tpu", "tests", "tools"]
+    )
     # GL001: terminal attribute/function names known to donate arg 0
     # (the builders in train/trainer.py, obs/telemetry.py,
     # parallel/mesh.py and parallel/pipeline.py all donate the state).
@@ -230,6 +242,10 @@ class FileContext:
         self.path = rel_path
         self.source = source
         self.config = config or LintConfig()
+        # Back-reference to the run's ProjectContext (set by
+        # run_analysis). Rules must degrade gracefully when None — unit
+        # fixtures construct FileContexts directly.
+        self.project: "ProjectContext | None" = None
         self.lines = source.splitlines()
         self.tree = ast.parse(source, filename=rel_path)
         self._parents: dict[ast.AST, ast.AST] = {}
@@ -296,11 +312,22 @@ class FileContext:
 
 
 class ProjectContext:
-    """Cross-file state for project-level checks (GL005 docs drift)."""
+    """Cross-file state for project-level checks (GL005 docs drift) and
+    the donation call graph GL001/GL006 resolve helper wrappers
+    through (``build_donation_graph``)."""
 
     def __init__(self, root: str, config: LintConfig):
         self.root = root
         self.config = config
+        #: FileContexts of every parsed file in this run (set by
+        #: run_analysis before any rule executes).
+        self.contexts: list[FileContext] = []
+        #: terminal callable name -> Donor. Seeded from the configured
+        #: donate_callables, grown to fixpoint over helper wrappers.
+        self.donors: dict[str, "Donor"] = {}
+        #: factory name -> Donor of the callable it RETURNS
+        #: (``make_train_step`` returns a jitted donating step).
+        self.factories: dict[str, "Donor"] = {}
 
 
 class Rule:
@@ -370,10 +397,15 @@ def run_analysis(
     findings: list[Finding] = []
     n_suppressed = 0
     files = iter_python_files(paths, root, config)
+    # Phase 1 — parse everything. The donation call graph (GL001/GL006)
+    # needs every file's tree before any per-file rule runs: a test
+    # calls a trainer method that calls the donating step, and only the
+    # project-wide fixpoint sees that chain.
+    contexts: list[FileContext] = []
     for rel in files:
         try:
             with open(os.path.join(root, rel), encoding="utf-8") as f:
-                ctx = FileContext(root, rel, f.read(), config)
+                contexts.append(FileContext(root, rel, f.read(), config))
         except (OSError, SyntaxError, UnicodeDecodeError, ValueError) as err:
             findings.append(
                 Finding(
@@ -384,16 +416,24 @@ def run_analysis(
                     hint="fix the syntax error or exclude the file",
                 )
             )
-            continue
+    # Phase 2 — project context + donation call graph.
+    project = ProjectContext(root, config)
+    project.contexts = contexts
+    project.donors, project.factories = build_donation_graph(contexts, config)
+    # Phase 3 — per-file rules (each ctx sees the project graph).
+    for ctx in contexts:
+        ctx.project = project
         for rule in rules:
             for f in rule.check_file(ctx):
                 if ctx.is_suppressed(f.rule, f.line):
                     n_suppressed += 1
                 else:
                     findings.append(f)
-    project = ProjectContext(root, config)
     for rule in rules:
-        findings.extend(rule.check_project(project))
+        findings.extend(
+            dataclasses.replace(f, project_level=True)
+            for f in rule.check_project(project)
+        )
     findings.sort(key=lambda f: (f.path, f.line, f.rule))
     stats = {
         "files": len(files),
@@ -445,3 +485,338 @@ def jit_call_kwargs(dec: ast.AST) -> dict[str, ast.AST] | None:
             if is_jit_expr(dec.args[0]):
                 return {k.arg: k.value for k in dec.keywords if k.arg}
     return None
+
+
+def full_key(node: ast.AST) -> str | None:
+    """Stable dotted identity of a trackable expression: a name
+    (``state``), an attribute path rooted at a name
+    (``self.state.params``), or either through a subscript
+    (``state.params["w"]`` -> "state.params"). None for anything whose
+    identity the analysis cannot track (call results, literals)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = full_key(node.value)
+        return f"{base}.{node.attr}" if base else None
+    if isinstance(node, ast.Subscript):
+        return full_key(node.value)
+    return None
+
+
+def keys_related(a: str, b: str) -> bool:
+    """Whether two expression keys can alias the same buffers: equal,
+    or one a dotted prefix of the other (``state`` donated frees the
+    buffers a ``state.params`` view aliases, and vice versa)."""
+    return a == b or a.startswith(b + ".") or b.startswith(a + ".")
+
+
+# -- donation call graph (GL001 / GL006) ------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class Donor:
+    """How a callable donates device buffers.
+
+    ``arg_positions`` — positions into a *bound* call's arguments that
+    are donated (``train_step(state, batch, lr)`` donates position 0).
+    ``self_attrs`` — receiver attributes the callable donates
+    internally (``Trainer.fit`` donates ``self.state`` through its
+    nested dispatch helpers), so ``t.fit()`` makes host views of
+    ``t.state...`` stale.
+    """
+
+    arg_positions: tuple[int, ...] = ()
+    self_attrs: tuple[str, ...] = ()
+
+    def merged(self, other: "Donor") -> "Donor":
+        return Donor(
+            arg_positions=tuple(
+                sorted(set(self.arg_positions) | set(other.arg_positions))
+            ),
+            self_attrs=tuple(
+                sorted(set(self.self_attrs) | set(other.self_attrs))
+            ),
+        )
+
+    def __bool__(self) -> bool:
+        return bool(self.arg_positions or self.self_attrs)
+
+
+def donated_indices(kwargs: dict[str, ast.AST]) -> tuple[int, ...]:
+    """The ``donate_argnums`` of a jit call's keyword dict."""
+    node = kwargs.get("donate_argnums")
+    if node is None:
+        return ()
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return tuple(
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        )
+    return ()
+
+
+def collect_jit_donating(tree: ast.AST) -> dict[str, tuple[int, ...]]:
+    """Intra-file donating callables: defs decorated
+    ``@partial(jax.jit, donate_argnums=...)`` and names bound via
+    ``f = jax.jit(g, donate_argnums=...)``. File-local by design — a
+    generic local name like ``step`` must not leak into the project
+    graph and flag unrelated files."""
+    donating: dict[str, tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                kwargs = jit_call_kwargs(dec)
+                if kwargs:
+                    idxs = donated_indices(kwargs)
+                    if idxs:
+                        donating[node.name] = idxs
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            kwargs = jit_call_kwargs(node.value) or (
+                {k.arg: k.value for k in node.value.keywords if k.arg}
+                if terminal_name(node.value.func) == "jit"
+                else None
+            )
+            if kwargs:
+                idxs = donated_indices(kwargs)
+                if idxs:
+                    for t in node.targets:
+                        name = terminal_name(t)
+                        if name:
+                            donating[name] = idxs
+    return donating
+
+
+def _param_names(fn: ast.AST) -> list[str]:
+    args = fn.args
+    return [a.arg for a in (*args.posonlyargs, *args.args)]
+
+
+def _resolve_call_donor(
+    call: ast.Call,
+    donors: dict[str, Donor],
+    local: dict[str, tuple[int, ...]],
+) -> Donor | None:
+    name = terminal_name(call.func)
+    d = donors.get(name)
+    idxs = local.get(name)
+    if idxs:
+        d = (d or Donor()).merged(Donor(arg_positions=idxs))
+    return d
+
+
+def donated_keys_of_call(
+    call: ast.Call,
+    donors: dict[str, Donor],
+    local: dict[str, tuple[int, ...]] | None = None,
+) -> list[str]:
+    """Expression keys whose device buffers are dead after ``call``:
+    donated positional args, plus ``<receiver>.<attr>`` for every
+    self-attribute the callee donates internally (``t.fit()`` with
+    ``fit`` donating ``self.state`` kills ``t.state``)."""
+    d = _resolve_call_donor(call, donors, local or {})
+    if not d:
+        return []
+    keys: list[str] = []
+    for p in d.arg_positions:
+        if p < len(call.args):
+            k = full_key(call.args[p])
+            if k:
+                keys.append(k)
+    if d.self_attrs and isinstance(call.func, ast.Attribute):
+        rk = full_key(call.func.value)
+        if rk:
+            keys.extend(f"{rk}.{a}" for a in d.self_attrs)
+    return keys
+
+
+def _function_donation(
+    fn: ast.AST,
+    donors: dict[str, Donor],
+    local: dict[str, tuple[int, ...]],
+) -> Donor:
+    """What ``fn`` donates of ITS OWN interface, judged by the calls in
+    its body (nested helper defs included — the trainer's dispatch
+    closures donate ``self.state`` on the enclosing method's behalf):
+    a parameter passed into a donating call in donated position makes
+    ``fn`` a positional donor; a donated ``self.<attr>`` makes it a
+    self-attribute donor."""
+    params = _param_names(fn)
+    is_method = bool(params) and params[0] in ("self", "cls")
+    positions: set[int] = set()
+    attrs: set[str] = set()
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call) or node is fn:
+            continue
+        for key in donated_keys_of_call(node, donors, local):
+            base = key.split(".")[0]
+            if key.startswith("self.") and "." in key:
+                attrs.add(key.split(".")[1])
+            elif base in params:
+                pos = params.index(base)
+                call_pos = pos - 1 if is_method else pos
+                if call_pos >= 0:
+                    positions.add(call_pos)
+    return Donor(
+        arg_positions=tuple(sorted(positions)), self_attrs=tuple(sorted(attrs))
+    )
+
+
+def _returned_donor(
+    fn: ast.AST,
+    donors: dict[str, Donor],
+    local: dict[str, tuple[int, ...]],
+    factories: dict[str, Donor],
+) -> Donor:
+    """Donor of the callable ``fn`` RETURNS, if any — the step-factory
+    shape. Recognized returns: a local jitted-donating def
+    (``make_train_step``), a direct ``return jax.jit(step, ...,
+    donate_argnums=...)`` (``make_sharded_train_step``), and a
+    delegation to another known factory
+    (``return pipeline.make_pipelined_train_step(...)``). Assignments
+    ``step = make_train_step(...)`` then make the local name a donor
+    (``factory_assigned_donors``)."""
+    own_jit = collect_jit_donating(fn)
+    out = Donor()
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Return) and node.value is not None):
+            continue
+        v = node.value
+        if isinstance(v, ast.Call):
+            kwargs = jit_call_kwargs(v)
+            if kwargs:
+                idxs = donated_indices(kwargs)
+                if idxs:
+                    out = out.merged(Donor(arg_positions=idxs))
+                continue
+            fac = factories.get(terminal_name(v.func))
+            if fac:
+                out = out.merged(fac)
+            continue
+        name = terminal_name(v)
+        idxs = own_jit.get(name) or local.get(name)
+        if idxs:
+            out = out.merged(Donor(arg_positions=idxs))
+        elif name in donors:
+            out = out.merged(donors[name])
+    return out
+
+
+def build_donation_graph(
+    contexts: list["FileContext"], config: LintConfig
+) -> tuple[dict[str, Donor], dict[str, Donor]]:
+    """Project-wide donation call graph, to fixpoint.
+
+    Seeds: the configured ``donate_callables`` (arg 0). Each round, a
+    function that feeds one of its parameters (or a ``self.<attr>``)
+    into a known donating call becomes a donor itself — so calls
+    through helper indirection (``run_single``-style wrappers,
+    ``Trainer.fit``) resolve without per-call configuration. Intra-file
+    jitted donors participate in their own file's propagation but stay
+    file-local (generic names must not flag other files). Also returns
+    the factory map: functions returning a donating callable
+    (``make_train_step``)."""
+    donors: dict[str, Donor] = {
+        name: Donor(arg_positions=(0,)) for name in config.donate_callables
+    }
+    factories: dict[str, Donor] = {}
+    local_by_ctx = []
+    for ctx in contexts:
+        local = collect_jit_donating(ctx.tree)
+        # Stash for donors_for_file — the per-rule resolution reuses
+        # this instead of re-walking the tree.
+        ctx._jit_donors = local
+        local_by_ctx.append(local)
+    for _ in range(8):  # bounded fixpoint; chains are short in practice
+        changed = False
+        for ctx, local in zip(contexts, local_by_ctx):
+            for fn in ast.walk(ctx.tree):
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                d = _function_donation(fn, donors, local)
+                if d:
+                    merged = donors.get(fn.name, Donor()).merged(d)
+                    if merged != donors.get(fn.name):
+                        donors[fn.name] = merged
+                        changed = True
+                f = _returned_donor(fn, donors, local, factories)
+                if f:
+                    fmerged = factories.get(fn.name, Donor()).merged(f)
+                    if fmerged != factories.get(fn.name):
+                        factories[fn.name] = fmerged
+                        changed = True
+        if not changed:
+            break
+    return donors, factories
+
+
+def donors_for_file(ctx: "FileContext") -> dict[str, Donor]:
+    """The donor map one file's rules should resolve calls against:
+    configured donate_callables + the project graph + file-local jit
+    donors + factory assignments. A project entry whose name is
+    shadowed by a local def is kept only if THIS file's def donates too
+    (a generic helper name in another file must not flag this one).
+    Memoized per FileContext — GL001 and GL006 both resolve through
+    this and the local-defs rescan is pure repetition."""
+    cached = getattr(ctx, "_donors_cache", None)
+    if cached is not None:
+        return cached
+    local = getattr(ctx, "_jit_donors", None)
+    if local is None:  # direct FileContext use (unit fixtures)
+        local = collect_jit_donating(ctx.tree)
+    out: dict[str, Donor] = {
+        name: Donor(arg_positions=(0,)) for name in ctx.config.donate_callables
+    }
+    project = ctx.project
+    if project is not None:
+        local_defs: dict[str, list[ast.AST]] = {}
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                local_defs.setdefault(n.name, []).append(n)
+        for name, d in project.donors.items():
+            if name in out:
+                out[name] = out[name].merged(d)
+                continue
+            defs = local_defs.get(name)
+            if defs and name not in local:
+                own = Donor()
+                for fn in defs:
+                    own = own.merged(
+                        _function_donation(fn, project.donors, local)
+                    )
+                if own:
+                    out[name] = own
+            else:
+                out[name] = d
+        for name, idxs in factory_assigned_donors(
+            ctx.tree, project.factories
+        ).items():
+            out[name] = out.get(name, Donor()).merged(
+                Donor(arg_positions=idxs)
+            )
+    for name, idxs in local.items():
+        out[name] = out.get(name, Donor()).merged(Donor(arg_positions=idxs))
+    ctx._donors_cache = out
+    return out
+
+
+def factory_assigned_donors(
+    tree: ast.AST, factories: dict[str, Donor]
+) -> dict[str, tuple[int, ...]]:
+    """File-local donors from factory assignments:
+    ``step = make_train_step(...)`` binds a name that donates exactly
+    what the factory's returned callable donates."""
+    out: dict[str, tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and isinstance(node.value, ast.Call)):
+            continue
+        fac = factories.get(terminal_name(node.value.func))
+        if not fac or not fac.arg_positions:
+            continue
+        for t in node.targets:
+            name = terminal_name(t)
+            if name:
+                out[name] = fac.arg_positions
+    return out

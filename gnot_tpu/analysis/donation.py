@@ -6,7 +6,7 @@ buffers are XLA's to reuse, and reading them is use-after-free — the
 exact bug PR 2's drive-by fixed, where async orbax saves read donated
 buffers and silently corrupted mid-run checkpoints.
 
-Two detection sources:
+Donating-callable discovery (``core.donors_for_file``):
 
 * **intra-file** — any function defined with a
   ``@functools.partial(jax.jit, donate_argnums=...)`` decorator (or
@@ -16,7 +16,15 @@ Two detection sources:
   ``LintConfig.donate_callables`` (default ``train_step`` /
   ``multi_train_step`` — the trainer's step attributes, built by
   donating builders in train/trainer.py, obs/telemetry.py,
-  parallel/mesh.py, parallel/pipeline.py).
+  parallel/mesh.py, parallel/pipeline.py);
+* **call graph** (``core.build_donation_graph``) — helper wrappers that
+  feed a parameter into a donating call in donated position
+  (``run_single``-style), resolved project-wide to fixpoint, plus
+  file-local names bound from step FACTORIES
+  (``step = make_train_step(...)``). Only positional donors extend this
+  rule — self-attribute donors (``Trainer.fit`` donating
+  ``self.state``) are GL006's aliased-host-view territory, where the
+  hazard needs an outstanding host view, not a missing rebind.
 
 A call is SAFE when the donated expression is rebound by the same
 statement (``state, loss = step(state, ...)``) — the canonical
@@ -34,42 +42,15 @@ from gnot_tpu.analysis.core import (
     FileContext,
     Finding,
     Rule,
-    jit_call_kwargs,
+    donors_for_file,
+    full_key,
     register,
     terminal_name,
 )
 
 
-def _donated_indices(kwargs: dict[str, ast.AST]) -> tuple[int, ...]:
-    node = kwargs.get("donate_argnums")
-    if node is None:
-        return ()
-    if isinstance(node, ast.Constant) and isinstance(node.value, int):
-        return (node.value,)
-    if isinstance(node, (ast.Tuple, ast.List)):
-        return tuple(
-            e.value
-            for e in node.elts
-            if isinstance(e, ast.Constant) and isinstance(e.value, int)
-        )
-    return ()
-
-
-def _expr_key(node: ast.AST) -> str | None:
-    """Stable identity for a donated argument we can track: a local
-    name ("state") or a self-attribute ("self.state")."""
-    if isinstance(node, ast.Name):
-        return node.id
-    if (
-        isinstance(node, ast.Attribute)
-        and isinstance(node.value, ast.Name)
-    ):
-        return f"{node.value.id}.{node.attr}"
-    return None
-
-
 def _matches_key(node: ast.AST, key: str) -> bool:
-    return _expr_key(node) == key
+    return full_key(node) == key
 
 
 def _assigned_keys(stmt: ast.stmt) -> set[str]:
@@ -86,7 +67,7 @@ def _assigned_keys(stmt: ast.stmt) -> set[str]:
     out: set[str] = set()
     for t in targets:
         for node in ast.walk(t):
-            key = _expr_key(node)
+            key = full_key(node)
             if key is not None:
                 out.add(key)
     return out
@@ -103,7 +84,11 @@ class UseAfterDonate(Rule):
     )
 
     def check_file(self, ctx: FileContext) -> list[Finding]:
-        donating = self._collect_donating(ctx)
+        donating = {
+            name: d.arg_positions
+            for name, d in donors_for_file(ctx).items()
+            if d.arg_positions
+        }
         findings: list[Finding] = []
         for call in ast.walk(ctx.tree):
             if not isinstance(call, ast.Call):
@@ -115,7 +100,7 @@ class UseAfterDonate(Rule):
             for idx in idxs:
                 if idx >= len(call.args):
                     continue
-                key = _expr_key(call.args[idx])
+                key = full_key(call.args[idx])
                 if key is None:
                     continue  # a fresh expression; nothing to re-read
                 bad_line = self._use_after(ctx, call, key)
@@ -135,34 +120,6 @@ class UseAfterDonate(Rule):
                         )
                     )
         return findings
-
-    # -- donating-callable discovery ---------------------------------------
-
-    def _collect_donating(self, ctx: FileContext) -> dict[str, tuple[int, ...]]:
-        donating = {name: (0,) for name in ctx.config.donate_callables}
-        for node in ast.walk(ctx.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for dec in node.decorator_list:
-                    kwargs = jit_call_kwargs(dec)
-                    if kwargs:
-                        idxs = _donated_indices(kwargs)
-                        if idxs:
-                            donating[node.name] = idxs
-            # f = jax.jit(g, donate_argnums=...) / partial(jax.jit, ...)
-            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
-                kwargs = jit_call_kwargs(node.value) or (
-                    {k.arg: k.value for k in node.value.keywords if k.arg}
-                    if terminal_name(node.value.func) == "jit"
-                    else None
-                )
-                if kwargs:
-                    idxs = _donated_indices(kwargs)
-                    if idxs:
-                        for t in node.targets:
-                            name = terminal_name(t)
-                            if name:
-                                donating[name] = idxs
-        return donating
 
     # -- dataflow ----------------------------------------------------------
 

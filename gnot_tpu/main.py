@@ -527,12 +527,19 @@ def main(argv=None) -> float:
         parser.error("--log_every needs --metrics_path (step records are JSONL-only)")
     if args.debug_checks:
         # Before ANY tracing: mid-process toggling does not reliably
-        # instrument already-warm jit paths.
-        import jax
+        # instrument already-warm jit paths. Bundles jax_debug_nans
+        # with the donation alias guard (utils/sanitizer.py).
+        from gnot_tpu.utils.debug import enable_debug_guards
 
-        jax.config.update("jax_debug_nans", True)
+        enable_debug_guards()
     if args.backend == "torch":
         return run_torch_backend(args)
+    if not args.debug_checks:
+        # Honor an explicit GNOT_ALIAS_GUARD even without
+        # --debug_checks (no-op when the variable is unset/off).
+        from gnot_tpu.utils import sanitizer
+
+        sanitizer.install()
 
     # Honor JAX_PLATFORMS even when a site hook already imported jax
     # (backends initialize lazily, so the live-config update works).

@@ -43,6 +43,7 @@ from gnot_tpu.data.batch import (
     pack_prefix,
     validate_samples,
 )
+from gnot_tpu.utils import sanitizer
 
 
 def rename_forward(fn: Callable, tag: str | None) -> Callable:
@@ -323,7 +324,12 @@ class InferenceEngine:
         if timings is not None:
             t1 = tick()
             timings["batch_assembly"] = (t0, t1)
-        out = np.asarray(self._run_forward(params, self._device_put(batch)))
+        # host_fetch: np.asarray in off mode (byte-identical), a
+        # defensive copy / registered view under GNOT_ALIAS_GUARD
+        # (utils/sanitizer.py) — the engine-side sanitizer seam.
+        out = sanitizer.host_fetch(
+            self._run_forward(params, self._device_put(batch))
+        )
         if timings is not None:
             t2 = tick()
             timings["device"] = (t1, t2)
@@ -380,7 +386,12 @@ class InferenceEngine:
         if timings is not None:
             t1 = tick()
             timings["batch_assembly"] = (t0, t1)
-        out = np.asarray(self._run_forward(params, self._device_put(batch)))
+        # host_fetch: np.asarray in off mode (byte-identical), a
+        # defensive copy / registered view under GNOT_ALIAS_GUARD
+        # (utils/sanitizer.py) — the engine-side sanitizer seam.
+        out = sanitizer.host_fetch(
+            self._run_forward(params, self._device_put(batch))
+        )
         if timings is not None:
             t2 = tick()
             timings["device"] = (t1, t2)
@@ -467,7 +478,10 @@ class InferenceEngine:
             # the per-host slices; the forward runs sharded and returns
             # the replicated [group, L, out] prediction.
             self._note_shape(batch)
-            out = np.asarray(self._run_forward(params, self._device_put(batch)))
+            # host_fetch: the engine-side sanitizer seam (see infer).
+            out = sanitizer.host_fetch(
+                self._run_forward(params, self._device_put(batch))
+            )
             for j in range(out.shape[0]):
                 idx = bi * group + j
                 outs.append(out[j, : samples[idx].coords.shape[0]])

@@ -107,7 +107,11 @@ class ReplicaRouter:
                 f"unknown route_policy {route_policy!r}; "
                 f"one of {ROUTE_POLICIES}"
             )
-        self.replicas = list(replicas)
+        # The live pool list: grown by add_replica while submit /
+        # reload / drain threads iterate — snapshot via _pool().
+        # (Annotated late: _lock is constructed below, but GL004
+        # collects annotations class-wide.)
+        self.replicas = list(replicas)  #: guarded_by _lock
         self.route_policy = route_policy
         self.pack_plan = pack_plan
         self.sink = sink
@@ -313,7 +317,10 @@ class ReplicaRouter:
         plan = self.pack_plan
         if plan is not None and plan.packable(sample):
             return PACKED_BUCKET, f"packed:{plan.n_rows}x{plan.row_len}"
-        pn, pf = self.replicas[0].engine.bucket_key(sample)
+        # Pool snapshot, not a bare list index: add_replica can resize
+        # the list concurrently (all replicas share one bucket_key
+        # impl, so replica 0 of the snapshot is as good as any).
+        pn, pf = self._pool()[0].engine.bucket_key(sample)
         return (pn, pf), f"{pn}x{pf}"
 
     def _place(self, key) -> tuple[EngineReplica, str]:

@@ -820,6 +820,19 @@ class Trainer:
                     self.model, self.config.train.loss, self.mesh, self.state,
                     loss_fn=self._loss_fn,
                 )
+        # Donation sanitizer seam (utils/sanitizer.py): under
+        # GNOT_ALIAS_GUARD=poison the donating dispatches poison any
+        # registered host view of the state they just donated, so a
+        # stale `jax.device_get` snapshot fails loudly at its read
+        # site. Identity (the bare jitted step, zero wrapper frames)
+        # in off/copy mode.
+        from gnot_tpu.utils import sanitizer
+
+        self.train_step = sanitizer.guard_donating(self.train_step)
+        if self.multi_train_step is not None:
+            self.multi_train_step = sanitizer.guard_donating(
+                self.multi_train_step
+            )
         return self.state
 
     def standard_params(self):

@@ -1,6 +1,7 @@
 """Debug-build numeric guards (SURVEY.md §5 race-detection note: the
 reference is single-threaded with nothing to race; the TPU-native
-equivalent of sanitizers is ``checkify`` for NaN/inf/OOB inside jit).
+equivalent of sanitizers is ``checkify`` for NaN/inf/OOB inside jit —
+plus this repo's own donation sanitizer, ``utils/sanitizer.py``).
 
 ``checked(fn)`` wraps a jittable function so NaN/inf inside it raises
 with a location, instead of silently propagating through the compiled
@@ -8,14 +9,35 @@ program; pass ``errors=checkify.all_checks`` to add div-by-zero and
 out-of-bounds index checks (expensive at trace time on large
 programs). Debug builds only — the checks block fusion and cost real
 throughput.
+
+``enable_debug_guards()`` is the one-call debug bundle ``main.py``
+runs under ``--debug_checks``: ``jax_debug_nans`` plus the donation
+alias guard (``GNOT_ALIAS_GUARD``, defaulted to copy mode so
+use-after-donate through aliased ``device_get`` views — the
+nine-times-root-caused parity bug — cannot occur in a debug run).
 """
 
 from __future__ import annotations
 
+import os
 from typing import Callable
 
 import jax
 from jax.experimental import checkify
+
+
+def enable_debug_guards(*, nan_checks: bool = True) -> str:
+    """Turn on the debug-run guard set. ``jax_debug_nans`` first (it
+    must precede tracing), then the donation sanitizer —
+    ``GNOT_ALIAS_GUARD`` is defaulted to ``1`` (copy mode) when unset,
+    so an explicit ``GNOT_ALIAS_GUARD=poison`` (or ``=0``) still wins.
+    Returns the sanitizer mode installed."""
+    from gnot_tpu.utils import sanitizer
+
+    if nan_checks:
+        jax.config.update("jax_debug_nans", True)
+    os.environ.setdefault("GNOT_ALIAS_GUARD", "1")
+    return sanitizer.install()
 
 
 def checked(fn: Callable, *, jit: bool = True, errors=None) -> Callable:

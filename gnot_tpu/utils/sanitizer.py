@@ -1,0 +1,304 @@
+"""Runtime donation sanitizer: make use-after-donate impossible (or loud).
+
+The single most expensive bug class in this repo's history is the
+**aliased host view over donated buffers**: on CPU, ``jax.device_get``
+(and ``np.asarray`` on a device value) return zero-copy NumPy views of
+the live device buffers, and the donating train steps
+(``donate_argnums=(0,)``) hand those very buffers back to XLA on every
+dispatch. A snapshot that was really a view silently "advances" with
+the next step, and the bug surfaces as ~1e-3 parity drift three layers
+from its cause — root-caused NINE separate times across PRs 6, 7 and
+10 (docs/robustness.md "The donation sanitizer" has the case study).
+graftlint GL006 (docs/static_analysis.md) catches the shape statically;
+this module is the runtime belt to that brace.
+
+``GNOT_ALIAS_GUARD`` selects the mode (read at :func:`install` time):
+
+* **off** (unset / ``0`` / ``off``) — nothing is patched; every hot
+  path is byte-identical to an unguarded process. The committed A/B
+  (``docs/artifacts/sanitizer_overhead_ab.jsonl``) pins this.
+* **copy** (``1`` / ``on`` / ``copy``) — ``jax.device_get`` returns
+  **defensively copied** arrays: a host snapshot through the
+  device_get channel — where all nine historical instances lived —
+  can never alias device memory. This is the tier-1 default
+  (tests/conftest.py) and what ``--debug_checks`` turns on: the cost is
+  one extra host memcpy per fetch, off the dispatch hot path. Honest
+  limit: ``np.asarray`` over a device value goes through numpy's
+  C-level buffer path, which is not interceptable (patching
+  ``ArrayImpl.__array__`` verifiably does not take effect), so that
+  seeding form stays zero-copy at runtime — graftlint **GL006** covers
+  it statically, and the engine's own fetches ride :func:`host_fetch`,
+  which IS guarded.
+* **poison** (``poison``) — the forensic mode: ``device_get`` returns
+  the raw (possibly zero-copy) views but REGISTERS them against their
+  device buffers; a donating dispatch wrapped by
+  :func:`guard_donating` then overwrites every registered view of the
+  donated buffers with a sentinel byte pattern (NaN for float views)
+  and warns with the view's creation site. A stale read stops being
+  1e-3 drift and becomes NaN at its own source line. (If XLA aliased
+  the new state onto the donated memory, the poison lands there too —
+  still loud, by design: the view's contents are undefined after
+  donation either way. Diagnostic runs only.)
+
+Wiring: trainer steps are wrapped in ``Trainer.initialize``;
+``InferenceEngine`` fetches outputs through :func:`host_fetch`;
+``gnot_tpu.main`` installs the guard at startup (forced on under
+``--debug_checks``); tier-1 installs via ``tests/conftest.py``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import traceback
+import warnings
+import weakref
+from typing import Callable, Iterable
+
+import numpy as np
+
+_MODES = ("off", "copy", "poison")
+
+#: Live mode; "off" until install() runs.
+_mode = "off"
+_orig_device_get: Callable | None = None
+
+#: id(device array) -> list of (weakref to host view ndarray, origin
+#: "file:line", is-jax-cache flag). Populated only in poison mode;
+#: entries die with their device array (weakref.finalize) or at
+#: poisoning.
+_views: dict[int, list] = {}
+
+#: Donating callables handed to guard_donating while poison was NOT
+#: live (returned unwrapped). A later install of poison mode warns
+#: with this count: those dispatches will never poison anything.
+_unguarded_builds = 0
+
+
+def guard_mode() -> str:
+    """The mode ``GNOT_ALIAS_GUARD`` requests (not necessarily
+    installed yet): off / copy / poison."""
+    raw = os.environ.get("GNOT_ALIAS_GUARD", "").strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return "off"
+    if raw == "poison":
+        return "poison"
+    return "copy"  # "1" / "on" / "true" / "copy"
+
+
+def installed_mode() -> str:
+    """The mode actually live in this process."""
+    return _mode
+
+
+def install() -> str:
+    """Install the guard per ``GNOT_ALIAS_GUARD``. Idempotent; safe to
+    call from conftest, main() and tools. Off-mode installs NOTHING —
+    the unguarded process stays byte-identical. Returns the live mode.
+
+    Re-installation honors a CHANGED env var (tests flip modes); the
+    original ``jax.device_get`` is kept once and restored around
+    swaps."""
+    global _mode, _orig_device_get
+    import jax
+
+    want = guard_mode()
+    if want == _mode:
+        return _mode
+    if _orig_device_get is None:
+        _orig_device_get = jax.device_get
+    if want == "off":
+        jax.device_get = _orig_device_get
+    else:
+        jax.device_get = _guarded_device_get
+    if _mode == "poison" and want != "poison":
+        # Leaving poison: drop the registry — wrappers built under
+        # poison re-check the live mode per call (disarm is total),
+        # and stale entries must not poison after a later re-arm.
+        _views.clear()
+    if want == "poison" and _mode != "poison" and _unguarded_builds:
+        # Poison forensics attach at BUILD time: guard_donating wraps a
+        # dispatch callable only when poison is already live, so a
+        # Trainer/engine constructed BEFORE this install keeps its bare
+        # steps and would silently register views nothing ever
+        # poisons. Say so — a forensic mode the operator merely
+        # believes is armed is worse than none. (A poison env set
+        # before any build stays silent: nothing was built unguarded.)
+        warnings.warn(
+            f"GNOT_ALIAS_GUARD=poison installed after "
+            f"{_unguarded_builds} donating dispatch(es) were built "
+            "unguarded — rebuild the Trainer/engine (or set the env "
+            "before the run) for forensics on existing objects",
+            stacklevel=2,
+        )
+    _mode = want
+    return _mode
+
+
+def _origin() -> str:
+    """file:line of the device_get caller (poison-mode forensics: the
+    warning at poison time points at the view's creation site)."""
+    for frame in reversed(traceback.extract_stack(limit=8)[:-2]):
+        fn = frame.filename
+        if "utils/sanitizer" in fn.replace(os.sep, "/"):
+            continue
+        return f"{fn}:{frame.lineno}"
+    return "<unknown>"
+
+
+def _register_view(device_leaf, host_leaf, origin: str) -> None:
+    if not isinstance(host_leaf, np.ndarray) or host_leaf.flags.owndata:
+        return  # a real copy cannot go stale
+    key = id(device_leaf)
+    slot = _views.get(key)
+    if slot is None:
+        slot = _views[key] = []
+        try:
+            weakref.finalize(device_leaf, _views.pop, key, None)
+        except TypeError:  # non-weakrefable leaf: keep the entry
+            pass
+    # jax caches the zero-copy host view on the Array (_npy_value) and
+    # returns the SAME object on every fetch — so the view outliving
+    # the user's reference is normal, not a leak. Remember whether
+    # this view is that cache object; at poison time a cache-held view
+    # with no OTHER referents is skipped (the user copied and moved
+    # on — the committed fix pattern must stay silent).
+    is_cache = host_leaf is getattr(device_leaf, "_npy_value", None)
+    try:
+        slot.append((weakref.ref(host_leaf), origin, is_cache))
+    except TypeError:
+        pass
+
+
+def _guarded_device_get(x):
+    """The patched ``jax.device_get``: deep copies in copy mode,
+    register-and-pass-through in poison mode."""
+    import jax
+
+    out = _orig_device_get(x)
+    if _mode == "copy":
+        return jax.tree.map(
+            lambda a: np.array(a) if isinstance(a, np.ndarray) else a, out
+        )
+    if _mode == "poison":
+        origin = _origin()
+        for dev, host in zip(jax.tree.leaves(x), jax.tree.leaves(out)):
+            _register_view(dev, host, origin)
+    return out
+
+
+def host_fetch(x) -> np.ndarray:
+    """Fetch a device value to host — the serve-engine output seam.
+
+    Off: ``np.asarray`` (zero-copy when the backend allows — today's
+    behavior, byte-identical). Copy: an owned copy, so an engine
+    caller's result can never alias device memory another dispatch may
+    reuse. Poison: zero-copy plus registration, so a later donation of
+    the fetched value poisons the caller's view loudly."""
+    if _mode == "copy":
+        return np.array(x)
+    out = np.asarray(x)
+    if _mode == "poison":
+        import jax
+
+        origin = _origin()
+        for dev, host in zip(
+            jax.tree.leaves(x), jax.tree.leaves(out)
+        ):
+            _register_view(dev, host, origin)
+    return out
+
+
+def guard_donating(fn: Callable, donate_argnums: tuple[int, ...] = (0,)):
+    """Wrap a donating dispatch callable so registered host views of
+    its donated arguments are poisoned after each call (poison mode).
+    In off/copy mode this returns ``fn`` ITSELF — the dispatch hot
+    path carries zero wrapper frames unless forensics are on (a later
+    switch TO poison warns about these unguarded builds)."""
+    if _mode != "poison":
+        global _unguarded_builds
+        _unguarded_builds += 1
+        return fn
+
+    def guarded(*args, **kwargs):
+        import jax
+
+        if _mode != "poison":
+            # Disarmed after build (install() switched modes): behave
+            # exactly like the bare step — no registry walks, no
+            # memsets, no warnings on the dispatch path.
+            return fn(*args, **kwargs)
+        donated = []
+        for i in donate_argnums:
+            if i < len(args):
+                donated.extend(jax.tree.leaves(args[i]))
+        out = fn(*args, **kwargs)
+        _poison_views_of(donated, repr(getattr(fn, "__name__", fn)))
+        return out
+
+    # The recompile monitor keys on the jitted callable's _cache_size;
+    # forward it so wrapping doesn't blind the monitor.
+    cache_size = getattr(fn, "_cache_size", None)
+    if cache_size is not None:
+        guarded._cache_size = cache_size
+    guarded.__name__ = getattr(fn, "__name__", "guarded_donating")
+    guarded.__wrapped__ = fn
+    return guarded
+
+
+def _poison_views_of(donated_leaves: Iterable, donor: str) -> None:
+    import sys
+
+    origins = []
+    for leaf in donated_leaves:
+        for ref, origin, is_cache in _views.pop(id(leaf), ()):
+            arr = ref()
+            if arr is None:
+                continue
+            # Referents at this point: the `arr` local + getrefcount's
+            # argument (= 2), plus the jax _npy_value cache when this
+            # view IS the cache object. Anything beyond that is a live
+            # user alias — the hazard; at or below it, the snapshot
+            # was copied and dropped (the fixed pattern): stay silent.
+            if sys.getrefcount(arr) <= (3 if is_cache else 2):
+                continue
+            if _poison_array(arr):
+                origins.append(origin)
+    if origins:
+        warnings.warn(
+            f"GNOT_ALIAS_GUARD=poison: {len(origins)} stale host view(s) "
+            f"of buffers donated to {donor} poisoned with the NaN "
+            f"sentinel; views created at: " + ", ".join(sorted(set(origins))),
+            stacklevel=3,
+        )
+
+
+def _poison_array(arr: np.ndarray) -> bool:
+    """Overwrite a (read-only, zero-copy) view's memory with 0xFF —
+    NaN for float dtypes, -1/garbage for ints — via ctypes: numpy
+    refuses the write (the view is correctly marked read-only), but
+    the memory is ours and its contents are UNDEFINED post-donation
+    anyway; the sentinel just makes every reader notice."""
+    if not arr.flags["C_CONTIGUOUS"] or arr.nbytes == 0:
+        return False
+    try:
+        ptr = arr.__array_interface__["data"][0]
+        ctypes.memset(ptr, 0xFF, arr.nbytes)
+        return True
+    except Exception:  # pragma: no cover — exotic buffer layouts
+        return False
+
+
+def stale_view_count() -> int:
+    """Registered (not yet poisoned) views — test/triage introspection."""
+    return sum(
+        1
+        for slot in _views.values()
+        for ref, _, _ in slot
+        if ref() is not None
+    )
+
+
+def clear_registry() -> None:
+    """Drop all registered views (test isolation)."""
+    _views.clear()

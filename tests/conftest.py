@@ -72,3 +72,16 @@ if not (os.environ.get("GNOT_COMPILE_CACHE") or os.environ.get("GNOT_TEST_CACHE"
     if seeded:
         os.environ["GNOT_COMPILE_CACHE"] = seeded
 enable_compile_cache()
+
+# Donation alias guard ON for tier-1 (ISSUE 11): GNOT_ALIAS_GUARD
+# defaults to copy mode, so jax.device_get returns BY-VALUE snapshots
+# and the nine-times-root-caused test-side use-after-donate (PR 6/7/10
+# parity failures — docs/parallelism.md ledger) cannot recur through
+# the device_get channel (np.asarray-seeded views remain GL006's
+# static territory — docs/robustness.md "The donation sanitizer"). An
+# explicit GNOT_ALIAS_GUARD=0 (or =poison, for triage) still wins.
+# utils/sanitizer.py; the committed overhead A/B pins the cost.
+os.environ.setdefault("GNOT_ALIAS_GUARD", "1")
+from gnot_tpu.utils import sanitizer
+
+sanitizer.install()

@@ -71,6 +71,31 @@ def test_tracing_ab_artifact_schema():
     assert summary["ms_per_step_on"] == arms["tracing_on"]["ms_per_step"]
 
 
+def test_sanitizer_ab_artifact_schema():
+    """The committed donation-sanitizer A/B (tools/sanitizer_ab.py):
+    three timed arms plus a summary meeting both ISSUE 11 bars —
+    guard-off within an honest noise window of a never-installed
+    baseline (|frac| <= 10%; the off arm runs the SAME machine code,
+    byte-identity is unit-proven by test_off_mode_is_byte_identical,
+    so a regenerated artifact must not flake on timing-noise sign) and
+    copy mode bounded (<=10% at snapshot_every=10)."""
+    path = os.path.join(ARTIFACT_DIR, "sanitizer_overhead_ab.jsonl")
+    with open(path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    arms = {r["arm"]: r for r in recs if "arm" in r}
+    assert set(arms) == {"baseline", "guard_off", "guard_copy"}
+    for r in arms.values():
+        assert r["ms_per_step"] > 0 and r["snapshot_every"] == 10
+    (summary,) = [r for r in recs if r.get("summary") == "sanitizer_overhead"]
+    assert isinstance(summary["off_vs_baseline_frac"], float)
+    assert abs(summary["off_vs_baseline_frac"]) <= 0.10
+    assert isinstance(summary["copy_overhead_frac"], float)
+    assert summary["copy_overhead_frac"] <= 0.10
+    assert (
+        summary["ms_per_step_copy"] == arms["guard_copy"]["ms_per_step"]
+    )
+
+
 def test_pack_ab_artifact_schema():
     """The committed packing A/B (tools/pack_ab.py): four measured arms
     plus a summary meeting the ISSUE 6 acceptance bar — pad waste DOWN

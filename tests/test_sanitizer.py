@@ -1,0 +1,214 @@
+"""Donation sanitizer (utils/sanitizer.py): mode selection, by-value
+guarded device_get, poison-mode forensics, and the guard-off
+byte-identity contract (ISSUE 11).
+
+Tier-1 itself runs with GNOT_ALIAS_GUARD=1 (tests/conftest.py), so
+every test here that flips the mode restores the ambient one.
+"""
+
+import functools
+import os
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gnot_tpu.utils import sanitizer
+
+
+@pytest.fixture
+def set_mode():
+    """Flip GNOT_ALIAS_GUARD + reinstall; restore the ambient mode
+    (tier-1's copy mode) afterwards."""
+    prev = os.environ.get("GNOT_ALIAS_GUARD")
+
+    def _set(value: str) -> str:
+        os.environ["GNOT_ALIAS_GUARD"] = value
+        return sanitizer.install()
+
+    yield _set
+    if prev is None:
+        os.environ.pop("GNOT_ALIAS_GUARD", None)
+    else:
+        os.environ["GNOT_ALIAS_GUARD"] = prev
+    sanitizer.install()
+    sanitizer.clear_registry()
+
+
+def _donating_step():
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(x):
+        return x + 1.0
+
+    return step
+
+
+def test_mode_parsing(set_mode):
+    assert set_mode("0") == "off"
+    assert set_mode("off") == "off"
+    assert set_mode("1") == "copy"
+    assert set_mode("copy") == "copy"
+    assert set_mode("on") == "copy"
+    assert set_mode("poison") == "poison"
+
+
+def test_copy_mode_device_get_is_by_value(set_mode):
+    """Guarded device_get returns OWNED arrays: no later donation can
+    touch the snapshot — the bug class is gone by construction."""
+    assert set_mode("1") == "copy"
+    x = jnp.arange(4096, dtype=jnp.float32)
+    tree = {"a": x, "b": jnp.ones((8, 8), jnp.float32)}
+    host = jax.device_get(tree)
+    for leaf in jax.tree.leaves(host):
+        assert isinstance(leaf, np.ndarray)
+        assert leaf.flags.owndata, "copy mode must return owned memory"
+    before = np.array(host["a"])
+    step = _donating_step()
+    step(x)  # donate x's buffers
+    np.testing.assert_array_equal(host["a"], before)
+
+
+def test_off_mode_is_byte_identical(set_mode):
+    """Guard off: jax.device_get is the ORIGINAL function object and
+    guard_donating returns the callable itself — zero wrapper frames,
+    zero behavior change (the A/B artifact pins the measured side)."""
+    assert set_mode("0") == "off"
+    assert jax.device_get is sanitizer._orig_device_get
+    step = _donating_step()
+    assert sanitizer.guard_donating(step) is step
+    x = jnp.arange(1024, dtype=jnp.float32)
+    host = jax.device_get(x)
+    # Off mode preserves today's zero-copy semantics (the view, not a
+    # copy) on the CPU backend.
+    assert not host.flags.owndata
+
+
+def test_copy_mode_guard_donating_is_identity(set_mode):
+    """Copy mode needs no dispatch wrapper (there are no views to
+    poison): the hot path stays the bare jitted callable."""
+    assert set_mode("1") == "copy"
+    step = _donating_step()
+    assert sanitizer.guard_donating(step) is step
+
+
+def test_poison_mode_stale_view_turns_nan(set_mode):
+    """The forensic contract: a zero-copy device_get view that
+    survives a donating dispatch is overwritten with the NaN sentinel
+    and the warning names the view's creation site."""
+    assert set_mode("poison") == "poison"
+    sanitizer.clear_registry()
+    x = jnp.arange(4096, dtype=jnp.float32) + 1.0
+    host = jax.device_get(x)  # zero-copy view, registered
+    if host.flags.owndata:  # pragma: no cover — non-zero-copy backend
+        pytest.skip("backend returned a copy; nothing to poison")
+    assert sanitizer.stale_view_count() == 1
+    step = sanitizer.guard_donating(_donating_step())
+    with pytest.warns(UserWarning, match="stale host view"):
+        step(x)
+    # The stale read below IS the poison-mode contract under test.
+    assert np.all(np.isnan(host))  # graftlint: disable=GL006 — deliberate use-after-donate fixture
+    assert sanitizer.stale_view_count() == 0
+
+
+def test_poison_mode_copied_snapshot_untouched(set_mode):
+    """The committed fix pattern (np.array copies) must sail through
+    poison mode: owned memory is never registered, never poisoned."""
+    assert set_mode("poison") == "poison"
+    sanitizer.clear_registry()
+    x = jnp.arange(1024, dtype=jnp.float32)
+    snap = np.array(jax.device_get(x))
+    before = np.array(snap)
+    step = sanitizer.guard_donating(_donating_step())
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any poison warning -> failure
+        step(x)
+    np.testing.assert_array_equal(snap, before)
+
+
+def test_poison_mode_rebound_view_is_not_poisoned(set_mode):
+    """Views of buffers NOT donated stay intact: donation poisons only
+    the donated argument's registered views."""
+    assert set_mode("poison") == "poison"
+    sanitizer.clear_registry()
+    x = jnp.arange(512, dtype=jnp.float32)
+    other = jnp.ones(512, jnp.float32) * 7.0
+    host_other = jax.device_get(other)
+    step = sanitizer.guard_donating(_donating_step())
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        step(x)
+    np.testing.assert_array_equal(
+        np.asarray(host_other), np.full(512, 7.0, np.float32)
+    )
+
+
+def test_host_fetch_modes(set_mode):
+    x = jnp.ones((16, 16), jnp.float32)
+    assert set_mode("0") == "off"
+    off = sanitizer.host_fetch(x)
+    assert isinstance(off, np.ndarray)
+    assert set_mode("1") == "copy"
+    copied = sanitizer.host_fetch(x)
+    assert copied.flags.owndata
+    np.testing.assert_array_equal(copied, np.asarray(off))
+
+
+def test_poison_wrapper_disarms_with_the_mode(set_mode):
+    """A step wrapped under poison must go fully inert when install()
+    leaves poison: no memsets, no warnings, registry dropped — the
+    off-mode contract holds for already-built objects too."""
+    assert set_mode("poison") == "poison"
+    sanitizer.clear_registry()
+    step = sanitizer.guard_donating(_donating_step())
+    x = jnp.arange(1024, dtype=jnp.float32)
+    host = jax.device_get(x)  # registered under poison
+    assert set_mode("0") == "off"
+    assert sanitizer.stale_view_count() == 0  # registry cleared on exit
+    before = np.array(host)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        step(x)  # wrapped object, disarmed mode: bare-step behavior
+    np.testing.assert_array_equal(np.asarray(host), before)  # graftlint: disable=GL006 — deliberate: asserts the DISARMED guard no longer poisons this stale view
+
+
+def test_late_poison_install_warns_about_unguarded_builds(set_mode):
+    """Arming poison AFTER donating dispatches were built unguarded is
+    a silent no-op for those objects — install() must say so."""
+    assert set_mode("1") == "copy"
+    step = _donating_step()
+    assert sanitizer.guard_donating(step) is step  # built unguarded
+    with pytest.warns(UserWarning, match="built\\s+unguarded"):
+        assert set_mode("poison") == "poison"
+
+
+def test_guard_donating_forwards_cache_size(set_mode):
+    """The recompile monitor keys on _cache_size; the poison wrapper
+    must not blind it."""
+    assert set_mode("poison") == "poison"
+    step = _donating_step()
+    wrapped = sanitizer.guard_donating(step)
+    assert wrapped is not step
+    assert callable(getattr(wrapped, "_cache_size", None)) == callable(
+        getattr(step, "_cache_size", None)
+    )
+
+
+def test_trainer_steps_identity_under_copy_mode(set_mode):
+    """Trainer.initialize routes its steps through guard_donating: in
+    tier-1's copy mode that is the bare jitted step (no wrapper), and
+    a fit() epoch trains normally with the guard live."""
+    assert set_mode("1") == "copy"
+    from tests.test_trainer import small_setup
+
+    cfg, mc, train, test = small_setup(epochs=1)
+    from gnot_tpu.train.trainer import Trainer
+
+    t = Trainer(cfg, mc, train, test)
+    t.initialize()
+    assert callable(getattr(t.train_step, "_cache_size", None)), (
+        "copy mode must keep the bare jitted step"
+    )
+    t.fit()
+    assert np.isfinite(t.best_metric)

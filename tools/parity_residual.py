@@ -87,7 +87,7 @@ def main():
     exact = (A.astype(np.float64) @ B.astype(np.float64)).astype(np.float32)
     for prec in ("default", "highest"):
         with jax.default_matmul_precision(prec):
-            chip = np.asarray(jax.jit(jnp.dot)(A, B))
+            chip = np.asarray(jax.jit(jnp.dot)(A, B))  # graftlint: disable=GL003 — two-precision diagnostic: compiles exactly twice by design
         print(f"matmul [{m}x{k}x{n}] f32 {prec}: max|err| = "
               f"{np.max(np.abs(chip - exact)):.3e} "
               f"(rel {np.max(np.abs(chip - exact)) / np.max(np.abs(exact)):.3e})")
