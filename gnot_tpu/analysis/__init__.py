@@ -47,5 +47,6 @@ from gnot_tpu.analysis import aliasing  # noqa: F401
 from gnot_tpu.analysis import donation  # noqa: F401
 from gnot_tpu.analysis import hostsync  # noqa: F401
 from gnot_tpu.analysis import locks  # noqa: F401
+from gnot_tpu.analysis import native_abi  # noqa: F401
 from gnot_tpu.analysis import recompile  # noqa: F401
 from gnot_tpu.analysis import registry_drift  # noqa: F401

@@ -96,6 +96,10 @@ class LintConfig:
     faults_registry: str = "gnot_tpu/resilience/faults.py"
     docs_events: str = "docs/observability.md"
     docs_faults: str = "docs/robustness.md"
+    # GL007: the ctypes bindings module and the C source whose
+    # extern "C" declarations it must match (arity + dtype tags).
+    native_binding: str = "gnot_tpu/native/__init__.py"
+    native_source: str = "gnot_tpu/native/ragged_pack.cpp"
 
     def rule_enabled(self, rule_id: str) -> bool:
         if rule_id in self.disable:

@@ -363,6 +363,14 @@ class ServeConfig:
     # the router treats a replica as wedged and drains its traffic to
     # siblings.
     wedge_after_s: float = 2.0
+    # Serving compute dtype (models/precision.py): "float32" (the
+    # historical path, byte-identical) or "bfloat16" — the block stack
+    # computes bf16 with f32 einsum accumulation, an f32 attention
+    # normalizer and an f32 output head; params stay f32 at rest and
+    # the engine publishes a cast copy per reload. Program identity
+    # (bucket signatures, PackPlan programs, AOT manifests) is
+    # dtype-keyed, so a bf16 deployment refuses f32 snapshots.
+    dtype: str = "float32"
     # Deploy-time AOT prewarm manifest (tools/aot_prewarm.py,
     # docs/serving.md "Deploy-time prewarm"): when set, serving
     # hydrates each engine's executables from the manifest's
@@ -404,6 +412,12 @@ class ServeConfig:
         if self.breaker_threshold < 1:
             raise ValueError(
                 f"breaker_threshold must be >= 1, got {self.breaker_threshold}"
+            )
+        from gnot_tpu.models.precision import SERVE_DTYPES
+
+        if self.dtype not in SERVE_DTYPES:
+            raise ValueError(
+                f"unknown serve dtype {self.dtype!r}; one of {SERVE_DTYPES}"
             )
 
 

@@ -185,6 +185,7 @@ def collate(
     bucket: bool = True,
     pad_nodes: int = 0,
     pad_funcs: int = 0,
+    dtype: str = "float32",
 ) -> MeshBatch:
     """Pad and stack ragged samples into a dense MeshBatch.
 
@@ -197,7 +198,11 @@ def collate(
     The packing hot loop runs in the native C++ packer
     (``gnot_tpu/native/ragged_pack.cpp``) when available: one
     memcpy+memset sweep per field with the mask written in the same
-    pass; pure-numpy fallback otherwise (identical output)."""
+    pass; pure-numpy fallback otherwise (identical output).
+    ``dtype="bfloat16"`` is the serving low-precision path: the native
+    sweep FUSES the pad with the f32->bf16 cast, so the dispatch batch
+    is assembled half-width in one pass (training always collates
+    f32)."""
     from gnot_tpu import native
 
     if pad_nodes:
@@ -207,9 +212,12 @@ def collate(
         if bucket:
             max_nodes = bucket_length(max_nodes)
 
-    coords, node_mask = native.pack_rows([s.coords for s in samples], max_nodes)
-    y, _ = native.pack_rows([s.y for s in samples], max_nodes)
+    coords, node_mask = native.pack_rows(
+        [s.coords for s in samples], max_nodes, dtype
+    )
+    y, _ = native.pack_rows([s.y for s in samples], max_nodes, dtype)
     theta = np.stack([np.atleast_1d(np.asarray(s.theta, np.float32)) for s in samples])
+    theta = theta.astype(coords.dtype, copy=False)
 
     n_funcs = len(samples[0].funcs)
     funcs = func_mask = None
@@ -223,7 +231,7 @@ def collate(
             if bucket:
                 max_f = bucket_length(max_f)
         packed = [
-            native.pack_rows([s.funcs[j] for s in samples], max_f)
+            native.pack_rows([s.funcs[j] for s in samples], max_f, dtype)
             for j in range(n_funcs)
         ]
         funcs = np.stack([p[0] for p in packed])
@@ -248,23 +256,29 @@ def pack_collate(
     chunk: int,
     n_slots: int,
     pad_funcs: int,
+    dtype: str = "float32",
 ) -> PackedBatch:
     """Assemble one PackedBatch from samples + their (row, offset)
     placements (offsets chunk-aligned; produced by ``PackedLoader``).
-    Slot ids are assignment order; unused rows/slots stay zero/pad."""
+    Slot ids are assignment order; unused rows/slots stay zero/pad.
+    ``dtype="bfloat16"``: float fields assemble half-width (the bf16
+    packed serving dispatch); segment id maps stay int32."""
+    from gnot_tpu.models.precision import np_dtype
+
+    ft = np_dtype(dtype)
     dx = samples[0].coords.shape[-1]
     dy = samples[0].y.shape[-1]
     n_funcs = len(samples[0].funcs)
-    coords = np.zeros((n_rows, row_len, dx), np.float32)
-    y = np.zeros((n_rows, row_len, dy), np.float32)
-    node_mask = np.zeros((n_rows, row_len), np.float32)
+    coords = np.zeros((n_rows, row_len, dx), ft)
+    y = np.zeros((n_rows, row_len, dy), ft)
+    node_mask = np.zeros((n_rows, row_len), ft)
     node_seg = np.full((n_rows, row_len // chunk), n_slots, np.int32)
-    theta = np.zeros((n_slots, np.atleast_1d(samples[0].theta).shape[-1]), np.float32)
+    theta = np.zeros((n_slots, np.atleast_1d(samples[0].theta).shape[-1]), ft)
     funcs = func_mask = func_seg = None
     if n_funcs:
         df = samples[0].funcs[0].shape[-1]
-        funcs = np.zeros((n_funcs, n_slots, pad_funcs, df), np.float32)
-        func_mask = np.zeros((n_funcs, n_slots, pad_funcs), np.float32)
+        funcs = np.zeros((n_funcs, n_slots, pad_funcs, df), ft)
+        func_mask = np.zeros((n_funcs, n_slots, pad_funcs), ft)
         func_seg = np.full((n_slots, 1), n_slots, np.int32)
     for slot, (s, (r, off)) in enumerate(zip(samples, placements)):
         n = s.coords.shape[0]
@@ -633,6 +647,7 @@ class Loader:
         prefetch: int = 2,
         pad_nodes: int = 0,
         pad_funcs: int = 0,
+        dtype: str = "float32",
     ):
         self.samples = list(samples)
         self.batch_size = batch_size
@@ -642,6 +657,9 @@ class Loader:
         self.prefetch = prefetch
         self.pad_nodes = pad_nodes
         self.pad_funcs = pad_funcs
+        # Collate dtype: float32 for training (always); the serving
+        # engine's offline path passes its own serve dtype through.
+        self.dtype = dtype
         self.seed = seed
         # Epoch counter for shuffling: each epoch's order is a pure
         # function of (seed, epoch), so a resumed run at epoch N sees
@@ -679,6 +697,7 @@ class Loader:
             bucket=self.bucket,
             pad_nodes=self.pad_nodes,
             pad_funcs=self.pad_funcs,
+            dtype=self.dtype,
         )
 
     def __iter__(self) -> Iterator[MeshBatch]:

@@ -200,6 +200,21 @@ def build_parser() -> argparse.ArgumentParser:
         help="serving: packed-mode segment alignment in tokens "
              "(multiple of 8)"
     )
+    from gnot_tpu.models.precision import SERVE_DTYPES
+
+    p.add_argument(
+        "--serve_dtype", type=str, default="float32",
+        choices=list(SERVE_DTYPES),
+        help="serving compute dtype (models/precision.py): bfloat16 "
+             "runs the block stack in bf16 with f32 einsum "
+             "accumulation, an f32 attention normalizer and an f32 "
+             "output head; params stay f32 at rest (the engine "
+             "publishes a cast copy per reload), batches assemble "
+             "half-width through the native fused pad-and-cast "
+             "packer, and every program/bucket/AOT-manifest key is "
+             "dtype-keyed (docs/performance.md 'Low-precision "
+             "serving')"
+    )
     p.add_argument(
         "--serve_replicas", type=int, default=1,
         help="serving: engine replicas behind the compile-affinity "
@@ -396,6 +411,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "serve.inject_fault": args.serve_inject_fault,
             "serve.packed": args.serve_packed,
             "serve.pack_chunk": args.serve_pack_chunk,
+            "serve.dtype": args.serve_dtype,
             "serve.replicas": args.serve_replicas,
             "serve.route_policy": args.route_policy,
             "serve.prewarm_manifest": args.serve_prewarm,
@@ -872,15 +888,39 @@ def _run_serve(
             bucket=cfg.data.bucket,
             pad_nodes=tl.pad_nodes,
             pad_funcs=tl.pad_funcs,
+            dtype=sc.dtype,
         )
     else:
-        engine = trainer.inference_engine()
+        engine = trainer.inference_engine(dtype=sc.dtype)
+    # One-time native-packer attribution (satellite of the dispatch
+    # hot-path work): whether batch assembly/unpad run the C++ packer
+    # or the Python fallback, as an event AND a run.json field — a
+    # bench artifact from this run names the path that produced it.
+    from gnot_tpu import native
+    from gnot_tpu.obs import events as events_lib
+
+    packer = native.status()
+    if sink is not None:
+        sink.log(
+            event=events_lib.NATIVE_PACKER,
+            available=packer["available"],
+            impl=packer["impl"],
+            pack_native_min_bytes=packer["pack_native_min_bytes"],
+            unpad_native_min_bytes=packer["unpad_native_min_bytes"],
+            **({"so": packer["so"]} if packer["so"] else {}),
+            **({"error": packer["error"]} if packer["error"] else {}),
+        )
+    if manifest_extra is not None:
+        manifest_extra["native_packer"] = packer
+        manifest_extra["serve_dtype"] = sc.dtype
     prewarm = None
     if sc.prewarm_manifest:
         # Deploy-time AOT prewarm (serve/aot.py): validate the
         # manifest against this topology up front — snapshots are
         # device-assignment-bound, so a manifest compiled for a
-        # different replica count cannot hydrate this pool.
+        # different replica count cannot hydrate this pool; and
+        # dtype-bound, so a manifest compiled at another serving
+        # dtype is the wrong program family, not a warm one.
         from gnot_tpu.serve import aot
 
         prewarm = aot.load_manifest(sc.prewarm_manifest)
@@ -891,6 +931,13 @@ def _run_serve(
                 f"{prewarm['replicas']} replicas; this run serves "
                 f"{expect} — re-run tools/aot_prewarm.py for the "
                 "target topology"
+            )
+        if prewarm.get("dtype", "float32") != sc.dtype:
+            raise ValueError(
+                f"--serve_prewarm manifest was compiled at serve "
+                f"dtype {prewarm.get('dtype', 'float32')!r}; this run "
+                f"serves {sc.dtype!r} — re-run tools/aot_prewarm.py "
+                "with the matching --serve_dtype"
             )
     with PreemptionHandler() as preempt:
         common = dict(

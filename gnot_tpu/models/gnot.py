@@ -232,15 +232,27 @@ def block_module(
 
 
 def out_module(cfg: ModelConfig) -> Mlp:
-    """Output projection MLP (model.py:152,171)."""
+    """Output projection MLP (model.py:152,171). ALWAYS f32
+    (``dtype=None`` + the f32 input cast in ``finalize_input``): the
+    head feeds RelL2 directly, so the precision policy
+    (models/precision.py) keeps it out of the reduced-precision block
+    stack. No-op for f32 configs, where ``model_dtype`` is None
+    anyway."""
     return Mlp(
         cfg.n_mlp_num_layers,
         cfg.n_mlp_hidden_dim,
         cfg.out_dim,
-        dtype=model_dtype(cfg),
+        dtype=None,
         gelu=cfg.gelu,
         name="out_mlp",
     )
+
+
+def finalize_input(query: Array) -> Array:
+    """The encoder->head boundary: whatever dtype the block stack
+    computed in, the output head reads f32 (a same-dtype cast XLA
+    elides for f32 configs)."""
+    return query.astype(jnp.float32)
 
 
 def finalize_output(out: Array) -> Array:
@@ -339,4 +351,4 @@ class GNOT(nn.Module):
                 node_seg_oh=node_seg_oh, func_seg_oh=func_seg_oh,
             )
 
-        return finalize_output(out_module(cfg)(query))
+        return finalize_output(out_module(cfg)(finalize_input(query)))

@@ -1,0 +1,149 @@
+"""Serving precision policy: WHERE reduced precision is safe, in code.
+
+GNOT's linear attention is matmul-dominated — the ideal bf16 target on
+matrix hardware — but its softmax-normalized queries are exactly the
+normalization-sensitive structure Cao's Fourier/Galerkin analysis
+(arXiv 2105.14995) warns about: the output is ``alpha * q @ (k^T v)``
+with ``alpha = 1 / <q, k_sum>``, so any precision loss in the
+normalizer multiplies EVERY output channel. Flipping one dtype flag is
+therefore not a policy; this module is. It pins, as data the rest of
+the stack threads through:
+
+* **compute dtype** — the per-block matmul/activation dtype (the knob
+  ``serve.dtype`` flips; flax modules receive it as their ``dtype``);
+* **f32 accumulation** — attention einsums contract with an explicit
+  ``preferred_element_type=float32`` so Gram/k_sum reductions never
+  accumulate in bf16 (``ops/attention.py`` reads the input dtype and
+  applies this; on TPU the MXU accumulates f32 natively, so this costs
+  nothing there);
+* **f32 normalizer** — ``<q, k_sum>`` and the ``1/x`` that follows are
+  computed in f32 ALWAYS (never the compute dtype); the mutation test
+  in tests/test_lowprec.py demonstrates what a bf16 normalizer does to
+  parity;
+* **f32 output head** — the final MLP feeds the RelL2 metric directly;
+  it runs on f32 inputs with f32 params (``models/gnot.py::out_module``
+  forces it when the block stack computes in bf16).
+
+Params stay f32 AT REST everywhere (training state, checkpoints, hot
+reload): the serving engine casts a bf16 copy at publish time
+(``InferenceEngine.swap_params`` -> :func:`cast_params`), so
+train/serve weight sharing and reload are untouched by the serving
+dtype.
+
+``int8`` weight-only for the FFN experts is the designed-for next step
+behind the same policy object (``weights_dtype`` is separate from
+``compute_dtype`` for exactly that reason); it is not wired yet.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+#: The serving dtypes the stack accepts end-to-end. Every program name,
+#: manifest and event uses the SHORT tag (program identity must be
+#: dtype-keyed but also stable and readable).
+SERVE_DTYPES = ("float32", "bfloat16")
+DTYPE_TAGS = {"float32": "f32", "bfloat16": "bf16"}
+
+
+@dataclasses.dataclass(frozen=True)
+class PrecisionPolicy:
+    """One serving precision mode, as explicit per-site dtypes.
+
+    ``accum_dtype``, ``normalizer_dtype`` and ``head_dtype`` are
+    float32 by POLICY — ``__post_init__`` refuses anything else, so a
+    future dtype cannot silently widen into the RelL2-critical sites.
+    """
+
+    compute_dtype: str = "float32"  # per-block matmuls + activations
+    weights_dtype: str = "float32"  # published (serving) weight copy
+    accum_dtype: str = "float32"  # attention einsum accumulation
+    normalizer_dtype: str = "float32"  # <q, k_sum> and 1/x
+    head_dtype: str = "float32"  # output MLP (RelL2-critical)
+
+    def __post_init__(self) -> None:
+        if self.compute_dtype not in SERVE_DTYPES:
+            raise ValueError(
+                f"unknown serve dtype {self.compute_dtype!r}; one of "
+                f"{SERVE_DTYPES}"
+            )
+        for site in ("accum_dtype", "normalizer_dtype", "head_dtype"):
+            if getattr(self, site) != "float32":
+                raise ValueError(
+                    f"{site} must stay float32 (the precision policy's "
+                    "point — see models/precision.py docstring); got "
+                    f"{getattr(self, site)!r}"
+                )
+
+    @property
+    def tag(self) -> str:
+        """Short dtype tag for program keys / manifests ("f32"/"bf16")."""
+        return DTYPE_TAGS[self.compute_dtype]
+
+    def table(self) -> list[tuple[str, str, str]]:
+        """(site, dtype, why) rows — the docs/performance.md policy
+        table renders from this so docs cannot drift from code."""
+        return [
+            ("block matmuls + activations", self.compute_dtype,
+             "the throughput knob; matmul-dominated, bf16-safe"),
+            ("published weight copy", self.weights_dtype,
+             "cast once at publish; params stay f32 at rest"),
+            ("attention einsum accumulation", self.accum_dtype,
+             "Gram/k_sum reductions; bf16 accumulation loses the "
+             "normalization property"),
+            ("attention normalizer <q,k_sum>, 1/x", self.normalizer_dtype,
+             "multiplies every output channel (2105.14995)"),
+            ("output head MLP", self.head_dtype,
+             "feeds RelL2 directly"),
+        ]
+
+
+def policy_for(dtype: str) -> PrecisionPolicy:
+    """The serving policy for a ``serve.dtype`` value."""
+    if dtype not in SERVE_DTYPES:
+        raise ValueError(
+            f"unknown serve dtype {dtype!r}; one of {SERVE_DTYPES}"
+        )
+    return PrecisionPolicy(compute_dtype=dtype, weights_dtype=dtype)
+
+
+def np_dtype(dtype: str):
+    """The numpy dtype object for a serve dtype (bfloat16 rides
+    ml_dtypes, which jax already depends on — no new dependency)."""
+    if dtype == "bfloat16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(np.float32)
+
+
+def cast_params(params, dtype: str):
+    """A ``dtype`` copy of a param tree for publish: float leaves cast
+    (f32 -> bf16 halves the published weight bytes), non-float leaves
+    pass through untouched. Identity (the SAME tree object) for
+    float32 — the f32 serving path stays byte-identical."""
+    if dtype == "float32":
+        return params
+    import jax
+
+    target = np_dtype(dtype)
+
+    def cast(leaf):
+        dt = getattr(leaf, "dtype", None)
+        if dt is not None and np.issubdtype(np.dtype(dt), np.floating):
+            return leaf.astype(target)
+        return leaf
+
+    return jax.tree.map(cast, params)
+
+
+def serve_model(model, dtype: str):
+    """The model to SERVE at ``dtype``: the same architecture with the
+    policy's compute dtype threaded per-block (flax ``dtype`` — params
+    keep their own dtype; computation casts). Identity for float32 or
+    when the model already computes at ``dtype``."""
+    if dtype == "float32" or model.config.dtype == dtype:
+        return model
+    return type(model)(dataclasses.replace(model.config, dtype=dtype))

@@ -3,9 +3,23 @@
 The reference has no native code of its own (SURVEY.md §2: all native
 execution lives in the torch/DGL wheels), so this layer is a
 capability superset: the host-side ragged->dense packer that feeds the
-TPU. Built on first import with g++ (cached as a .so next to the
-source); every entry point has a pure-numpy fallback so the framework
-works with no toolchain.
+TPU, the fused pad-and-cast variant the bf16 serving path dispatches
+through, and the batched unpad/scatter that hands each response its
+own rows in one call. Built on first import with g++ (cached as a .so
+next to the source); every entry point has a pure-numpy fallback so
+the framework works with no toolchain — and the fallbacks are
+BIT-EXACT (tests/test_native.py pins it), so which implementation ran
+never changes an answer, only its cost.
+
+Whether the .so actually loaded is observable: :func:`status` is the
+one probe; serving emits it as the one-time ``native_packer`` event
+and records it in ``run.json`` so committed bench artifacts are
+attributable to the path that produced them.
+
+The ctypes signatures below are cross-checked against the C symbol
+declarations in ``ragged_pack.cpp`` by graftlint rule GL007 (arity +
+dtype tags) on every lint run — the .so cannot drift from its Python
+caller silently.
 """
 
 from __future__ import annotations
@@ -23,12 +37,69 @@ _SO = os.path.join(_HERE, "_ragged_pack.so")
 
 _lock = threading.Lock()
 _lib = None
+_lib_gil = None
 _load_failed = False
+_load_error: str | None = None
+
+#: Payloads under this run through the GIL-HOLDING handle (PyDLL): a
+#: sub-millisecond memory sweep must not pay a GIL release/reacquire
+#: round-trip — under a live serve storm the reacquisition contends
+#: with the submitting client thread and costs more than the sweep
+#: (measured; docs/performance.md round 12). Above it (the threaded
+#: multi-MB train-collate regime) the CDLL handle releases the GIL so
+#: a long pack never stalls the interpreter.
+GIL_HOLD_MAX_BYTES = 2 << 20
+
+
+def _bf16():
+    """numpy's bfloat16 via ml_dtypes (a jax dependency, not a new
+    one); imported lazily so the packer has no import-time cost."""
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def _bind(lib):
+    """Attach the ctypes signatures to one dlopen handle. GL007
+    cross-checks these against ragged_pack.cpp's extern "C"
+    declarations (arity + dtype tags) on every lint run."""
+    lib.gnot_pack_rows.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+    ]
+    lib.gnot_pack_rows.restype = None
+    lib.gnot_pack_rows_bf16.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+    ]
+    lib.gnot_pack_rows_bf16.restype = None
+    lib.gnot_unpad_rows.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+    lib.gnot_unpad_rows.restype = None
+    return lib
 
 
 def _load():
     """Build (if stale) and dlopen the packer; returns None on failure."""
-    global _lib, _load_failed
+    global _lib, _lib_gil, _load_failed, _load_error
     if _lib is not None or _load_failed:
         return _lib
     with _lock:
@@ -41,50 +112,109 @@ def _load():
                 # Per-process tmp name: concurrent first-builds must not
                 # interleave writes; os.replace stays atomic.
                 tmp = f"{_SO}.{os.getpid()}.tmp"
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
-                    check=True,
-                    capture_output=True,
-                )
+                # -march=native is safe BY CONSTRUCTION: the .so is
+                # built on first import on the machine that runs it
+                # (never shipped), and it is what lets -O3 vectorize
+                # the bf16 conversion sweep.
+                # -fno-strict-aliasing: the bf16 sweep reads float bits
+                # through a uint32 pointer (the form -O3 vectorizes).
+                cmd = ["g++", "-O3", "-march=native", "-fno-strict-aliasing",
+                       "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp]
+                try:
+                    subprocess.run(cmd, check=True, capture_output=True)
+                except subprocess.CalledProcessError:
+                    # Exotic toolchains may lack -march=native; the
+                    # portable build is still correct, just slower.
+                    cmd.remove("-march=native")
+                    subprocess.run(cmd, check=True, capture_output=True)
                 os.replace(tmp, _SO)
-            lib = ctypes.CDLL(_SO)
-            lib.gnot_pack_rows.argtypes = [
-                ctypes.POINTER(ctypes.c_void_p),
-                ctypes.POINTER(ctypes.c_int64),
-                ctypes.c_int64,
-                ctypes.c_int64,
-                ctypes.c_int64,
-                ctypes.c_void_p,
-                ctypes.c_void_p,
-            ]
-            lib.gnot_pack_rows.restype = None
-            _lib = lib
-        except (OSError, subprocess.CalledProcessError):
+            # Two handles on one .so: CDLL releases the GIL per call
+            # (long threaded packs), PyDLL holds it (sub-ms serve-sized
+            # sweeps — see GIL_HOLD_MAX_BYTES).
+            _lib_gil = _bind(ctypes.PyDLL(_SO))
+            _lib = _bind(ctypes.CDLL(_SO))
+        except (OSError, subprocess.CalledProcessError, AttributeError) as err:
             _load_failed = True
+            _load_error = f"{type(err).__name__}: {err}"
     return _lib
+
+
+def _handle(payload_bytes: int):
+    """The dlopen handle for one call: GIL-holding under the payload
+    bar, GIL-releasing above it. ``_load()`` must have succeeded."""
+    return _lib_gil if payload_bytes < GIL_HOLD_MAX_BYTES else _lib
 
 
 def native_available() -> bool:
     return _load() is not None
 
 
+def status() -> dict:
+    """One attributability record: which packer implementation this
+    process runs (and why, when it fell back). Emitted as the
+    ``native_packer`` event by serving and stamped into ``run.json`` —
+    a bench artifact produced on the Python fallback must say so.
+    ``impl: "native"`` means the .so loaded AND dispatch is the
+    payload-gated ADAPTIVE policy — the thresholds are part of the
+    record, so a reader can tell which payload classes actually ran
+    the C sweep (below the bars the numpy fallback is the chosen fast
+    path, by measurement, not by accident)."""
+    lib = _load()
+    return {
+        "available": lib is not None,
+        "impl": "native" if lib is not None else "python",
+        "so": _SO if lib is not None else None,
+        "error": _load_error,
+        "pack_native_min_bytes": dict(PACK_NATIVE_MIN_BYTES),
+        "unpad_native_min_bytes": NATIVE_UNPAD_MIN_BYTES,
+    }
+
+
 def pack_rows_numpy(
-    arrs: list[np.ndarray], max_len: int
+    arrs: list[np.ndarray], max_len: int, dtype: str = "float32"
 ) -> tuple[np.ndarray, np.ndarray]:
     """Fallback: pad [len_i, dim] float32 blocks to [n, max_len, dim] +
-    [n, max_len] mask (zero pad at the row tail, reference utils.py:3-4)."""
+    [n, max_len] mask (zero pad at the row tail, reference utils.py:3-4).
+    ``dtype="bfloat16"`` emits both in bfloat16 (ml_dtypes RNE cast —
+    bitwise what the fused native sweep produces)."""
+    target = _bf16() if dtype == "bfloat16" else np.dtype(np.float32)
     n, dim = len(arrs), arrs[0].shape[1]
-    out = np.zeros((n, max_len, dim), np.float32)
-    mask = np.zeros((n, max_len), np.float32)
+    out = np.zeros((n, max_len, dim), target)
+    mask = np.zeros((n, max_len), target)
     for i, a in enumerate(arrs):
-        out[i, : a.shape[0]] = a
+        # Casting assignment: numpy/ml_dtypes converts in ONE pass (no
+        # full-width temp), the same RNE the fused native sweep does.
+        # Non-f32 input is normalized to f32 FIRST — the native path
+        # always reads f32 bits, so a wider input must round f64->f32
+        # ->bf16 on both paths or the bit-exactness contract breaks on
+        # double-rounding edge values.
+        out[i, : a.shape[0]] = np.ascontiguousarray(a, np.float32)
         mask[i, : a.shape[0]] = 1.0
     return out, mask
 
 
-def pack_rows(arrs: list[np.ndarray], max_len: int) -> tuple[np.ndarray, np.ndarray]:
+#: Minimum total payload (bytes of ragged f32 input) at which the
+#: native sweep beats the numpy fallback, PER DTYPE — measured on this
+#: box, not guessed (docs/performance.md "Low-precision serving",
+#: round 12). bf16: the fused pad-and-cast wins 1.2-1.9x from ~100 KB
+#: up (one vectorized pass vs numpy's cast-assign loop). f32: numpy's
+#: calloc + per-sample C-core memcpy is already optimal — the ctypes
+#: hop only pays once the 32 MB threading threshold makes the copy
+#: itself parallel. Below the bar the fallback IS the fast path;
+#: bitwise-identical either way (tests/test_native.py).
+PACK_NATIVE_MIN_BYTES = {"bfloat16": 96 << 10, "float32": 32 << 20}
+
+
+def pack_rows(
+    arrs: list[np.ndarray], max_len: int, dtype: str = "float32"
+) -> tuple[np.ndarray, np.ndarray]:
     """Pack ragged float32 row-blocks into a padded batch + mask, using
-    the C++ packer when available."""
+    the C++ packer where it measurably pays (``PACK_NATIVE_MIN_BYTES``).
+    ``dtype="bfloat16"`` is the FUSED pad-and-cast path: one native
+    sweep emits the half-width batch the bf16 serving program consumes
+    (no full-width intermediate, no second pass)."""
+    if dtype not in ("float32", "bfloat16"):
+        raise ValueError(f"pack_rows dtype must be float32|bfloat16, got {dtype!r}")
     dim = arrs[0].shape[1] if arrs[0].ndim == 2 else -1
     for a in arrs:
         if a.ndim != 2 or a.shape[1] != dim:
@@ -95,19 +225,32 @@ def pack_rows(arrs: list[np.ndarray], max_len: int) -> tuple[np.ndarray, np.ndar
     if too_long > max_len:
         raise ValueError(f"row block of {too_long} rows exceeds max_len={max_len}")
     lib = _load()
-    if lib is None:
-        return pack_rows_numpy(arrs, max_len)
+    payload = sum(a.shape[0] for a in arrs) * dim * 4
+    if lib is None or payload < PACK_NATIVE_MIN_BYTES[dtype]:
+        return pack_rows_numpy(arrs, max_len, dtype)
     n, dim = len(arrs), arrs[0].shape[1]
     contig = [np.ascontiguousarray(a, np.float32) for a in arrs]
-    out = np.empty((n, max_len, dim), np.float32)
-    mask = np.empty((n, max_len), np.float32)
-    srcs = (ctypes.c_void_p * n)(
-        *(a.ctypes.data_as(ctypes.c_void_p).value for a in contig)
+    target = _bf16() if dtype == "bfloat16" else np.dtype(np.float32)
+    # np.zeros, NOT np.empty: the C side writes payload + mask prefix
+    # only (caller contract in ragged_pack.cpp) — calloc's lazy zero
+    # pages make the pad tail free instead of a second full-width
+    # memset sweep.
+    out = np.zeros((n, max_len, dim), target)
+    mask = np.zeros((n, max_len), target)
+    # Pointer/length marshalling through two small numpy buffers: one
+    # C-call's worth of setup, no per-array ctypes object churn.
+    srcs = np.fromiter(
+        (a.__array_interface__["data"][0] for a in contig),
+        dtype=np.uintp, count=n,
     )
-    lens = (ctypes.c_int64 * n)(*(a.shape[0] for a in contig))
-    lib.gnot_pack_rows(
-        ctypes.cast(srcs, ctypes.POINTER(ctypes.c_void_p)),
-        ctypes.cast(lens, ctypes.POINTER(ctypes.c_int64)),
+    lens = np.fromiter(
+        (a.shape[0] for a in contig), dtype=np.int64, count=n
+    )
+    lib = _handle(payload)
+    fn = lib.gnot_pack_rows_bf16 if dtype == "bfloat16" else lib.gnot_pack_rows
+    fn(
+        srcs.ctypes.data_as(ctypes.POINTER(ctypes.c_void_p)),
+        lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         n,
         dim,
         max_len,
@@ -115,3 +258,71 @@ def pack_rows(arrs: list[np.ndarray], max_len: int) -> tuple[np.ndarray, np.ndar
         mask.ctypes.data_as(ctypes.c_void_p),
     )
     return out, mask
+
+
+#: Below this total payload the batched native unpad cannot amortize
+#: its ctypes marshalling (~10-25 us/call measured) against numpy's
+#: per-span C-core copies; above it the single native call (and, past
+#: 32 MB, its threading) wins. Measured crossover on this box —
+#: docs/performance.md "Low-precision serving" round 12.
+NATIVE_UNPAD_MIN_BYTES = 4 << 20
+
+
+def unpad_rows_numpy(
+    out: np.ndarray, spans: list[tuple[int, int, int]]
+) -> list[np.ndarray]:
+    """Fallback: per-span OWNED copies ``out[row, off:off+length]`` —
+    byte-identical to the native scatter (same bytes, same order),
+    just one numpy copy per span instead of one batched call."""
+    return [out[r, off : off + length].copy() for r, off, length in spans]
+
+
+def unpad_rows(
+    out: np.ndarray, spans: list[tuple[int, int, int]]
+) -> list[np.ndarray]:
+    """Batched unpad/scatter: slice each request's ``[length, dim]``
+    block out of a dense ``[R, L, dim]`` dispatch output as an OWNED
+    array (``spans`` are ``(row, offset, length)`` — the padded path
+    uses ``(i, 0, n_i)``, the packed path its segment placements).
+    Owned copies — not views — so a response never pins the whole
+    dispatch buffer. Implementation is chosen where it pays: the numpy
+    copy loop under ``NATIVE_UNPAD_MIN_BYTES`` (ctypes setup would
+    dominate), ONE native call above it; both produce identical
+    bytes."""
+    if out.ndim != 3:
+        raise ValueError(f"unpad_rows needs a [R, L, dim] output, got {out.shape}")
+    n = len(spans)
+    row_len, dim = out.shape[1], out.shape[2]
+    for r, off, length in spans:
+        if not (0 <= r < out.shape[0] and 0 <= off and off + length <= row_len):
+            raise ValueError(
+                f"span {(r, off, length)} out of bounds for {out.shape}"
+            )
+    total = sum(length for _, _, length in spans) * dim * out.itemsize
+    lib = _load()
+    if lib is None or n == 0 or total < NATIVE_UNPAD_MIN_BYTES:
+        return unpad_rows_numpy(out, spans)
+    src = np.ascontiguousarray(out)
+    tok_bytes = dim * src.itemsize
+    dsts = [np.empty((length, dim), src.dtype) for _, _, length in spans]
+    meta = np.empty((3, n), np.int64)
+    meta[0] = [s[0] for s in spans]
+    meta[1] = [s[1] for s in spans]
+    meta[2] = [s[2] for s in spans]
+    ptrs = np.fromiter(
+        (d.__array_interface__["data"][0] for d in dsts),
+        dtype=np.uintp, count=n,
+    )
+    as_i64 = ctypes.POINTER(ctypes.c_int64)
+    lib = _handle(total)
+    lib.gnot_unpad_rows(
+        src.ctypes.data_as(ctypes.c_void_p),
+        meta[0].ctypes.data_as(as_i64),
+        meta[1].ctypes.data_as(as_i64),
+        meta[2].ctypes.data_as(as_i64),
+        n,
+        row_len * tok_bytes,
+        tok_bytes,
+        ptrs.ctypes.data_as(ctypes.POINTER(ctypes.c_void_p)),
+    )
+    return dsts

@@ -1,5 +1,5 @@
 // Native ragged->dense batch packer: the host-side hot loop of the data
-// pipeline.
+// pipeline and the serving dispatch path.
 //
 // The reference pads ragged meshes in Python inside the train loop
 // (/root/reference/main.py:63-82, utils.py:3-4): one torch op per sample
@@ -9,38 +9,43 @@
 // the 0/1 mask written in the same sweep — no per-sample allocations, no
 // interpreter in the loop. Threaded over samples for large batches.
 //
+// Serving additions (round 12, trace_report-indicted host phases):
+//
+// * gnot_pack_rows_bf16 — FUSED pad-and-cast: the same sweep, emitting
+//   bfloat16 (round-to-nearest-even, Eigen/ml_dtypes-identical) so a
+//   bf16 serving dispatch assembles its half-width batch in one pass
+//   instead of pack-then-astype (two passes, an interpreter hop, and a
+//   full-width intermediate).
+// * gnot_unpad_rows — batched unpad/scatter: every response's
+//   [n_i, out] rows copied out of the dispatch output in ONE native
+//   call (padded rows or packed (row, offset) segments alike) instead
+//   of a Python loop of slice-copies.
+//
 // ABI: plain C symbols loaded via ctypes (no pybind11 dependency).
+// tools/lint.py rule GL007 cross-checks these signatures against the
+// ctypes bindings in __init__.py (arity + dtype tags) on every run.
 
 #include <cstdint>
 #include <cstring>
 #include <thread>
 #include <vector>
 
-extern "C" {
+namespace {
 
-// Pack n ragged [len_i, dim] float32 row-blocks into a dense
-// [n, max_len, dim] tensor (zero pad at the row tail) and a [n, max_len]
-// 0/1 mask. `srcs[i]` points at sample i's contiguous data.
-void gnot_pack_rows(const float** srcs, const int64_t* lens, int64_t n,
-                    int64_t dim, int64_t max_len, float* out, float* mask) {
-  const int64_t row_bytes = dim * static_cast<int64_t>(sizeof(float));
-  auto pack_one = [&](int64_t i) {
-    const int64_t len = lens[i];
-    float* dst = out + i * max_len * dim;
-    std::memcpy(dst, srcs[i], static_cast<size_t>(len * row_bytes));
-    std::memset(dst + len * dim, 0,
-                static_cast<size_t>((max_len - len) * row_bytes));
-    float* m = mask + i * max_len;
-    for (int64_t r = 0; r < len; ++r) m[r] = 1.0f;
-    std::memset(m + len, 0, static_cast<size_t>((max_len - len) * sizeof(float)));
-  };
+// The bf16 conversion inside gnot_pack_rows_bf16 is EXACTLY the
+// Eigen::bfloat16 round-to-nearest-even ml_dtypes uses, so the Python
+// fallback (numpy astype via ml_dtypes) is bitwise-identical — the
+// parity tests assert it, NaNs included.
 
-  // Threading pays only when there is real work per thread; the packer
-  // is memcpy-bound, so use a coarse bytes threshold.
-  int64_t total = 0;
-  for (int64_t i = 0; i < n; ++i) total += lens[i] * row_bytes;
+// Run pack_one(i) for i in [0, n), threaded only when the payload is
+// so large that thread spawn (hundreds of us on a busy host) is noise.
+// Measured on this class of box: per-dispatch serve payloads (KBs to a
+// few MB) lose to spawn cost every time — memcpy at >10 GB/s finishes
+// before the second thread starts — so the bar is 32 MB, not "a few".
+template <typename F>
+void for_samples(int64_t n, int64_t total_bytes, F&& pack_one) {
   const unsigned hw = std::thread::hardware_concurrency();
-  if (total < (1 << 22) || hw <= 1 || n <= 1) {
+  if (total_bytes < (int64_t{32} << 20) || hw <= 1 || n <= 1) {
     for (int64_t i = 0; i < n; ++i) pack_one(i);
     return;
   }
@@ -53,6 +58,88 @@ void gnot_pack_rows(const float** srcs, const int64_t* lens, int64_t n,
     });
   }
   for (auto& th : threads) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Pack n ragged [len_i, dim] float32 row-blocks into a dense
+// [n, max_len, dim] tensor and a [n, max_len] 0/1 mask. `srcs[i]`
+// points at sample i's contiguous data.
+//
+// CALLER CONTRACT: `out` and `mask` arrive ZERO-INITIALIZED (the
+// Python side allocates them with np.zeros — calloc-backed lazy zero
+// pages). Only the payload and the mask's 1-prefix are written here;
+// the pad tail is never touched, so untouched pad PAGES are never
+// faulted in. This is the difference between beating numpy's own
+// calloc+copy path and losing to it by the width of a redundant
+// memset (measured on this box; docs/performance.md round 12).
+void gnot_pack_rows(const float** srcs, const int64_t* lens, int64_t n,
+                    int64_t dim, int64_t max_len, float* out, float* mask) {
+  const int64_t row_bytes = dim * static_cast<int64_t>(sizeof(float));
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; ++i) total += lens[i] * row_bytes;
+  for_samples(n, total, [&](int64_t i) {
+    const int64_t len = lens[i];
+    std::memcpy(out + i * max_len * dim, srcs[i],
+                static_cast<size_t>(len * row_bytes));
+    float* m = mask + i * max_len;
+    for (int64_t r = 0; r < len; ++r) m[r] = 1.0f;
+  });
+}
+
+// Fused pad-and-cast: gnot_pack_rows semantics (same zero-initialized
+// caller contract), but the output tensor and mask are bfloat16
+// (uint16 bits, RNE) — ONE sweep builds the half-width dispatch batch
+// a bf16 serving program consumes, no full-width intermediate, no
+// second pass. The cast loop reads the float bits through a uint32
+// pointer (built with -fno-strict-aliasing) and keeps the NaN fixup
+// as a branchless select so -O3 -march=native vectorizes it.
+void gnot_pack_rows_bf16(const float** srcs, const int64_t* lens, int64_t n,
+                         int64_t dim, int64_t max_len, uint16_t* out,
+                         uint16_t* mask) {
+  const int64_t row_bytes = dim * static_cast<int64_t>(sizeof(float));
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; ++i) total += lens[i] * row_bytes;
+  for_samples(n, total, [&](int64_t i) {
+    const int64_t len = lens[i];
+    const uint32_t* src = reinterpret_cast<const uint32_t*>(srcs[i]);
+    uint16_t* dst = out + i * max_len * dim;
+    const int64_t elems = len * dim;
+    // Mask-select form (not value ternaries): gcc 10 refuses to
+    // vectorize mixed-width conditional moves but turns this into
+    // 64-byte AVX-512 vectors (measured 4x; -fopt-info-vec verified).
+    for (int64_t e = 0; e < elems; ++e) {
+      const uint32_t x = src[e];
+      const uint32_t lsb = (x >> 16) & 1u;
+      const uint32_t rne = (x + 0x7FFFu + lsb) >> 16;
+      const uint32_t nan_bits = (x >> 31) ? 0xFFC0u : 0x7FC0u;
+      const uint32_t is_nan =
+          (x & 0x7FFFFFFFu) > 0x7F800000u ? 0xFFFFFFFFu : 0u;
+      dst[e] = static_cast<uint16_t>((is_nan & nan_bits) | (~is_nan & rne));
+    }
+    uint16_t* m = mask + i * max_len;
+    for (int64_t r = 0; r < len; ++r) m[r] = 0x3F80u;  // 1.0 in bfloat16
+  });
+}
+
+// Batched unpad/scatter: copy each sample's [len_i, dim] block out of a
+// dense [R, row_len, dim] dispatch output into its own destination
+// buffer, in one call. Byte-oriented so any element dtype works:
+// sample i's block starts at src + rows[i]*row_bytes + offs[i]*tok_bytes
+// and spans lens[i]*tok_bytes (tok_bytes = dim * itemsize). Covers the
+// padded path (rows=i, offs=0) and the packed path ((row, offset)
+// segment placements) with the same symbol.
+void gnot_unpad_rows(const char* src, const int64_t* rows,
+                     const int64_t* offs, const int64_t* lens, int64_t n,
+                     int64_t row_bytes, int64_t tok_bytes, char** dsts) {
+  int64_t total = 0;
+  for (int64_t i = 0; i < n; ++i) total += lens[i] * tok_bytes;
+  for_samples(n, total, [&](int64_t i) {
+    std::memcpy(dsts[i], src + rows[i] * row_bytes + offs[i] * tok_bytes,
+                static_cast<size_t>(lens[i] * tok_bytes));
+  });
 }
 
 }  // extern "C"

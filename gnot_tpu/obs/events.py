@@ -49,6 +49,7 @@ REPLICA_HEALTH = "replica_health"
 ROLLING_RELOAD = "rolling_reload"
 AOT_PREWARM = "aot_prewarm"
 REPLICA_WARM = "replica_warm"
+NATIVE_PACKER = "native_packer"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -183,10 +184,11 @@ EVENTS: dict[str, EventSpec] = {
         ),
         module="gnot_tpu/serve/server.py",
         doc="end-of-serve rollup emitted on drain (one per replica "
-        "server plus one pool-level rollup from the router)",
+        "server plus one pool-level rollup from the router); `dtype` "
+        "names the serving compute dtype the numbers were measured at",
         optional=(
             "queue_device_by_bucket", "pad_waste_by_bucket", "replica",
-            "per_replica", "routing",
+            "per_replica", "routing", "dtype",
         ),
     ),
     "route": EventSpec(
@@ -194,7 +196,9 @@ EVENTS: dict[str, EventSpec] = {
         module="gnot_tpu/serve/router.py",
         doc="one placement decision: which replica got the request and "
         "why (affinity | cold_assign | spill | least_loaded | "
-        "round_robin | pool_full | no_healthy)",
+        "round_robin | pool_full | no_healthy); `dtype` is the pool's "
+        "serving compute dtype",
+        optional=("dtype",),
     ),
     "replica_health": EventSpec(
         fields=("replica", "healthy", "reason"),
@@ -227,6 +231,19 @@ EVENTS: dict[str, EventSpec] = {
         "refused; `reason` says why); emitted at pool prewarm "
         "and at every scale-out add_replica",
         optional=("hits", "misses", "reason"),
+    ),
+    "native_packer": EventSpec(
+        fields=("available", "impl"),
+        module="gnot_tpu/main.py",
+        doc="one-time serve-start record of the host packer path: "
+        "`impl` is 'native' (_ragged_pack.so loaded; dispatch is the "
+        "payload-gated ADAPTIVE policy — the C fused pad/cast + "
+        "batched unpad run above the recorded `*_min_bytes` bars, "
+        "the bit-identical numpy fallback below them) or 'python' "
+        "(fallback only; `error` says why), so bench artifacts are "
+        "attributable to the code path that produced them",
+        optional=("so", "error", "pack_native_min_bytes",
+                  "unpad_native_min_bytes"),
     ),
     "trace_flush": EventSpec(
         fields=("path", "spans", "dropped"),

@@ -43,6 +43,14 @@ def feature_softmax(x: Array) -> Array:
     return out.astype(dtype)
 
 
+def _reduced_precision(*arrays: Array) -> bool:
+    """True when any operand computes below float32 — the switch for
+    the f32-accumulation path (models/precision.py policy: attention
+    reductions and the normalizer NEVER accumulate in bf16). False for
+    the all-f32 case, which keeps the historical ops byte-identical."""
+    return any(a.dtype != jnp.float32 for a in arrays)
+
+
 def normalized_linear_attention(
     q: Array,
     k: Array,
@@ -70,10 +78,17 @@ def normalized_linear_attention(
         # v is multiplied implicitly via k in the k^T v contraction; no
         # need to mask v separately.
 
+    # Reduced-precision inputs (bf16 serving): contractions accumulate
+    # in f32 via explicit preferred_element_type, and the normalizer
+    # (<q, k_sum> and the reciprocal) is f32 END TO END — the precision
+    # policy (models/precision.py). The all-f32 path takes the
+    # historical branch, byte-identical.
+    lowp = _reduced_precision(q, k, v)
+    acc = {"preferred_element_type": jnp.float32} if lowp else {}
     # k_sum over the sequence axis: [B, H, D]
-    k_sum = jnp.sum(k, axis=2)
+    k_sum = jnp.sum(k, axis=2, dtype=jnp.float32) if lowp else jnp.sum(k, axis=2)
     # alpha = 1 / <q, k_sum> : [B, H, Lq, 1]
-    denom = jnp.einsum("bhld,bhd->bhl", q, k_sum)
+    denom = jnp.einsum("bhld,bhd->bhl", q, k_sum, **acc)
     if kv_mask is not None:
         # An all-masked key set (a record with an empty input function) has
         # k_sum == 0 exactly — softmaxed k rows are strictly positive, so
@@ -85,9 +100,12 @@ def normalized_linear_attention(
         denom = jnp.where(denom == 0.0, 1.0, denom)
     alpha = 1.0 / (denom + eps)
     # k^T v : [B, H, D, D] — the hot MXU contraction.
-    kv = jnp.einsum("bhld,bhle->bhde", k, v)
-    out = jnp.einsum("bhld,bhde->bhle", q, kv)
-    return alpha[..., None] * out
+    kv = jnp.einsum("bhld,bhle->bhde", k, v, **acc)
+    out = jnp.einsum("bhld,bhde->bhle", q, kv, **acc)
+    out = alpha[..., None] * out
+    # Hand the block back its compute dtype (the f32 head casts at the
+    # model level); alpha/out above stayed f32 through the reductions.
+    return out.astype(q.dtype) if lowp else out
 
 
 def segment_one_hot(seg: Array, n_seg: int, dtype=jnp.float32) -> Array:
@@ -149,31 +167,40 @@ def packed_normalized_linear_attention(
     if kv_mask is not None:
         k = k * kv_mask[:, None, :, None].astype(k.dtype)
 
-    oh_k = kv_seg_oh.astype(k.dtype)  # [Bk,Nk,S]
-    oh_q = q_seg_oh.astype(q.dtype)  # [Bq,Nq,S]
+    # Reduced-precision inputs: every scatter/gather contraction below
+    # accumulates in f32 (preferred_element_type) and the normalizer
+    # stays f32 — the same precision policy as the unpacked op. The
+    # all-f32 path is byte-identical to the historical einsums.
+    lowp = _reduced_precision(q, k, v)
+    acc = {"preferred_element_type": jnp.float32} if lowp else {}
+    oh_k = kv_seg_oh.astype(jnp.float32 if lowp else k.dtype)  # [Bk,Nk,S]
+    oh_q = q_seg_oh.astype(jnp.float32 if lowp else q.dtype)  # [Bq,Nq,S]
 
     kc = k.reshape(bk, h, nk, ck, d)
     vc = v.reshape(bk, h, nk, ck, d)
     # Per-chunk partial Grams / key sums: the SAME total contraction
     # work as the unpacked op, just summed chunkwise.
-    kv_chunk = jnp.einsum("bhncd,bhnce->bhnde", kc, vc)  # [Bk,H,Nk,D,D]
-    ks_chunk = jnp.sum(kc, axis=3)  # [Bk,H,Nk,D]
+    kv_chunk = jnp.einsum("bhncd,bhnce->bhnde", kc, vc, **acc)  # [Bk,H,Nk,D,D]
+    ks_chunk = (
+        jnp.sum(kc, axis=3, dtype=jnp.float32) if lowp else jnp.sum(kc, axis=3)
+    )  # [Bk,H,Nk,D]
     # Scatter-add into global per-segment Grams (tiny contractions).
-    kv_seg_gram = jnp.einsum("bns,bhnde->shde", oh_k, kv_chunk)  # [S,H,D,D]
-    ks_seg_sum = jnp.einsum("bns,bhnd->shd", oh_k, ks_chunk)  # [S,H,D]
+    kv_seg_gram = jnp.einsum("bns,bhnde->shde", oh_k, kv_chunk, **acc)  # [S,H,D,D]
+    ks_seg_sum = jnp.einsum("bns,bhnd->shd", oh_k, ks_chunk, **acc)  # [S,H,D]
     # Gather each query chunk's segment Gram / key sum.
-    kv_q = jnp.einsum("bns,shde->bhnde", oh_q, kv_seg_gram)  # [Bq,H,Nq,D,D]
-    ks_q = jnp.einsum("bns,shd->bhnd", oh_q, ks_seg_sum)  # [Bq,H,Nq,D]
+    kv_q = jnp.einsum("bns,shde->bhnde", oh_q, kv_seg_gram, **acc)  # [Bq,H,Nq,D,D]
+    ks_q = jnp.einsum("bns,shd->bhnd", oh_q, ks_seg_sum, **acc)  # [Bq,H,Nq,D]
 
     qc = q.reshape(bq, h, nq, cq, d)
-    denom = jnp.einsum("bhncd,bhnd->bhnc", qc, ks_q)
+    denom = jnp.einsum("bhncd,bhnd->bhnc", qc, ks_q, **acc)
     # Pad chunks/tokens and empty segments have denom == 0 exactly
     # (softmaxed k rows are strictly positive — same argument as the
     # masked unpacked op); select 1 for a clean 0 output there.
     denom = jnp.where(denom == 0.0, 1.0, denom)
-    out = jnp.einsum("bhncd,bhnde->bhnce", qc, kv_q)
+    out = jnp.einsum("bhncd,bhnde->bhnce", qc, kv_q, **acc)
     out = out / denom[..., None]
-    return out.reshape(bq, h, lq, d)
+    out = out.reshape(bq, h, lq, d)
+    return out.astype(q.dtype) if lowp else out
 
 
 def split_heads(x: Array, n_head: int) -> Array:

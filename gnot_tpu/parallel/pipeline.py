@@ -135,7 +135,9 @@ def _head(cfg: ModelConfig, params: dict, query):
     from gnot_tpu.models import gnot
 
     return gnot.finalize_output(
-        gnot.out_module(cfg).apply({"params": params["out_mlp"]}, query)
+        gnot.out_module(cfg).apply(
+            {"params": params["out_mlp"]}, gnot.finalize_input(query)
+        )
     )
 
 

@@ -64,7 +64,12 @@ from gnot_tpu.utils.cache import cache_dir_manifest, warm_cache
 
 #: Manifest schema version (bump on incompatible changes; load_manifest
 #: rejects unknown versions loudly instead of hydrating garbage).
-MANIFEST_VERSION = 1
+#: v2: program identity is dtype-keyed — ``ProgramSpec.dtype``, the
+#: ``@<tag>`` key suffix, and the manifest-level ``dtype`` a hydrating
+#: engine must match wholesale. v1 manifests predate serving dtypes
+#: and are refused (their f32 programs would silently hydrate into a
+#: bf16 deployment at the same shapes).
+MANIFEST_VERSION = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,6 +89,13 @@ class ProgramSpec:
     rows: int
     dims: dict
     plan: dict | None = None  # PackPlan fields when kind == "packed"
+    # Serving compute dtype this program was lowered at
+    # (models/precision.py). Part of program IDENTITY: the key carries
+    # its tag, the dummy batch collates at it, and hydration refuses a
+    # manifest whose dtype differs from the serving engine's — an f32
+    # executable at a bf16 deployment's shapes is the wrong program,
+    # not a warm one.
+    dtype: str = "float32"
 
     def dummy_samples(self) -> list[MeshSample]:
         """Zero-filled sample(s) whose collated batch has this
@@ -106,7 +118,10 @@ class ProgramSpec:
 
     def dummy_batch(self):
         """The collated (host-side) batch at this program's exact
-        static shape — what the engine lowers/dispatches."""
+        static shape AND dtype — what the engine lowers/dispatches
+        (dispatch signatures are dtype-keyed, so the dummy must collate
+        at the program's dtype or hydration would install keys no live
+        dispatch ever matches)."""
         samples = self.dummy_samples()
         if self.kind == "packed":
             plan = PackPlan(**self.plan)
@@ -121,6 +136,7 @@ class ProgramSpec:
                 chunk=plan.chunk,
                 n_slots=plan.n_slots,
                 pad_funcs=plan.pad_funcs,
+                dtype=self.dtype,
             )
         reqs = samples * self.rows
         return collate(
@@ -128,6 +144,7 @@ class ProgramSpec:
             bucket=False,
             pad_nodes=self.pad_nodes,
             pad_funcs=self.pad_funcs,
+            dtype=self.dtype,
         )
 
 
@@ -177,7 +194,14 @@ def enumerate_programs(
     packed program when a plan is given."""
     if not samples:
         raise ValueError("enumerate_programs needs representative samples")
+    from gnot_tpu.models.precision import DTYPE_TAGS
+
     rows = rows or engine.batch_size
+    # Programs inherit the engine's serving dtype — the key carries the
+    # tag, so an f32 and a bf16 deployment of the same traffic family
+    # never share a program name (or a snapshot file).
+    dtype = getattr(engine, "dtype", "float32")
+    tag = DTYPE_TAGS[dtype]
     dims = sample_dims(samples[0])
     specs = []
     seen: set[tuple[int, int]] = set()
@@ -189,25 +213,27 @@ def enumerate_programs(
         pn, pf = key
         specs.append(
             ProgramSpec(
-                key=f"bucket:{pn}x{pf}@{rows}",
+                key=f"bucket:{pn}x{pf}@{rows}@{tag}",
                 kind="bucket",
                 pad_nodes=pn,
                 pad_funcs=pf,
                 rows=rows,
                 dims=dims,
+                dtype=dtype,
             )
         )
     specs.sort(key=lambda sp: sp.key)
     if pack_plan is not None:
         specs.append(
             ProgramSpec(
-                key=f"packed:{pack_plan.n_rows}x{pack_plan.row_len}",
+                key=f"packed:{pack_plan.n_rows}x{pack_plan.row_len}@{tag}",
                 kind="packed",
                 pad_nodes=0,
                 pad_funcs=pack_plan.pad_funcs,
                 rows=pack_plan.n_rows,
                 dims=dims,
                 plan=dataclasses.asdict(pack_plan),
+                dtype=dtype,
             )
         )
     return specs
@@ -322,6 +348,7 @@ def hydrate(
     snapshot_dir: str,
     *,
     params_sig: str | None = None,
+    dtype: str | None = None,
 ) -> dict:
     """Warm-replica hydration: deserialize each program's snapshot and
     install it in the engine's AOT table — no trace, no compile, no
@@ -334,6 +361,20 @@ def hydrate(
     from jax.experimental import serialize_executable
 
     t0 = time.monotonic()
+    if dtype is not None and dtype != getattr(engine, "dtype", "float32"):
+        # Programs compiled at another serving dtype: refuse them ALL,
+        # first. A bf16 deployment handed f32 snapshots must serve
+        # cold, not serve the wrong-precision programs — params_sig
+        # would also catch the cast weight mismatch, but the dtype
+        # refusal is the named, deliberate contract (and covers
+        # engines whose param trees happen to agree).
+        return {
+            "installed": 0,
+            "skipped": len(list(programs)),
+            "seconds": time.monotonic() - t0,
+            "keys": [],
+            "reason": "dtype_mismatch",
+        }
     if params_sig is not None and params_sig != params_signature(
         engine.params
     ):
@@ -368,7 +409,8 @@ def hydrate(
                 k: entry[k]
                 for k in ("key", "kind", "pad_nodes", "pad_funcs",
                           "rows", "dims", "plan")
-            }
+            },
+            dtype=entry.get("dtype", "float32"),
         )
         # Keyed on the PLACED signature, mirroring aot_compile's
         # lowering and _run_forward's lookup — an engine whose
@@ -427,6 +469,10 @@ def prewarm_deployment(
         "cache_dir": cache_dir_manifest(),
         "replicas": len(engines),
         "rows": rows,
+        # The one serving dtype every program in this manifest was
+        # lowered at — hydration matches it WHOLESALE against the
+        # serving engine (hydrate's dtype refusal).
+        "dtype": getattr(engines[0][1], "dtype", "float32"),
         "packed": pack_plan is not None,
         "snapshot_dir": os.path.abspath(snapshot_dir),
         "program_keys": [sp.key for sp in specs],
@@ -475,14 +521,15 @@ def _sum_opt(values) -> int | None:
 def hydrate_block(engine, manifest: dict, replica_id: int) -> dict:
     """Hydrate one engine from its manifest block — THE shared entry
     point for both ``EngineReplica.prewarm_from`` and the
-    single-server ``--serve_prewarm`` path, so params-guard threading
-    and skip accounting cannot drift between them."""
+    single-server ``--serve_prewarm`` path, so dtype/params-guard
+    threading and skip accounting cannot drift between them."""
     block = manifest["per_replica"][str(replica_id)]
     return hydrate(
         engine,
         block["programs"],
         manifest["snapshot_dir"],
         params_sig=block.get("params_sig"),
+        dtype=manifest.get("dtype", "float32"),
     )
 
 

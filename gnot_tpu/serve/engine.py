@@ -33,6 +33,7 @@ from typing import Callable, Sequence
 import jax
 import numpy as np
 
+from gnot_tpu import native
 from gnot_tpu.data.batch import (
     Loader,
     MeshSample,
@@ -43,6 +44,7 @@ from gnot_tpu.data.batch import (
     pack_prefix,
     validate_samples,
 )
+from gnot_tpu.models import precision
 from gnot_tpu.utils import sanitizer
 
 
@@ -91,7 +93,23 @@ class InferenceEngine:
         n_proc: int = 1,
         p_idx: int = 0,
         place_params: Callable | None = None,
+        dtype: str = "float32",
     ):
+        # Serving compute dtype (models/precision.py): "float32" is the
+        # historical engine, byte-identical. "bfloat16" serves the SAME
+        # f32-at-rest weights through the low-precision policy — the
+        # default forward runs the bf16-compute model clone, batches
+        # collate half-width via the fused pad-and-cast packer, and
+        # swap_params publishes a bf16 weight COPY (the caller's tree
+        # is never touched, so hot reload and train/serve sharing see
+        # f32 exactly as before). Program identity is dtype-keyed:
+        # dispatch signatures carry leaf dtypes, so an f32 and a bf16
+        # program at the same shape never collide in the AOT table or
+        # the compiled-shapes count.
+        self.policy = precision.policy_for(dtype)
+        self.dtype = dtype
+        if dtype != "float32" and forward is None and forward_builder is None:
+            model = precision.serve_model(model, dtype)
         self.model = model
         self.batch_size = batch_size
         self.bucket = bucket
@@ -133,8 +151,12 @@ class InferenceEngine:
         self._lock = threading.Lock()  # published params + shape log
         # The published weight reference: swapped by reload callers,
         # read by the dispatch threads (graftlint GL004 enforces the
-        # guarded_by annotation).
-        self._params = params  #: guarded_by _lock
+        # guarded_by annotation). Under a reduced-precision policy this
+        # is the CAST COPY (cast-on-publish); the caller's f32 tree is
+        # never mutated.
+        self._params = self._place_params(
+            precision.cast_params(params, dtype)
+        ) if dtype != "float32" else params  #: guarded_by _lock
         # Distinct (B, L, Lf) dispatch signatures — a host-side proxy
         # for the number of XLA programs this engine forced. The chaos
         # suite bounds it by the bucket count; mutated by whichever
@@ -160,8 +182,11 @@ class InferenceEngine:
         """Atomically publish a new weight set (hot reload). In-flight
         dispatches keep the reference they already read; the next
         dispatch sees the new one. No request is ever dropped or served
-        a half-swapped tree."""
-        params = self._place_params(params)
+        a half-swapped tree. Cast-on-publish: a reduced-precision
+        engine publishes a ``dtype`` COPY here (the one cast per
+        reload), so reload sources keep handing over the same f32
+        trees they always did."""
+        params = self._place_params(precision.cast_params(params, self.dtype))
         with self._lock:
             self._params = params
 
@@ -239,8 +264,14 @@ class InferenceEngine:
     @staticmethod
     def signature_of(batch) -> tuple:
         """The dispatch-signature key of a (host or placed) batch —
-        what the AOT executable table and ``compiled_shapes`` key on."""
-        return tuple(np.shape(l) for l in jax.tree.leaves(batch))
+        what the AOT executable table and ``compiled_shapes`` key on.
+        Shape AND dtype per leaf: program identity is dtype-keyed, so
+        an f32 and a bf16 program at the same shapes are two programs,
+        never one table slot."""
+        return tuple(
+            (np.shape(l), str(np.asarray(l).dtype if not hasattr(l, "dtype") else l.dtype))
+            for l in jax.tree.leaves(batch)
+        )
 
     def install_program(self, signature: tuple, loaded: Callable) -> None:
         """Hydrate one AOT executable: dispatches whose batch matches
@@ -318,6 +349,7 @@ class InferenceEngine:
             bucket=False,
             pad_nodes=pad_nodes,
             pad_funcs=pad_funcs,
+            dtype=self.dtype,
         )
         self._note_shape(batch)
         params = self.params  # one consistent weight set per dispatch
@@ -333,7 +365,12 @@ class InferenceEngine:
         if timings is not None:
             t2 = tick()
             timings["device"] = (t1, t2)
-        outs = [out[i, : s.coords.shape[0]] for i, s in enumerate(reqs)]
+        # Batched native unpad: every response's [n_i, out] block is an
+        # OWNED copy cut in one call (Python-loop slicing otherwise —
+        # value-identical), so no response pins the dispatch buffer.
+        outs = native.unpad_rows(
+            out, [(i, 0, s.coords.shape[0]) for i, s in enumerate(reqs)]
+        )
         if timings is not None:
             timings["unpad"] = (t2, tick())
         return outs
@@ -380,6 +417,7 @@ class InferenceEngine:
             chunk=plan.chunk,
             n_slots=plan.n_slots,
             pad_funcs=plan.pad_funcs,
+            dtype=self.dtype,
         )
         self._note_shape(batch)
         params = self.params  # one consistent weight set per dispatch
@@ -395,10 +433,15 @@ class InferenceEngine:
         if timings is not None:
             t2 = tick()
             timings["device"] = (t1, t2)
-        outs = [
-            out[r, off : off + s.coords.shape[0]]
-            for s, (r, off) in zip(reqs, placements)
-        ]
+        # Per-segment unpad, batched through the native scatter: each
+        # request gets exactly its own [n_i, out] rows as an owned copy.
+        outs = native.unpad_rows(
+            out,
+            [
+                (r, off, s.coords.shape[0])
+                for s, (r, off) in zip(reqs, placements)
+            ],
+        )
         if timings is not None:
             timings["unpad"] = (t2, tick())
         return outs
@@ -416,7 +459,7 @@ class InferenceEngine:
         return 1
 
     def _note_shape(self, batch) -> None:
-        key = tuple(np.shape(l) for l in jax.tree.leaves(batch))
+        key = self.signature_of(batch)
         with self._lock:
             self._shapes.add(key)
 
@@ -470,6 +513,7 @@ class InferenceEngine:
             bucket=self.bucket,
             pad_nodes=self.pad_nodes,
             pad_funcs=self.pad_funcs,
+            dtype=self.dtype,
         )
         params = self.params
         outs: list[np.ndarray] = []
@@ -482,7 +526,13 @@ class InferenceEngine:
             out = sanitizer.host_fetch(
                 self._run_forward(params, self._device_put(batch))
             )
-            for j in range(out.shape[0]):
-                idx = bi * group + j
-                outs.append(out[j, : samples[idx].coords.shape[0]])
+            outs.extend(
+                native.unpad_rows(
+                    out,
+                    [
+                        (j, 0, samples[bi * group + j].coords.shape[0])
+                        for j in range(out.shape[0])
+                    ],
+                )
+            )
         return outs[:n_real]

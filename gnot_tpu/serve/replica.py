@@ -220,6 +220,7 @@ def build_replicas(
     pad_funcs: int = 0,
     devices: Sequence | None = None,
     forward_fn: Callable | None = None,
+    dtype: str = "float32",
 ) -> list[EngineReplica]:
     """N engine replicas over disjoint device slices.
 
@@ -271,6 +272,7 @@ def build_replicas(
             pad_nodes=pad_nodes,
             pad_funcs=pad_funcs,
             forward_fn=forward_fn,
+            dtype=dtype,
         )
         for i in range(n_replicas)
     ]
@@ -288,6 +290,7 @@ def build_replica(
     pad_nodes: int = 0,
     pad_funcs: int = 0,
     forward_fn: Callable | None = None,
+    dtype: str = "float32",
 ) -> EngineReplica:
     """ONE replica on an explicit device slice — the scale-out unit.
 
@@ -296,16 +299,23 @@ def build_replica(
     build individual replicas for slices of the SAME target topology,
     so replica ``i`` here and replica ``i`` at deploy-time prewarm sit
     on identical device assignments — the condition for its warm
-    snapshot (device-bound XLA executables) to hydrate."""
+    snapshot (device-bound XLA executables) to hydrate.
+
+    ``dtype`` is the serving compute dtype (models/precision.py): the
+    default forward runs the ``dtype``-compute model clone and the
+    engine publishes a cast weight copy; ``params`` here (and every
+    hot reload) stay f32 at rest."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec
 
+    from gnot_tpu.models import precision
     from gnot_tpu.parallel import mesh as mesh_lib
 
     if forward_fn is None:
         from gnot_tpu.train.trainer import apply_batch
 
-        forward_fn = lambda p, b: apply_batch(model, p, b)  # noqa: E731
+        serve_model = precision.serve_model(model, dtype)
+        forward_fn = lambda p, b: apply_batch(serve_model, p, b)  # noqa: E731
     per = len(slice_devices)
     if per < 1:
         raise ValueError("a replica needs at least one device")
@@ -330,6 +340,7 @@ def build_replica(
         bucket=bucket,
         pad_nodes=pad_nodes,
         pad_funcs=pad_funcs,
+        dtype=dtype,
         forward=forward,
         # Fresh-jit factory for AOT snapshot compiles (serve/aot.py):
         # same fn, same out-sharding, NEW jit object (uniquely named

@@ -153,6 +153,13 @@ class ReplicaRouter:
                     **self._server_kwargs,
                 )
             )
+        # The pool's serving compute dtype (models/precision.py): one
+        # dtype per pool BY CONSTRUCTION (mixed-precision pools would
+        # break program identity for routing), read off the engines;
+        # tagged onto every route event and the pool serve_summary.
+        self._dtype = getattr(
+            self.replicas[0].engine, "dtype", "float32"
+        )
         self._lock = threading.Lock()
         # Placement counters + health memory, shared between every
         # submitting thread and the reload/drain threads.
@@ -308,6 +315,7 @@ class ReplicaRouter:
             policy=self.route_policy,
             reason=reason,
             depth=replica.server.depth(),
+            dtype=self._dtype,
         )
         return replica.server.submit(sample, deadline_ms=deadline_ms)
 
@@ -524,6 +532,7 @@ class ReplicaRouter:
             rollouts = self._rollouts
             submitted = self._submitted
         summary = {
+            "dtype": self._dtype,
             "requests": sum(s["requests"] for s in per.values()),
             "admitted": sum(s["admitted"] for s in per.values()),
             "completed": sum(s["completed"] for s in per.values()),

@@ -920,6 +920,11 @@ class InferenceServer:
                 for key, st in sorted(bucket_stats.items())
             }
         summary.update(
+            # Serving compute dtype (models/precision.py): every rollup
+            # names the precision it measured — a bench artifact from a
+            # bf16 run cannot masquerade as f32. getattr: chaos-test
+            # stub engines predate the policy.
+            dtype=getattr(self.engine, "dtype", "float32"),
             breaker_trips=self.breaker.trips,
             compiled_shapes=self.engine.compiled_shapes,
             latency_p50_ms=float(np.percentile(lat, 50)) if lat.size else None,

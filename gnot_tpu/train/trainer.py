@@ -980,13 +980,20 @@ class Trainer:
         """
         return self.inference_engine().predict(samples)
 
-    def inference_engine(self):
+    def inference_engine(self, dtype: str = "float32"):
         """The trainer's ``serve.InferenceEngine`` over its CURRENT
         params: layout-aware jitted forward (flat / stacked / standard,
         mesh-replicated outputs), the training data's fixed pad
         lengths, and the mesh batch-placement hook. Built once; params
         are re-published on every call so post-fit/restore weights are
-        always what serves."""
+        always what serves.
+
+        ``dtype`` is the SERVING compute dtype (models/precision.py);
+        the trainer's own params stay f32 — the engine publishes a
+        cast copy. Standard param layout only: the flat/stacked
+        layout-aware forwards are not threaded through the serve-model
+        clone, so bf16 serving of those layouts fails with the flag to
+        flip instead of silently serving f32."""
         multiproc = jax.process_count() > 1
         if self.state is None:
             self.initialize()
@@ -995,8 +1002,25 @@ class Trainer:
                 "multi-process predict() requires the distributed "
                 "trainer (a mesh) — run with --distributed"
             )
+        if dtype != "float32" and (
+            self._flat or "blocks" in self.state.params
+        ):
+            raise ValueError(
+                "serve.dtype='bfloat16' serves the standard param "
+                "layout only; drop --scan_layers/--flat_params (or "
+                "serve float32)"
+            )
+        if self._engine is not None and self._engine.dtype != dtype:
+            # One engine cache, one serving dtype per trainer run: a
+            # mid-process dtype flip would need a second jitted
+            # forward + AOT table — rebuild instead of mixing them.
+            self._engine = None
+            self._forward = None
+            self._forward_builder = None
         if self._forward is None:
-            model = self.model
+            from gnot_tpu.models.precision import serve_model
+
+            model = serve_model(self.model, dtype)
             if self._flat:
                 unravel = self._unravel
                 fwd = lambda params, batch: apply_batch(
@@ -1044,6 +1068,7 @@ class Trainer:
                 bucket=self.config.data.bucket,
                 pad_nodes=self.train_loader.pad_nodes,
                 pad_funcs=self.train_loader.pad_funcs,
+                dtype=dtype,
                 forward=self._forward,
                 forward_builder=self._forward_builder,
                 device_put=self._device_batch,

@@ -816,7 +816,7 @@ def test_pyproject_config_parses_without_tomllib():
     fallback parser (this image's python predates tomllib)."""
     cfg = load_config(REPO)
     assert cfg.enable == [
-        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006"
+        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"
     ]
     assert cfg.paths == ["gnot_tpu", "tests", "tools"]
     assert "gnot_tpu/native/" in cfg.exclude
@@ -1083,7 +1083,7 @@ def test_repo_tree_is_clean():
     cfg = load_config(REPO)
     findings, stats = run_analysis(cfg.paths, root=REPO, config=cfg)
     assert stats["rules"] == [
-        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006"
+        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"
     ]
     assert stats["files"] > 90  # gnot_tpu + tests + tools, not a subset
     assert findings == [], "\n".join(f.format() for f in findings)
@@ -1103,7 +1103,103 @@ def test_rule_registry_complete():
     from gnot_tpu.analysis import RULES
 
     assert sorted(RULES) == [
-        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006"
+        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"
     ]
     for rid, cls in RULES.items():
         assert cls.id == rid and cls.title and cls.hint
+
+
+# --- GL007: native ABI drift (ctypes bindings vs extern "C" decls) --------
+
+
+_GL007_CPP = '''
+// comment mentioning void gnot_commented_out(int64_t fake) is ignored
+extern "C" {
+void gnot_pack_rows(const float** srcs, const int64_t* lens, int64_t n,
+                    int64_t dim, int64_t max_len, float* out, float* mask) {}
+void gnot_unpad_rows(const char* src, const int64_t* rows,
+                     const int64_t* offs, const int64_t* lens, int64_t n,
+                     int64_t row_bytes, int64_t tok_bytes, char** dsts) {}
+}
+'''
+
+_GL007_PY_CLEAN = '''
+import ctypes
+def _bind(lib):
+    lib.gnot_pack_rows.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.gnot_unpad_rows.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_void_p),
+    ]
+'''
+
+
+def _gl007_sandbox(tmp_path, py_src, cpp_src=_GL007_CPP):
+    (tmp_path / "gnot_tpu" / "native").mkdir(parents=True, exist_ok=True)
+    (tmp_path / "gnot_tpu" / "native" / "__init__.py").write_text(py_src)
+    (tmp_path / "gnot_tpu" / "native" / "ragged_pack.cpp").write_text(cpp_src)
+    cfg = LintConfig(enable=["GL007"])
+    return run_analysis(["gnot_tpu"], root=str(tmp_path), config=cfg)[0]
+
+
+def test_gl007_clean_bindings_pass(tmp_path):
+    assert _gl007_sandbox(tmp_path, _GL007_PY_CLEAN) == []
+
+
+def test_gl007_arity_drift_is_caught(tmp_path):
+    drifted = _GL007_PY_CLEAN.replace(
+        "        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,\n"
+        "        ctypes.c_void_p, ctypes.c_void_p,",
+        "        ctypes.c_int64, ctypes.c_int64,\n"
+        "        ctypes.c_void_p, ctypes.c_void_p,",
+    )
+    assert drifted != _GL007_PY_CLEAN
+    findings = _gl007_sandbox(tmp_path, drifted)
+    assert len(findings) == 1 and findings[0].rule == "GL007"
+    assert "arity drift" in findings[0].message
+    assert findings[0].project_level  # --changed must never scope it out
+
+
+def test_gl007_dtype_tag_drift_is_caught(tmp_path):
+    drifted = _GL007_PY_CLEAN.replace(
+        "ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_int64),",
+        "ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_void_p),",
+    )
+    assert drifted != _GL007_PY_CLEAN
+    findings = _gl007_sandbox(tmp_path, drifted)
+    assert [f.rule for f in findings] == ["GL007"]
+    assert "dtype-tag drift at arg 1" in findings[0].message
+    assert "POINTER(c_int64)" in findings[0].message
+
+
+def test_gl007_unbound_export_and_missing_symbol(tmp_path):
+    # Binding a symbol the .cpp never declares...
+    phantom = _GL007_PY_CLEAN + (
+        "    lib.gnot_phantom.argtypes = [ctypes.c_int64]\n"
+    )
+    findings = _gl007_sandbox(tmp_path, phantom)
+    assert any("no such extern" in f.message for f in findings)
+    # ...and an extern "C" export with no binding, both drift.
+    extra_cpp = _GL007_CPP.replace(
+        "}\n'",
+        "void gnot_orphan(int64_t n) {}\n}\n'",
+    )
+    extra_cpp = _GL007_CPP.rstrip()[:-1] + "void gnot_orphan(int64_t n) {}\n}\n"
+    findings = _gl007_sandbox(tmp_path, _GL007_PY_CLEAN, extra_cpp)
+    assert any("no ctypes binding" in f.message for f in findings)
+
+
+def test_gl007_real_tree_bindings_agree():
+    """The live bindings and the live .cpp agree right now (the same
+    check test_repo_tree_is_clean enforces, isolated here so a drift
+    failure names the rule instead of the whole gate)."""
+    cfg = load_config(REPO)
+    cfg.enable = ["GL007"]
+    findings, _ = run_analysis(["gnot_tpu"], root=REPO, config=cfg)
+    assert findings == [], "\n".join(f.format() for f in findings)

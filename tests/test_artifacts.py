@@ -233,3 +233,80 @@ def test_serve_trace_example_is_complete_chrome_trace():
     assert len(complete) >= 1
     # Every trace at least entered admission (shed chains stop early).
     assert all("admission" in names for names in by_trace.values())
+
+
+def test_lowprec_ab_artifact_schema():
+    """The committed low-precision serving A/B (tools/lowprec_ab.py):
+    per-dataset bf16-vs-f32 RelL2 parity under the stated bar, both
+    serve arms measured over one shared offered-load ladder through
+    the real replica tier (sustained req/s + tokens/s + p99 at the
+    same SLO), the native-vs-python host-phase trace breakdown showing
+    a measured reduction, and the device microbench that makes the
+    req/s ratio attributable to this backend's bf16 lowering. The
+    quality bar is the hard one (no tolerance loosening anywhere); the
+    throughput record pins no-regression-beyond-the-measured-device-
+    slowdown on this CPU proxy, with the 1.3x MXU design target
+    recorded beside the evidence (docs/performance.md round 12)."""
+    path = os.path.join(ARTIFACT_DIR, "lowprec_ab.jsonl")
+    with open(path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    # Attribution: the artifact names the packer path that produced it.
+    (packer,) = [r for r in recs if r.get("probe") == "native_packer"]
+    assert packer["impl"] in ("native", "python")
+    # Quality parity, per dataset, under the stated bar.
+    parity = {r["dataset"]: r for r in recs if r.get("probe") == "parity"}
+    assert set(parity) == {"darcy64", "elasticity", "ns2d", "heatsink3d"}
+    for r in parity.values():
+        assert 0 < r["rel_l2_f32"] and 0 < r["rel_l2_bf16"]
+        assert abs(r["delta"]) <= r["bar"] == 0.01, (
+            f"{r['dataset']}: bf16 RelL2 delta {r['delta']} over the bar"
+        )
+    # The device microbench (the honest-hardware evidence line).
+    (micro,) = [r for r in recs if r.get("probe") == "device_microbench"]
+    assert micro["dispatch_ms_f32"] > 0 and micro["dispatch_ms_bf16"] > 0
+    assert micro["bf16_dispatch_slowdown"] == pytest.approx(
+        micro["dispatch_ms_bf16"] / micro["dispatch_ms_f32"], rel=1e-2
+    )
+    # Both serve arms over the SAME ladder; every request accounted.
+    runs = [r for r in recs if str(r.get("arm", "")).startswith("serve_")]
+    ladder32 = {r["load_mult"] for r in runs if r["arm"] == "serve_f32"}
+    ladder16 = {r["load_mult"] for r in runs if r["arm"] == "serve_bf16"}
+    assert ladder32 == ladder16 and len(ladder32) >= 3
+    for r in runs:
+        assert r["completed"] + sum(r["shed"].values()) == r["submitted"]
+        assert r["achieved_rps"] <= r["offered_rps"] * 1.25
+        assert r["tokens_per_s"] is None or r["tokens_per_s"] >= 0
+        assert r["dtype"] == (
+            "bfloat16" if r["arm"] == "serve_bf16" else "float32"
+        )
+    # Host-phase before/after (trace_report breakdown): a measured
+    # reduction under the adaptive native path.
+    arms = {r["arm"]: r for r in recs if str(r.get("arm", "")).startswith("host_")}
+    assert set(arms) == {"host_python", "host_native"}
+    for r in arms.values():
+        assert r["batch_assembly_total_ms"] > 0
+        assert r["batch_assembly_trimmed_ms"] > 0
+    (summary,) = [r for r in recs if r.get("summary") == "lowprec_ab"]
+    assert summary["quick"] is False
+    assert summary["parity_max_delta"] <= summary["parity_bar"] == 0.01
+    assert summary["host_reduction_frac"] > 0
+    # Throughput: both arms sustained a point under the ONE shared SLO
+    # ("equal p99" = held to the same number), with the ratio pinned
+    # against the measured device-side slowdown — the host-path work
+    # must not ADD a regression on top of what the backend's bf16
+    # lowering costs (the microbench beside it is the evidence; the
+    # MXU design target stays recorded as bar_req_s_ratio_target).
+    slo = summary["slo_p99_ms"]
+    assert summary["p99_at_sustained_f32"] <= slo
+    assert summary["p99_at_sustained_bf16"] <= slo
+    assert summary["req_s_ratio"] == pytest.approx(
+        summary["sustained_rps_bf16"] / summary["sustained_rps_f32"],
+        rel=1e-2,
+    )
+    assert summary["bar_req_s_ratio_target"] == 1.3
+    assert summary["bf16_dispatch_slowdown_cpu"] > 0
+    floor = min(1.0, 1.0 / summary["bf16_dispatch_slowdown_cpu"]) * 0.8
+    assert summary["req_s_ratio"] >= floor, (
+        "bf16 serving regressed beyond the measured device slowdown — "
+        "the host path added a loss of its own"
+    )

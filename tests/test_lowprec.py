@@ -1,0 +1,249 @@
+"""Low-precision serving numerics: the precision policy, pinned.
+
+The policy (``models/precision.py``) says exactly where bf16 is
+allowed: block matmuls and activations. Everything normalization- or
+metric-critical stays f32 — einsum ACCUMULATION, the attention
+normalizer ``1/<q, k_sum>``, and the output head. These tests pin each
+clause the way the static-analysis suite pins its rules: a conforming
+path must meet the parity bar, and a MUTATED path (the bf16 normalizer
+the policy forbids) must violate it — proving the bar actually guards
+the clause instead of being slack enough to pass anything.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from gnot_tpu.models import precision
+from gnot_tpu.ops.attention import (
+    feature_softmax,
+    normalized_linear_attention,
+    packed_normalized_linear_attention,
+    segment_one_hot,
+)
+
+#: The bf16-vs-f32 relative-error bar for one attention op on bf16
+#: inputs under the policy (f32 accumulation + f32 normalizer). The
+#: bf16 INPUT quantization alone costs ~2^-9 ~ 2e-3; the policy path
+#: must stay at that floor, and the forbidden bf16-normalizer mutant
+#: measurably exceeds it (the mutation test below).
+ATTN_REL_BAR = 3.5e-3
+
+
+def _qkv(seed=0, b=2, h=2, l=2048, d=8):
+    rng = np.random.default_rng(seed)
+    q = feature_softmax(jnp.asarray(rng.standard_normal((b, h, l, d)), jnp.float32))
+    k = feature_softmax(jnp.asarray(rng.standard_normal((b, h, l, d)), jnp.float32))
+    v = jnp.asarray(rng.standard_normal((b, h, l, d)), jnp.float32)
+    mask = jnp.asarray((rng.uniform(size=(b, l)) < 0.8).astype(np.float32))
+    return q, k, v, mask
+
+
+def _rel(a, ref):
+    return float(jnp.linalg.norm(a - ref) / jnp.linalg.norm(ref))
+
+
+# -- the policy object itself ---------------------------------------------
+
+
+def test_policy_pins_f32_sites():
+    pol = precision.policy_for("bfloat16")
+    assert pol.compute_dtype == "bfloat16"
+    assert pol.weights_dtype == "bfloat16"
+    assert pol.accum_dtype == pol.normalizer_dtype == pol.head_dtype == "float32"
+    assert pol.tag == "bf16"
+    # The RelL2-critical sites are FROZEN policy, not knobs.
+    for site in ("accum_dtype", "normalizer_dtype", "head_dtype"):
+        with pytest.raises(ValueError, match="must stay float32"):
+            dataclasses.replace(pol, **{site: "bfloat16"})
+    with pytest.raises(ValueError, match="unknown serve dtype"):
+        precision.policy_for("float16")
+    # The docs table renders one row per policy site.
+    assert len(pol.table()) == 5
+
+
+def test_cast_params_is_identity_for_f32_and_copy_for_bf16():
+    params = {"dense": {"kernel": jnp.ones((4, 4), jnp.float32),
+                        "steps": jnp.asarray(3, jnp.int32)}}
+    assert precision.cast_params(params, "float32") is params
+    cast = precision.cast_params(params, "bfloat16")
+    assert cast["dense"]["kernel"].dtype == jnp.bfloat16
+    assert cast["dense"]["steps"].dtype == jnp.int32  # non-float untouched
+    # The caller's tree is never mutated (params stay f32 at rest).
+    assert params["dense"]["kernel"].dtype == jnp.float32
+
+
+# -- f32 accumulation + normalizer in the attention ops -------------------
+
+
+def test_bf16_attention_meets_policy_bar():
+    q, k, v, mask = _qkv()
+    ref = normalized_linear_attention(q, k, v, kv_mask=mask)
+    out = normalized_linear_attention(
+        q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+        v.astype(jnp.bfloat16), kv_mask=mask,
+    )
+    # The op hands its compute dtype back; the f32 head casts later.
+    assert out.dtype == jnp.bfloat16
+    assert _rel(out.astype(jnp.float32), ref) <= ATTN_REL_BAR
+
+
+def test_f32_attention_is_bitwise_unchanged():
+    """The policy branch must not perturb the f32 path at all — same
+    einsums, no preferred_element_type, bit-for-bit."""
+    q, k, v, mask = _qkv(l=256)
+
+    def legacy(q, k, v, kv_mask):
+        k = k * kv_mask[:, None, :, None].astype(k.dtype)
+        k_sum = jnp.sum(k, axis=2)
+        denom = jnp.einsum("bhld,bhd->bhl", q, k_sum)
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        alpha = 1.0 / denom
+        kv = jnp.einsum("bhld,bhle->bhde", k, v)
+        out = jnp.einsum("bhld,bhde->bhle", q, kv)
+        return alpha[..., None] * out
+
+    np.testing.assert_array_equal(
+        np.asarray(normalized_linear_attention(q, k, v, kv_mask=mask)),
+        np.asarray(legacy(q, k, v, mask)),
+    )
+
+
+def test_mutation_bf16_normalizer_is_caught_by_the_bar():
+    """Mutation-style rule pin: recompute the SAME attention with the
+    policy-forbidden bf16 normalizer (bf16 k_sum accumulation + bf16
+    denominator — the pre-policy math on bf16 inputs). The parity bar
+    that the conforming op meets must CATCH the mutant; if this test
+    ever fails because the mutant passes the bar, the bar is slack and
+    guards nothing."""
+    q, k, v, mask = _qkv()
+    ref = normalized_linear_attention(q, k, v, kv_mask=mask)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+
+    def mutant(q, k, v, kv_mask):
+        k = k * kv_mask[:, None, :, None].astype(k.dtype)
+        k_sum = jnp.sum(k, axis=2)  # bf16 accumulation — forbidden
+        denom = jnp.einsum("bhld,bhd->bhl", q, k_sum)  # bf16 normalizer
+        denom = jnp.where(denom == 0.0, 1.0, denom)
+        kv = jnp.einsum("bhld,bhle->bhde", k, v)
+        out = jnp.einsum("bhld,bhde->bhle", q, kv)
+        return out / denom[..., None]
+
+    rel_policy = _rel(
+        normalized_linear_attention(qb, kb, vb, kv_mask=mask).astype(
+            jnp.float32
+        ),
+        ref,
+    )
+    rel_mutant = _rel(mutant(qb, kb, vb, mask).astype(jnp.float32), ref)
+    assert rel_policy <= ATTN_REL_BAR
+    assert rel_mutant > ATTN_REL_BAR, (
+        f"bf16-normalizer mutant ({rel_mutant}) passes the "
+        f"{ATTN_REL_BAR} bar — the bar no longer guards the policy"
+    )
+    assert rel_mutant > 1.3 * rel_policy
+
+
+def test_bf16_packed_attention_meets_policy_bar():
+    rng = np.random.default_rng(3)
+    b, h, n, c, d, s = 1, 2, 8, 128, 8, 5
+    l = n * c
+    q = feature_softmax(jnp.asarray(rng.standard_normal((b, h, l, d)), jnp.float32))
+    k = feature_softmax(jnp.asarray(rng.standard_normal((b, h, l, d)), jnp.float32))
+    v = jnp.asarray(rng.standard_normal((b, h, l, d)), jnp.float32)
+    seg = jnp.asarray(rng.integers(0, s, size=(b, n)), jnp.int32)
+    oh = segment_one_hot(seg, s)
+    ref = packed_normalized_linear_attention(
+        q, k, v, q_seg_oh=oh, kv_seg_oh=oh
+    )
+    out = packed_normalized_linear_attention(
+        q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+        v.astype(jnp.bfloat16), q_seg_oh=oh, kv_seg_oh=oh,
+    )
+    assert out.dtype == jnp.bfloat16
+    assert _rel(out.astype(jnp.float32), ref) <= ATTN_REL_BAR
+
+
+# -- model-level policy: f32 head, f32-at-rest params ---------------------
+
+
+def _tiny_model_and_batch():
+    from gnot_tpu.config import ModelConfig
+    from gnot_tpu.data import datasets
+    from gnot_tpu.data.batch import collate
+    from gnot_tpu.models.gnot import GNOT
+    from gnot_tpu.train.trainer import init_params
+
+    samples = datasets.synth_darcy2d(4, seed=0, grid_n=8)
+    mc = ModelConfig(
+        n_attn_layers=1, n_attn_hidden_dim=16, n_mlp_num_layers=1,
+        n_mlp_hidden_dim=16, n_input_hidden_dim=16, n_expert=2, n_head=2,
+        **datasets.infer_model_dims(samples),
+    )
+    model = GNOT(mc)
+    params = init_params(model, collate(samples), 0)
+    return model, params, samples
+
+
+def test_serve_model_outputs_f32_head_under_bf16():
+    from gnot_tpu.train.trainer import apply_batch
+    from gnot_tpu.data.batch import collate
+
+    model, params, samples = _tiny_model_and_batch()
+    bf_model = precision.serve_model(model, "bfloat16")
+    assert bf_model.config.dtype == "bfloat16"
+    assert precision.serve_model(model, "float32") is model
+    batch32 = collate(samples)
+    batch16 = collate(samples, dtype="bfloat16")
+    assert batch16.coords.dtype == precision.np_dtype("bfloat16")
+    ref = np.asarray(apply_batch(model, params, batch32))
+    out = np.asarray(
+        apply_batch(
+            bf_model, precision.cast_params(params, "bfloat16"), batch16
+        )
+    )
+    # Output head is f32 by policy — whatever the stack computed in.
+    assert out.dtype == np.float32
+    rel = np.linalg.norm(out - ref) / np.linalg.norm(ref)
+    assert rel < 2e-2, f"bf16 forward rel err {rel}"
+
+
+def test_engine_bf16_publishes_cast_copy_and_keeps_rest_f32():
+    from gnot_tpu.serve import InferenceEngine
+
+    model, params, samples = _tiny_model_and_batch()
+    eng = InferenceEngine(model, params, batch_size=4, dtype="bfloat16")
+    pub = jax.tree.leaves(eng.params)[0].dtype
+    assert pub == jnp.bfloat16
+    # ... while the tree the caller handed over is untouched f32.
+    assert all(
+        l.dtype == jnp.float32 for l in jax.tree.leaves(params)
+    )
+    # Hot reload hands over f32 again; publish casts again.
+    eng.swap_params(params)
+    assert jax.tree.leaves(eng.params)[0].dtype == jnp.bfloat16
+    # Responses are f32 (the policy head) and close to the f32 engine.
+    f32 = InferenceEngine(model, params, batch_size=4)
+    key = f32.bucket_key(samples[0])
+    a = f32.infer([samples[0]], pad_nodes=key[0], pad_funcs=key[1], rows=4)[0]
+    b = eng.infer([samples[0]], pad_nodes=key[0], pad_funcs=key[1], rows=4)[0]
+    assert b.dtype == np.float32
+    assert np.linalg.norm(b - a) / np.linalg.norm(a) < 2e-2
+
+
+def test_dispatch_signatures_are_dtype_keyed():
+    """An f32 and a bf16 program at the SAME shapes are two programs:
+    signature_of carries leaf dtypes, so the AOT table and the
+    compiled-shapes ledger cannot collide them."""
+    from gnot_tpu.data.batch import collate
+    from gnot_tpu.serve.engine import InferenceEngine
+
+    _, _, samples = _tiny_model_and_batch()
+    s32 = InferenceEngine.signature_of(collate(samples))
+    s16 = InferenceEngine.signature_of(collate(samples, dtype="bfloat16"))
+    assert [shape for shape, _ in s32] == [shape for shape, _ in s16]
+    assert s32 != s16

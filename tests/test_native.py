@@ -1,8 +1,32 @@
-"""Native C++ ragged packer vs the numpy fallback."""
+"""Native C++ ragged packer vs the numpy fallback.
+
+The dispatch between them is a MEASURED policy
+(``native.PACK_NATIVE_MIN_BYTES`` / ``NATIVE_UNPAD_MIN_BYTES``): below
+the payload bars numpy's calloc+C-core copies are the fast path, above
+them the native sweep is. Parity tests force the native path
+(``force_native``) so small fixtures exercise the C++ code instead of
+silently comparing numpy against itself."""
+
+import contextlib
 
 import numpy as np
 
 from gnot_tpu import native
+
+
+@contextlib.contextmanager
+def force_native():
+    """Drop the payload bars to 0 so every call runs the native path
+    (when the .so loaded) regardless of size."""
+    saved = dict(native.PACK_NATIVE_MIN_BYTES)
+    saved_unpad = native.NATIVE_UNPAD_MIN_BYTES
+    native.PACK_NATIVE_MIN_BYTES.update({"float32": 0, "bfloat16": 0})
+    native.NATIVE_UNPAD_MIN_BYTES = 0
+    try:
+        yield
+    finally:
+        native.PACK_NATIVE_MIN_BYTES.update(saved)
+        native.NATIVE_UNPAD_MIN_BYTES = saved_unpad
 
 
 def _ragged(rng, n, dim, lo=3, hi=40):
@@ -19,21 +43,24 @@ def test_native_builds_and_loads():
 
 def test_pack_rows_matches_numpy():
     rng = np.random.default_rng(0)
-    for n, dim in [(1, 2), (4, 3), (16, 7)]:
-        arrs = _ragged(rng, n, dim)
-        max_len = max(a.shape[0] for a in arrs) + 5
-        out_n, mask_n = native.pack_rows(arrs, max_len)
-        out_p, mask_p = native.pack_rows_numpy(arrs, max_len)
-        np.testing.assert_array_equal(out_n, out_p)
-        np.testing.assert_array_equal(mask_n, mask_p)
+    with force_native():
+        for n, dim in [(1, 2), (4, 3), (16, 7)]:
+            arrs = _ragged(rng, n, dim)
+            max_len = max(a.shape[0] for a in arrs) + 5
+            out_n, mask_n = native.pack_rows(arrs, max_len)
+            out_p, mask_p = native.pack_rows_numpy(arrs, max_len)
+            np.testing.assert_array_equal(out_n, out_p)
+            np.testing.assert_array_equal(mask_n, mask_p)
 
 
 def test_pack_rows_large_threaded_path():
     rng = np.random.default_rng(1)
-    # > 4 MiB total to cross the threading threshold in ragged_pack.cpp.
+    # ~8 MiB total: exercises the native sweep at real size (threading
+    # itself engages at 32 MiB — see ragged_pack.cpp for_samples).
     arrs = _ragged(rng, 32, 64, lo=500, hi=1200)
     max_len = max(a.shape[0] for a in arrs)
-    out_n, mask_n = native.pack_rows(arrs, max_len)
+    with force_native():
+        out_n, mask_n = native.pack_rows(arrs, max_len)
     out_p, mask_p = native.pack_rows_numpy(arrs, max_len)
     np.testing.assert_array_equal(out_n, out_p)
     np.testing.assert_array_equal(mask_n, mask_p)
@@ -60,6 +87,133 @@ def test_collate_uses_packer_consistently():
     np.testing.assert_array_equal(b1.node_mask, b2.node_mask)
 
 
+def test_pack_rows_bf16_bitwise_matches_numpy_fallback():
+    """The fused pad-and-cast sweep must be BITWISE the ml_dtypes RNE
+    cast the Python fallback does — NaNs, denormals, ties and infs
+    included — so which implementation assembled a bf16 dispatch can
+    never change a served bit."""
+    import ml_dtypes
+
+    if not native.native_available():
+        import pytest
+
+        pytest.skip("native packer unavailable")
+    rng = np.random.default_rng(7)
+    arrs = _ragged(rng, 6, 4)
+    # Adversarial block: specials + RNE tie patterns + denormals.
+    arrs[0] = np.array(
+        [
+            [np.nan, -np.nan, np.inf, -np.inf],
+            [0.0, -0.0, 1e-40, -1e-40],
+            # 1.0 + 2^-9 exactly (an RNE tie) and its neighbors.
+            [1.001953125, 1.0019531, 1.0019532, -1.001953125],
+            [3.3895314e38, -3.3895314e38, 65504.0, 1.5],
+        ],
+        np.float32,
+    )
+    max_len = max(a.shape[0] for a in arrs) + 3
+    with force_native():
+        out_c, mask_c = native.pack_rows(arrs, max_len, "bfloat16")
+    out_p, mask_p = native.pack_rows_numpy(arrs, max_len, "bfloat16")
+    assert out_c.dtype == out_p.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(
+        out_c.view(np.uint16), out_p.view(np.uint16)
+    )
+    np.testing.assert_array_equal(
+        mask_c.view(np.uint16), mask_p.view(np.uint16)
+    )
+    # And both agree with a straight ml_dtypes cast of the padded f32.
+    with force_native():
+        out_f, _ = native.pack_rows(arrs, max_len, "float32")
+    np.testing.assert_array_equal(
+        out_c.view(np.uint16),
+        out_f.astype(ml_dtypes.bfloat16).view(np.uint16),
+    )
+
+
+def test_pack_rows_bf16_empty_and_oversize_edges():
+    """Edge parity: a zero-length block packs to an all-pad row on both
+    paths, and the oversize guard raises identically BEFORE either
+    implementation is chosen (the fallback can't accept what the
+    native path rejects)."""
+    import pytest
+
+    arrs = [np.zeros((0, 3), np.float32), np.ones((2, 3), np.float32)]
+    for dtype in ("float32", "bfloat16"):
+        out_c, mask_c = native.pack_rows(arrs, 4, dtype)
+        out_p, mask_p = native.pack_rows_numpy(arrs, 4, dtype)
+        np.testing.assert_array_equal(np.asarray(out_c, np.float32),
+                                      np.asarray(out_p, np.float32))
+        assert float(np.asarray(mask_c, np.float32)[0].sum()) == 0.0
+        assert float(np.asarray(mask_c, np.float32)[1].sum()) == 2.0
+    big = [np.ones((9, 3), np.float32)]
+    # The oversize guard sits BEFORE the native/fallback choice, so an
+    # oversize block fails identically whichever implementation loads
+    # (this is the serve oversize-fallback edge: the server routes such
+    # requests to a bigger bucket, never into a too-small pack).
+    with pytest.raises(ValueError, match="exceeds max_len"):
+        native.pack_rows(big, 8, "bfloat16")
+    lib, native._lib, native._load_failed = native._lib, None, True
+    try:
+        with pytest.raises(ValueError, match="exceeds max_len"):
+            native.pack_rows(big, 8, "bfloat16")
+    finally:
+        native._lib, native._load_failed = lib, False
+    with pytest.raises(ValueError, match="dtype must be"):
+        native.pack_rows(arrs, 4, "float16")
+
+
+def test_unpad_rows_matches_numpy_exactly():
+    """Batched native unpad vs the Python slice loop: exact bytes for
+    padded spans (row, 0, n), packed spans (row, offset, n), empty
+    spans (n=0), f32 and bf16 element types; results are OWNED arrays,
+    not views into the dispatch buffer."""
+    import ml_dtypes
+
+    rng = np.random.default_rng(5)
+    out = rng.standard_normal((3, 40, 2)).astype(np.float32)
+    spans = [(0, 0, 17), (1, 8, 20), (2, 0, 0), (1, 28, 12)]
+    for arr in (out, out.astype(ml_dtypes.bfloat16)):
+        with force_native():
+            got = native.unpad_rows(arr, spans)
+        want = native.unpad_rows_numpy(arr, spans)
+        assert [g.shape for g in got] == [(17, 2), (20, 2), (0, 2), (12, 2)]
+        for g, w in zip(got, want):
+            assert g.dtype == arr.dtype
+            np.testing.assert_array_equal(
+                g.view(np.uint16) if g.dtype != np.float32 else g,
+                w.view(np.uint16) if w.dtype != np.float32 else w,
+            )
+            assert g.base is None  # owned, never a view into `arr`
+
+
+def test_unpad_rows_bounds_checked():
+    import pytest
+
+    out = np.zeros((2, 8, 1), np.float32)
+    with pytest.raises(ValueError, match="out of bounds"):
+        native.unpad_rows(out, [(0, 4, 5)])
+    with pytest.raises(ValueError, match="out of bounds"):
+        native.unpad_rows(out, [(2, 0, 1)])
+    with pytest.raises(ValueError, match=r"\[R, L, dim\]"):
+        native.unpad_rows(np.zeros((4, 4), np.float32), [(0, 0, 1)])
+
+
+def test_native_status_is_attributable():
+    st = native.status()
+    assert set(st) == {
+        "available", "impl", "so", "error",
+        "pack_native_min_bytes", "unpad_native_min_bytes",
+    }
+    assert st["impl"] in ("native", "python")
+    # The record carries the adaptive-dispatch policy: a reader can
+    # tell which payload classes actually ran the C sweep.
+    assert st["pack_native_min_bytes"] == native.PACK_NATIVE_MIN_BYTES
+    assert st["unpad_native_min_bytes"] == native.NATIVE_UNPAD_MIN_BYTES
+    if st["available"]:
+        assert st["impl"] == "native" and st["so"].endswith(".so")
+
+
 def test_pack_rows_fuzz_matches_numpy():
     """Randomized shapes/lengths: the C++ packer and the numpy fallback
     must agree bit-for-bit, including mask placement."""
@@ -70,15 +224,49 @@ def test_pack_rows_fuzz_matches_numpy():
 
         pytest.skip("native packer unavailable")
     rng = np.random.default_rng(123)
-    for _ in range(50):
-        n = int(rng.integers(1, 9))
-        dim = int(rng.integers(1, 17))
-        lens = rng.integers(0, 33, size=n)
-        max_len = int(max(lens.max(), 1) + rng.integers(0, 8))
-        arrs = [
-            rng.normal(size=(int(m), dim)).astype(np.float32) for m in lens
-        ]
-        out_c, mask_c = native.pack_rows(arrs, max_len)
-        out_np, mask_np = native.pack_rows_numpy(arrs, max_len)
-        np.testing.assert_array_equal(out_c, out_np)
-        np.testing.assert_array_equal(mask_c, mask_np)
+    with force_native():
+        for _ in range(50):
+            n = int(rng.integers(1, 9))
+            dim = int(rng.integers(1, 17))
+            lens = rng.integers(0, 33, size=n)
+            max_len = int(max(lens.max(), 1) + rng.integers(0, 8))
+            arrs = [
+                rng.normal(size=(int(m), dim)).astype(np.float32) for m in lens
+            ]
+            dt = "bfloat16" if rng.integers(2) else "float32"
+            out_c, mask_c = native.pack_rows(arrs, max_len, dt)
+            out_np, mask_np = native.pack_rows_numpy(arrs, max_len, dt)
+            v = np.uint16 if dt == "bfloat16" else np.float32
+            np.testing.assert_array_equal(out_c.view(v), out_np.view(v))
+            np.testing.assert_array_equal(mask_c.view(v), mask_np.view(v))
+
+
+def test_pack_rows_bf16_f64_input_rounds_identically():
+    """Non-f32 input must round f64->f32->bf16 on BOTH paths: the
+    native sweep reads f32 bits, so a fallback that cast f64->bf16
+    directly would diverge on double-rounding edge values."""
+    import ml_dtypes
+
+    if not native.native_available():
+        import pytest
+
+        pytest.skip("native packer unavailable")
+    rng = np.random.default_rng(11)
+    # Values engineered near f32 rounding boundaries + random f64s.
+    a = np.concatenate([
+        rng.standard_normal(64) * np.float64(1.0000000596046448),
+        np.nextafter(np.float64(1.001953125), 2.0) * np.ones(8),
+        rng.standard_normal(64),
+    ]).reshape(-1, 4)
+    arrs = [a, rng.standard_normal((5, 4))]  # float64 blocks
+    with force_native():
+        out_c, _ = native.pack_rows(arrs, 40, "bfloat16")
+    out_p, _ = native.pack_rows_numpy(arrs, 40, "bfloat16")
+    np.testing.assert_array_equal(
+        out_c.view(np.uint16), out_p.view(np.uint16)
+    )
+    # And both equal the canonical two-step rounding.
+    want = arrs[0].astype(np.float32).astype(ml_dtypes.bfloat16)
+    np.testing.assert_array_equal(
+        out_c[0, : a.shape[0]].view(np.uint16), want.view(np.uint16)
+    )

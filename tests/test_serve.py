@@ -1428,3 +1428,175 @@ def test_coldstart_ab_quick_smoke(tmp_path):
     assert arms == {"deploy", "cold", "prewarmed"}
     assert summary["shed_prewarmed"] == 0
     assert summary["speedup"] > 1.0
+
+
+# --- low-precision serving (ISSUE 12): serve.dtype = bf16 -----------------
+
+
+def test_bf16_server_storm_end_to_end(setup, tmp_path):
+    """A bf16 server serves the same traffic the f32 server does: every
+    request completes, responses are f32 (the policy head) and within
+    the parity bar of the f32 engine's answers, the summary names its
+    dtype, and the compiled-program bound holds (bf16 programs are
+    dtype-keyed, not extra shapes)."""
+    import serve_smoke
+
+    model, params, samples, f32_engine = setup
+    engine = InferenceEngine(
+        model, params, batch_size=MAX_BATCH, dtype="bfloat16"
+    )
+    traffic = serve_smoke.mixed_traffic(8, seed=3)
+    engine.warmup(traffic, rows=MAX_BATCH)
+    server, sink, path = make_server(setup, tmp_path, engine=engine)
+    server.start()
+    futures = [server.submit(s) for s in traffic]
+    results = [f.result(timeout=60) for f in futures]
+    summary = server.drain()
+    sink.close()
+    assert all(r.ok for r in results)
+    assert summary["dtype"] == "bfloat16"
+    f32_engine.warmup(traffic, rows=MAX_BATCH)
+    for s, r in zip(traffic, results):
+        assert r.output.dtype == np.float32
+        key = f32_engine.bucket_key(s)
+        ref = f32_engine.infer(
+            [s], pad_nodes=key[0], pad_funcs=key[1], rows=MAX_BATCH
+        )[0]
+        rel = np.linalg.norm(r.output - ref) / max(
+            np.linalg.norm(ref), 1e-12
+        )
+        assert rel < 2e-2, f"bf16 response drifted {rel} from f32"
+    buckets = {f32_engine.bucket_key(s) for s in traffic}
+    assert summary["compiled_shapes"] <= len(buckets)
+
+
+def test_bf16_aot_roundtrip_serves_with_zero_jit_fallbacks(setup, tmp_path):
+    """ISSUE 12 acceptance: AOT prewarm/hydrate round-trips dtype-keyed
+    programs — a bf16 deployment hydrates a bf16 manifest (keys carry
+    the @bf16 tag) and serves its first requests entirely through the
+    installed executables: zero jit fallbacks."""
+    import serve_smoke
+
+    from gnot_tpu.serve import aot
+
+    model, params, _, _ = setup
+    traffic = serve_smoke.mixed_traffic(6, seed=4)
+    deploy = InferenceEngine(
+        model, params, batch_size=MAX_BATCH, dtype="bfloat16"
+    )
+    manifest = aot.prewarm_deployment(
+        [(0, deploy)], traffic, rows=MAX_BATCH,
+        snapshot_dir=str(tmp_path / "snap"),
+    )
+    assert manifest["dtype"] == "bfloat16"
+    assert all(k.endswith("@bf16") for k in manifest["program_keys"])
+    fresh = InferenceEngine(
+        model, params, batch_size=MAX_BATCH, dtype="bfloat16"
+    )
+    stats = aot.hydrate_block(fresh, manifest, 0)
+    assert stats["installed"] == len(manifest["program_keys"])
+    assert stats["skipped"] == 0
+    for s in traffic:
+        key = fresh.bucket_key(s)
+        out = fresh.infer(
+            [s], pad_nodes=key[0], pad_funcs=key[1], rows=MAX_BATCH
+        )[0]
+        assert out.shape[0] == s.coords.shape[0]
+    counts = fresh.dispatch_counts
+    assert counts["jit"] == 0 and counts["aot"] == len(traffic)
+
+
+def test_dtype_mismatched_snapshots_are_refused_wholesale(setup, tmp_path):
+    """A bf16 deployment handed an f32 manifest (or vice versa) must
+    refuse EVERY snapshot with the named reason and serve cold — an
+    f32 executable at a bf16 deployment's shapes is the wrong program,
+    not a warm one. The replica warm_stats surface the refusal."""
+    import serve_smoke
+
+    from gnot_tpu.serve import aot
+
+    model, params, _, _ = setup
+    traffic = serve_smoke.mixed_traffic(4, seed=5)
+    f32_manifest, _ = _prewarm_manifest(
+        setup, tmp_path, n=1, traffic=traffic
+    )
+    assert f32_manifest["dtype"] == "float32"
+    assert all(k.endswith("@f32") for k in f32_manifest["program_keys"])
+    bf16_engine = InferenceEngine(
+        model, params, batch_size=MAX_BATCH, dtype="bfloat16"
+    )
+    stats = aot.hydrate_block(bf16_engine, f32_manifest, 0)
+    assert stats["installed"] == 0
+    assert stats["skipped"] == len(f32_manifest["program_keys"])
+    assert stats["reason"] == "dtype_mismatch"
+    assert bf16_engine.aot_programs == 0
+    # A v1-era manifest (predates serving dtypes) cannot even load.
+    stale = dict(f32_manifest, version=1)
+    aot.save_manifest(str(tmp_path / "stale.json"), stale)
+    # save_manifest re-stamps the current version; doctor it back.
+    doc = json.load(open(str(tmp_path / "stale.json")))
+    doc["version"] = 1
+    json.dump(doc, open(str(tmp_path / "stale.json"), "w"))
+    with pytest.raises(ValueError, match="version"):
+        aot.load_manifest(str(tmp_path / "stale.json"))
+    # The reverse direction refuses too (f32 engine, bf16 manifest).
+    deploy = InferenceEngine(
+        model, params, batch_size=MAX_BATCH, dtype="bfloat16"
+    )
+    bf16_manifest = aot.prewarm_deployment(
+        [(0, deploy)], traffic, rows=MAX_BATCH,
+        snapshot_dir=str(tmp_path / "snap2"),
+    )
+    (twin,) = _make_replicas(setup, 1)
+    ws = twin.prewarm_from(bf16_manifest)
+    assert ws["reason"] == "dtype_mismatch" and ws["source"] == "none"
+
+
+def test_router_reports_dtype_on_routes_and_summary(setup, tmp_path):
+    """The replica/router plumbing names the serving dtype: every route
+    event and the pool serve_summary carry it (the A/B artifact's
+    attribution chain)."""
+    from gnot_tpu.serve import ReplicaRouter
+
+    model, params, samples, _ = setup
+    replicas = _make_replicas(setup, 2, dtype="bfloat16")
+    for r in replicas:
+        assert r.engine.dtype == "bfloat16"
+        r.warm(samples[:2], rows=MAX_BATCH)
+    sink = MetricsSink(str(tmp_path / "serve.jsonl"))
+    router = ReplicaRouter(
+        replicas, max_batch=MAX_BATCH, max_wait_ms=2.0, sink=sink
+    )
+    router.start()
+    futures = [router.submit(s) for s in samples[:6]]
+    for f in futures:
+        assert f.result(timeout=60).ok
+    summary = router.drain()
+    sink.close()
+    assert summary["dtype"] == "bfloat16"
+    events = read_events(str(tmp_path / "serve.jsonl"))
+    routes = [e for e in events if e.get("event") == "route"]
+    assert routes and all(e["dtype"] == "bfloat16" for e in routes)
+    pool = [
+        e for e in events
+        if e.get("event") == "serve_summary" and "routing" in e
+    ]
+    assert pool and pool[0]["dtype"] == "bfloat16"
+
+
+@pytest.mark.slow
+def test_lowprec_ab_quick_smoke(tmp_path):
+    """tools/lowprec_ab.py --quick end-to-end (in-process: structure
+    and bookkeeping, not the committed artifact's bars, which
+    test_artifacts pins): parity within the bar on the quick dataset,
+    both serve arms measured, the host-phase arms recorded."""
+    import lowprec_ab
+
+    out = str(tmp_path / "ab.jsonl")
+    summary = lowprec_ab.run(["--quick", "--out", out])
+    assert summary["quick"] is True
+    assert summary["parity_max_delta"] <= summary["parity_bar"]
+    recs = [json.loads(l) for l in open(out) if l.strip()]
+    arms = {r.get("arm") for r in recs if "arm" in r}
+    assert {"serve_f32", "serve_bf16", "host_python", "host_native"} <= arms
+    assert summary["bf16_dispatch_slowdown_cpu"] > 0

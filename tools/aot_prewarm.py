@@ -52,6 +52,14 @@ def run(argv=None) -> dict:
     p.add_argument("--packed", action="store_true",
                    help="also compile the PackPlan program")
     p.add_argument("--pack_chunk", type=int, default=64)
+    p.add_argument(
+        "--serve_dtype", type=str, default="float32",
+        choices=["float32", "bfloat16"],
+        help="serving compute dtype the deployment will run at "
+             "(models/precision.py): programs, keys and the manifest "
+             "are dtype-bound — a bf16 deployment refuses an f32 "
+             "manifest wholesale, so prewarm at the dtype you serve"
+    )
     p.add_argument("--snapshot_dir", type=str, required=True)
     p.add_argument(
         "--manifest", type=str, default="",
@@ -81,7 +89,7 @@ def run(argv=None) -> dict:
     from serve_smoke import build_engine, mixed_traffic
 
     cache_dir = enable_compile_cache()
-    engine = build_engine(max_batch=args.max_batch)
+    engine = build_engine(max_batch=args.max_batch, dtype=args.serve_dtype)
     traffic = mixed_traffic(
         args.n, mesh_lo=args.mesh_lo, mesh_hi=args.mesh_hi
     )
@@ -95,7 +103,7 @@ def run(argv=None) -> dict:
     if args.replicas > 1:
         replicas = build_replicas(
             engine.model, engine.params, args.replicas,
-            batch_size=args.max_batch,
+            batch_size=args.max_batch, dtype=args.serve_dtype,
         )
         engines = [(r.replica_id, r.engine) for r in replicas]
     else:

@@ -89,12 +89,14 @@ def _ensure_xla_flags(n_replicas: int) -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 
-def make_replicas(model, params, n_replicas, *, max_batch, traffic):
+def make_replicas(model, params, n_replicas, *, max_batch, traffic,
+                  dtype="float32"):
     """N warmed replicas, one device each (the arms differ ONLY in
     replica count). Warm compiles are the expensive part — callers
     build replicas once per arm and put a FRESH router over them per
-    run (jitted executables persist on the engines). Returns
-    (replicas, warm_stats)."""
+    run (jitted executables persist on the engines). ``dtype`` is the
+    serving compute dtype (the low-precision A/B arms differ only in
+    it — tools/lowprec_ab.py). Returns (replicas, warm_stats)."""
     import jax
 
     from gnot_tpu.serve import build_replicas
@@ -109,6 +111,7 @@ def make_replicas(model, params, n_replicas, *, max_batch, traffic):
     replicas = build_replicas(
         model, params, n_replicas,
         batch_size=max_batch, devices=devices[:n_replicas],
+        dtype=dtype,
     )
     with compile_cache_probe() as warm_stats:
         warmed = sum(r.warm(traffic, rows=max_batch) for r in replicas)

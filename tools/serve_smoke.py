@@ -39,10 +39,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def build_engine(seed: int = 0, max_batch: int = 4):
+def build_engine(seed: int = 0, max_batch: int = 4, dtype: str = "float32"):
     """Tiny GNOT + fresh params on the Darcy64 schema (64-point grid,
     one input function) — weights untrained; serving correctness is
-    about plumbing, not accuracy."""
+    about plumbing, not accuracy. ``dtype`` is the serving compute
+    dtype (models/precision.py); params stay f32 at rest."""
     from gnot_tpu.config import ModelConfig
     from gnot_tpu.data import datasets
     from gnot_tpu.data.batch import collate
@@ -58,7 +59,7 @@ def build_engine(seed: int = 0, max_batch: int = 4):
     )
     model = GNOT(mc)
     params = init_params(model, collate(samples), seed)
-    return InferenceEngine(model, params, batch_size=max_batch)
+    return InferenceEngine(model, params, batch_size=max_batch, dtype=dtype)
 
 
 def mixed_traffic(n: int, seed: int = 0, mesh_lo: int = 300, mesh_hi: int = 700):
