@@ -371,6 +371,21 @@ class ServeConfig:
     # (bucket signatures, PackPlan programs, AOT manifests) is
     # dtype-keyed, so a bf16 deployment refuses f32 snapshots.
     dtype: str = "float32"
+    # Autoregressive rollout serving (serve/rollout.py, docs/serving.md
+    # "Rollout serving"): with rollout_steps K > 0 the --serve
+    # entrypoint drives each test sample as ONE K-step session — K
+    # chained dispatches whose carry stays resident on the owning
+    # replica, per-step deadlines (deadline_ms applies per step),
+    # streaming partial results, and router-driven migration from the
+    # rolling host-side snapshot when the owner dies mid-rollout.
+    # 0 = one-shot serving (the historical path, unchanged).
+    rollout_steps: int = 0
+    # Rolling session-snapshot cadence (steps between host-side carry
+    # snapshots — the state a migration replays from; the supervisor's
+    # last-good pattern applied to serving). 1 = snapshot every step
+    # (zero replay on migration); larger trades snapshot copies for
+    # at-least-once replayed steps.
+    session_snapshot_every: int = 1
     # Deploy-time AOT prewarm manifest (tools/aot_prewarm.py,
     # docs/serving.md "Deploy-time prewarm"): when set, serving
     # hydrates each engine's executables from the manifest's
@@ -412,6 +427,15 @@ class ServeConfig:
         if self.breaker_threshold < 1:
             raise ValueError(
                 f"breaker_threshold must be >= 1, got {self.breaker_threshold}"
+            )
+        if self.rollout_steps < 0:
+            raise ValueError(
+                f"rollout_steps must be >= 0, got {self.rollout_steps}"
+            )
+        if self.session_snapshot_every < 1:
+            raise ValueError(
+                "session_snapshot_every must be >= 1, got "
+                f"{self.session_snapshot_every}"
             )
         from gnot_tpu.models.precision import SERVE_DTYPES
 

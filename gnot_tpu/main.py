@@ -246,6 +246,21 @@ def build_parser() -> argparse.ArgumentParser:
              "every N requests (0 = never) — exercises the atomic "
              "weight swap under load"
     )
+    p.add_argument(
+        "--serve_rollout_steps", type=int, default=0,
+        help="serving: autoregressive rollout mode (docs/serving.md "
+             "'Rollout serving') — drive each test sample as ONE "
+             "K-step stateful session (K chained dispatches, carry "
+             "resident on the owning replica, per-step deadlines, "
+             "streamed partial results, migration on replica failure); "
+             "0 = one-shot serving"
+    )
+    p.add_argument(
+        "--session_snapshot_every", type=int, default=1,
+        help="serving: rollout-session snapshot cadence (steps between "
+             "host-side carry snapshots — the state a migration "
+             "replays from; 1 = every step)"
+    )
     p.add_argument("--checkpoint_every", type=int, default=0)
     p.add_argument(
         "--stop_after_epoch", type=int, default=0,
@@ -415,6 +430,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "serve.replicas": args.serve_replicas,
             "serve.route_policy": args.route_policy,
             "serve.prewarm_manifest": args.serve_prewarm,
+            "serve.rollout_steps": args.serve_rollout_steps,
+            "serve.session_snapshot_every": args.session_snapshot_every,
             "mesh.data": args.mesh_data,
             "mesh.seq": args.mesh_seq,
             "mesh.model": args.mesh_model,
@@ -953,6 +970,7 @@ def _run_serve(
             faults=FaultInjector.from_spec(sc.inject_fault),
             preempt=preempt,
             tracer=tracer,
+            session_snapshot_every=sc.session_snapshot_every,
         )
         if replicas is not None:
             server = ReplicaRouter(
@@ -1025,10 +1043,19 @@ def _run_serve(
             }
         server.start()
         futures = []
+        rollout_k = sc.rollout_steps
         for i, s in enumerate(samples):
             if preempt.triggered:
                 break
-            futures.append(server.submit(s))
+            if rollout_k:
+                # Rollout serving (docs/serving.md "Rollout serving"):
+                # each sample becomes one K-step stateful session — K
+                # chained dispatches, carry resident on the owning
+                # replica, streamed partial results, migration on
+                # owner failure.
+                futures.append(server.submit_rollout(s, rollout_k))
+            else:
+                futures.append(server.submit(s))
             if (
                 args.serve_reload_every
                 and checkpointer is not None
@@ -1037,10 +1064,12 @@ def _run_serve(
                 # On the router this is the ROLLING reload: one replica
                 # warms at a time, old weights keep serving.
                 server.reload(deadline_ms=sc.deadline_ms)
+        session_timeout = sc.drain_timeout_s * max(1, rollout_k)
         for f in futures:
-            f.result(timeout=sc.drain_timeout_s)
+            f.result(timeout=session_timeout)
         summary = server.drain(sc.drain_timeout_s)
     routing = summary.get("routing")
+    sessions = summary.get("sessions")
     print(
         f"Serve: {summary['completed']}/{summary['requests']} ok, "
         f"shed={summary['shed']}, breaker_trips={summary['breaker_trips']}, "
@@ -1053,7 +1082,18 @@ def _run_serve(
             if routing
             else ""
         )
+        + (
+            f", sessions={sessions['completed']}/{sessions['started']} "
+            f"complete (migrated={sessions.get('migrated', 0)}, "
+            f"lost={sessions.get('lost', sessions.get('failed', 0))}), "
+            f"step_p50={sessions['step_latency_p50_ms']}ms"
+            if sessions
+            else ""
+        )
     )
+    if rollout_k:
+        done = sum(1 for f in futures if f.result().ok)
+        return done / max(1, len(futures))
     return summary["completed"] / max(1, summary["requests"])
 
 

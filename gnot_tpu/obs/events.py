@@ -50,6 +50,9 @@ ROLLING_RELOAD = "rolling_reload"
 AOT_PREWARM = "aot_prewarm"
 REPLICA_WARM = "replica_warm"
 NATIVE_PACKER = "native_packer"
+ROLLOUT_STEP = "rollout_step"
+SESSION_SNAPSHOT = "session_snapshot"
+SESSION_MIGRATE = "session_migrate"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -149,8 +152,9 @@ EVENTS: dict[str, EventSpec] = {
     "shed": EventSpec(
         fields=("reason",),
         module="gnot_tpu/serve/server.py",
-        doc="a request was shed/rejected (reason + per-reason detail)",
-        optional=("trace_id", "trace_ids", "replica"),
+        doc="a request was shed/rejected (reason + per-reason detail; "
+        "a shed rollout SESSION carries its `session` id)",
+        optional=("trace_id", "trace_ids", "replica", "session", "step"),
     ),
     "breaker_open": EventSpec(
         fields=("state", "reason", "detail", "trips"),
@@ -188,7 +192,7 @@ EVENTS: dict[str, EventSpec] = {
         "names the serving compute dtype the numbers were measured at",
         optional=(
             "queue_device_by_bucket", "pad_waste_by_bucket", "replica",
-            "per_replica", "routing", "dtype",
+            "per_replica", "routing", "dtype", "sessions",
         ),
     ),
     "route": EventSpec(
@@ -197,8 +201,10 @@ EVENTS: dict[str, EventSpec] = {
         doc="one placement decision: which replica got the request and "
         "why (affinity | cold_assign | spill | least_loaded | "
         "round_robin | pool_full | no_healthy); `dtype` is the pool's "
-        "serving compute dtype",
-        optional=("dtype",),
+        "serving compute dtype; a rollout session's FIRST-step "
+        "placement carries its `session` id (steps 2..K never "
+        "re-route — session affinity)",
+        optional=("dtype", "session"),
     ),
     "replica_health": EventSpec(
         fields=("replica", "healthy", "reason"),
@@ -244,6 +250,34 @@ EVENTS: dict[str, EventSpec] = {
         "attributable to the code path that produced them",
         optional=("so", "error", "pack_native_min_bytes",
                   "unpad_native_min_bytes"),
+    ),
+    "rollout_step": EventSpec(
+        fields=("session", "step", "steps", "latency_ms"),
+        module="gnot_tpu/serve/server.py",
+        doc="one committed step of an autoregressive rollout session "
+        "(1-indexed `step` of `steps`; the carry advanced and the "
+        "partial result streamed)",
+        optional=("replica", "dispatch"),
+    ),
+    "session_snapshot": EventSpec(
+        fields=("session", "step"),
+        module="gnot_tpu/serve/server.py",
+        doc="a rollout session's carry was snapshotted host-side (the "
+        "rolling last-good state a migration replays from; cadence "
+        "`serve.session_snapshot_every`, plus a final persist at "
+        "drain)",
+        optional=("replica",),
+    ),
+    "session_migrate": EventSpec(
+        fields=(
+            "session", "from_replica", "to_replica", "at_step",
+            "replay_from", "reason",
+        ),
+        module="gnot_tpu/serve/router.py",
+        doc="a rollout session was re-placed onto a sibling replica "
+        "after its owner failed mid-rollout (`reason` names the "
+        "failure; replay resumes from the `replay_from` snapshot "
+        "cursor — at-least-once step semantics, zero lost sessions)",
     ),
     "trace_flush": EventSpec(
         fields=("path", "spans", "dropped"),

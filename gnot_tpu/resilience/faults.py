@@ -35,6 +35,23 @@ Serve-side kinds (``serve.inject_fault``, consumed by
   directory immediately before the Nth hot reload reads it, so the
   reload must survive via the restore fallback chain.
 
+Rollout-serving kinds (stateful sessions, ``serve/rollout.py`` —
+``STEP`` is the server's 1-indexed rollout-step admission ordinal, the
+count of session steps that server has accepted):
+
+* ``replica_kill@STEP`` — the replica dies just before dispatching its
+  STEPth rollout step (worker exits; every in-system request fails
+  ``error_replica_dead``): the mid-rollout replica loss whose sessions
+  the router must migrate from their snapshots.
+* ``stale_session@STEP`` — the session carry behind the STEPth rollout
+  step is lost/stale at dispatch (``error_stale_session``): resident
+  state evicted under it (host OOM, a buggy eviction) — restore from
+  snapshot, don't serve a wrong trajectory.
+* ``rollout_nan@STEP`` — NaN-poison the outputs of the dispatch
+  carrying the STEPth rollout step (a sick chip mid-trajectory): feeds
+  the breaker like ``nan_output``, and the victim session must replay,
+  not keep a poisoned carry.
+
 Steps are 1-indexed global update counts (the trainer's ``host_step``
 after the dispatch), matching the step numbers in metrics records;
 serve ordinals are 1-indexed admission/dispatch/reload counts.
@@ -69,6 +86,10 @@ FAULT_KINDS = (
     "slow_request",
     "nan_output",
     "reload_corrupt",
+    # rollout-serving (stateful sessions, serve/rollout.py)
+    "replica_kill",
+    "stale_session",
+    "rollout_nan",
 )
 
 KINDS = FAULT_KINDS  # legacy alias
@@ -198,6 +219,46 @@ class FaultInjector:
             logger.warning(
                 "fault injection: NaN outputs on serving dispatch #%d",
                 dispatch,
+            )
+            return True
+        return False
+
+    def maybe_replica_kill(self, rollout_step: int) -> bool:
+        """True once when the server's ``rollout_step``-th session step
+        has a ``replica_kill`` armed: the worker dies before the
+        dispatch (every in-system request fails ``error_replica_dead``
+        and the worker thread exits — the router's ``dead`` health
+        signal)."""
+        if self._take("replica_kill", rollout_step):
+            logger.warning(
+                "fault injection: replica kill at rollout step #%d",
+                rollout_step,
+            )
+            return True
+        return False
+
+    def maybe_stale_session(self, rollout_step: int) -> bool:
+        """True once when the ``rollout_step``-th session step has a
+        ``stale_session`` armed: the resident carry behind that step is
+        lost — the step fails ``error_stale_session`` and the session
+        must restore from its snapshot."""
+        if self._take("stale_session", rollout_step):
+            logger.warning(
+                "fault injection: stale session carry at rollout step #%d",
+                rollout_step,
+            )
+            return True
+        return False
+
+    def maybe_rollout_nan(self, rollout_step: int) -> bool:
+        """True once when the ``rollout_step``-th session step has a
+        ``rollout_nan`` armed: the dispatch carrying it gets NaN
+        outputs (breaker food; the victim session replays from its
+        snapshot instead of committing a poisoned carry)."""
+        if self._take("rollout_nan", rollout_step):
+            logger.warning(
+                "fault injection: NaN outputs at rollout step #%d",
+                rollout_step,
             )
             return True
         return False

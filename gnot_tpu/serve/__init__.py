@@ -32,6 +32,12 @@ the single-server tier:
   one-off compile lands on exactly one replica), with rolling
   hot-reload across the pool, live scale-out (``add_replica``), and a
   pool-level ``serve_summary`` rollup.
+* ``rollout`` — stateful autoregressive rollout sessions (docs/
+  serving.md "Rollout serving"): one request becomes K chained
+  dispatches with the carry resident on the owning replica, rolling
+  host-side snapshots, streaming partial results, and router-driven
+  session migration when the owner dies mid-rollout — zero lost
+  sessions, every future still always resolves.
 * ``aot`` — the deploy-time cold-start pipeline (docs/serving.md
   "Deploy-time prewarm"): enumerate the serving program family,
   ``jit(...).lower().compile()`` it into the persistent compile cache,
@@ -45,6 +51,7 @@ Chaos-tested on CPU via the serve-side fault kinds in
 """
 
 from gnot_tpu.serve import aot  # noqa: F401
+from gnot_tpu.serve import rollout  # noqa: F401
 from gnot_tpu.serve.batcher import Batcher  # noqa: F401
 from gnot_tpu.serve.engine import InferenceEngine  # noqa: F401
 from gnot_tpu.serve.policies import (  # noqa: F401
@@ -58,6 +65,13 @@ from gnot_tpu.serve.replica import (  # noqa: F401
     EngineReplica,
     build_replica,
     build_replicas,
+)
+from gnot_tpu.serve.rollout import (  # noqa: F401
+    RolloutFuture,
+    RolloutResult,
+    RolloutSession,
+    advance_sample,
+    offline_rollout,
 )
 from gnot_tpu.serve.router import ReplicaRouter  # noqa: F401
 from gnot_tpu.serve.server import (  # noqa: F401

@@ -1,0 +1,361 @@
+"""Stateful autoregressive rollout sessions for the serving tier.
+
+One-shot serving answers ``f(sample) -> field``; the NS2d trajectory
+workload (PAPER.md's time-dependent family — ``data/datasets.py::
+synth_ns2d`` parameterizes ``theta`` as time) is ``K`` CHAINED
+dispatches per request: step ``k+1``'s input is derived from step
+``k``'s prediction, and the carry state stays resident on the serving
+replica between steps. This module holds the pieces both tiers share:
+
+* ``advance_sample`` — THE canonical carry: ``theta`` advances by
+  ``dt`` and the input function's value channels are refreshed from the
+  predicted field, so every step genuinely depends on the previous
+  step's output (a rollout is a trajectory, not K independent queries).
+  Shapes never change across steps, so a session stays in ONE bucket —
+  the whole rollout rides the bucket's one compiled program, and
+  concurrent sessions at different step indices batch/pack together
+  through the ordinary ``Batcher``/``PackPlan`` machinery.
+* ``offline_rollout`` — the engine-only K-step loop (no serve stack):
+  the parity reference the chaos A/B (``tools/rollout_ab.py``) holds
+  served rollouts to, <= 1e-5 per step.
+* ``RolloutSession`` — the session object: id, step cursor,
+  replica-resident carry, per-step/whole-rollout deadline budgets, and
+  the rolling host-side snapshot (the ``resilience/supervisor.py``
+  last-good pattern applied to serving): every ``snapshot_every``
+  completed steps the carry is copied out, and when the owning replica
+  dies/open-breakers/wedges mid-rollout the router re-places the
+  session on a sibling FROM the snapshot and replays forward —
+  at-least-once step semantics, zero lost sessions. Replayed steps are
+  deterministic (same carry -> same outputs), and re-delivery to the
+  client is suppressed by a high-water mark.
+* ``RolloutFuture`` — the submitted future, extended with streaming
+  partial results: ``iter_steps()`` yields ``(step, output)`` as each
+  step lands (an ``on_step`` callback is the push-style twin), and the
+  future itself always resolves to a ``RolloutResult`` — completed,
+  partial-with-``drained_at_step``-marker, or shed-with-reason; never
+  a hang, on any path (the one-shot tier's contract, kept stateful).
+
+Thread-safety: a session is mutated by the owning replica's worker
+thread and read/re-placed by router threads (migration, drain) — all
+mutable state is under the session's own lock (graftlint GL004
+enforces the annotations).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Callable, Iterator, Sequence
+
+import numpy as np
+
+from gnot_tpu.data.batch import MeshSample
+
+#: Default trajectory time increment per rollout step (theta advance).
+ROLLOUT_DT = 0.05
+
+#: Terminal reasons a rollout future can resolve with, beyond the
+#: one-shot REASONS a failing step passes through: "ok" (all K steps),
+#: "drained" (partial, with the ``drained_at_step`` marker).
+ROLLOUT_REASONS = ("ok", "drained")
+
+
+def advance_sample(
+    sample: MeshSample, output: np.ndarray, *, dt: float = ROLLOUT_DT
+) -> MeshSample:
+    """The canonical autoregressive carry: the next step's request,
+    derived from this step's prediction.
+
+    ``theta`` (time, per the NS2d parameterization) advances by ``dt``;
+    the input function's trailing value channels are refreshed from the
+    predicted field at the function mesh's points (first ``m`` rows —
+    the synthetic generators emit function meshes as node-mesh
+    prefixes). Coordinates and every shape are preserved EXACTLY, so
+    the whole rollout stays in one bucket and one compiled program.
+    All arrays are fresh copies — the previous step's sample (which may
+    be a held snapshot) is never written in place."""
+    out = np.asarray(output, dtype=np.float32)
+    funcs = []
+    for f in sample.funcs:
+        f_new = np.array(f, dtype=np.float32)
+        k = min(f_new.shape[1], out.shape[1])
+        t = min(f_new.shape[0], out.shape[0])
+        f_new[:t, f_new.shape[1] - k :] = out[:t, :k]
+        funcs.append(f_new)
+    theta = (np.asarray(sample.theta, dtype=np.float32) + np.float32(dt)).astype(
+        np.float32
+    )
+    return MeshSample(
+        coords=np.array(sample.coords, dtype=np.float32),
+        y=np.array(sample.y, dtype=np.float32),
+        theta=theta,
+        funcs=tuple(funcs),
+    )
+
+
+def offline_rollout(
+    engine,
+    sample: MeshSample,
+    steps: int,
+    *,
+    rows: int | None = None,
+    advance: Callable = advance_sample,
+    dt: float = ROLLOUT_DT,
+) -> list[np.ndarray]:
+    """The engine-only K-step reference loop (no serve stack): the
+    trajectory a served rollout must match <= 1e-5 per step — including
+    sessions that migrated mid-rollout (replay from the snapshot carry
+    is exact)."""
+    if steps < 1:
+        raise ValueError(f"rollout needs steps >= 1, got {steps}")
+    outs: list[np.ndarray] = []
+    cur = sample
+    for _ in range(steps):
+        pn, pf = engine.bucket_key(cur)
+        out = engine.infer([cur], pad_nodes=pn, pad_funcs=pf, rows=rows)[0]
+        outs.append(out)
+        cur = advance(cur, out, dt=dt)
+    return outs
+
+
+@dataclasses.dataclass
+class RolloutResult:
+    """What a rollout future resolves to — ALWAYS, on every path.
+
+    ``ok`` means all ``steps`` completed; otherwise ``reason`` names the
+    terminal condition ("drained" for a graceful drain mid-rollout —
+    then ``drained_at_step`` marks where it stopped — or the failing
+    step's one-shot reason: "shed_deadline", "shed_queue_full",
+    "rejected_breaker_open", "error_nan_output", "error_dispatch",
+    "error_replica_dead", "error_stale_session", ...). ``outputs``
+    holds the per-step predictions actually committed (all ``steps`` of
+    them when ok, the completed prefix otherwise)."""
+
+    ok: bool
+    reason: str
+    session: str
+    steps: int
+    steps_completed: int
+    outputs: list = dataclasses.field(default_factory=list)
+    drained_at_step: int | None = None
+    migrations: int = 0
+    detail: str = ""
+
+
+class RolloutFuture(Future):
+    """A ``concurrent.futures.Future`` resolving to ``RolloutResult``,
+    plus streaming partial results: each committed step is published to
+    ``iter_steps()`` as it lands. The stream closes when the future
+    resolves, so iteration always terminates."""
+
+    def __init__(self):
+        super().__init__()
+        self._step_queue: queue.Queue = queue.Queue()
+
+    def _publish(self, step: int, output: np.ndarray) -> None:
+        self._step_queue.put((step, output))
+
+    def _close_stream(self) -> None:
+        self._step_queue.put(None)
+
+    def iter_steps(self, timeout: float | None = None) -> Iterator[tuple]:
+        """Yield ``(step, output)`` pairs (1-indexed, in order) as the
+        rollout progresses; returns when the session reaches a terminal
+        state. Replayed steps after a migration are NOT re-delivered
+        (high-water deduplication in the session)."""
+        while True:
+            item = self._step_queue.get(timeout=timeout)
+            if item is None:
+                return
+            yield item
+
+
+class RolloutSession:
+    """One in-flight autoregressive rollout: identity, cursor, the
+    replica-resident carry, the rolling host-side snapshot, and the
+    client-facing future/stream. Created by ``submit_rollout`` (router
+    or standalone server); mutated by the owning replica's worker
+    thread; read and re-placed by router threads on migration/drain."""
+
+    def __init__(
+        self,
+        sid: str,
+        sample: MeshSample,
+        steps: int,
+        *,
+        snapshot_every: int = 1,
+        step_deadline_ms: float | None = None,
+        rollout_deadline: float | None = None,
+        on_step: Callable | None = None,
+        advance: Callable = advance_sample,
+        dt: float = ROLLOUT_DT,
+    ):
+        if steps < 1:
+            raise ValueError(f"rollout needs steps >= 1, got {steps}")
+        if snapshot_every < 1:
+            raise ValueError(
+                f"snapshot_every must be >= 1, got {snapshot_every}"
+            )
+        self.sid = sid
+        self.steps = steps
+        self.snapshot_every = snapshot_every
+        self.step_deadline_ms = step_deadline_ms
+        #: Absolute whole-rollout expiry on the serving clock (None =
+        #: no budget); every step's deadline is clamped to it.
+        self.rollout_deadline = rollout_deadline
+        self.on_step = on_step
+        self.advance = advance
+        self.dt = dt
+        self.future = RolloutFuture()
+        #: Migration handler installed by the router
+        #: (``fn(session, reason, detail, from_replica)``); None on a
+        #: standalone server — step failures then resolve the future.
+        self.migrate_cb: Callable | None = None
+        self._lock = threading.Lock()
+        self._sample = sample  #: guarded_by _lock
+        self._cursor = 0  #: guarded_by _lock
+        self._outputs: list = []  #: guarded_by _lock
+        # The rolling last-good snapshot (supervisor pattern): taken at
+        # creation (step 0 is always restorable) and every
+        # snapshot_every completed steps thereafter.
+        self._snapshot = {
+            "cursor": 0, "sample": sample, "outputs": [],
+        }  #: guarded_by _lock
+        self._streamed = 0  #: guarded_by _lock
+        self._migrations = 0  #: guarded_by _lock
+        self._resolved = False  #: guarded_by _lock
+
+    # -- step lifecycle (owning replica's worker thread) -------------------
+
+    @property
+    def sample(self) -> MeshSample:
+        """The current carry — the next step's request payload."""
+        with self._lock:
+            return self._sample
+
+    @property
+    def cursor(self) -> int:
+        """Completed steps (the next step to run is ``cursor + 1``)."""
+        with self._lock:
+            return self._cursor
+
+    @property
+    def finished(self) -> bool:
+        with self._lock:
+            return self._cursor >= self.steps
+
+    @property
+    def migrations(self) -> int:
+        with self._lock:
+            return self._migrations
+
+    def record_step(self, output: np.ndarray) -> int:
+        """Commit one completed step: append the output, advance the
+        carry. Returns the 1-indexed step just committed."""
+        with self._lock:
+            self._outputs.append(output)
+            self._cursor += 1
+            if self._cursor < self.steps:
+                self._sample = self.advance(self._sample, output, dt=self.dt)
+            return self._cursor
+
+    def publish_step(self, step: int, output: np.ndarray) -> None:
+        """Stream one committed step to the client (callback +
+        iterator), exactly once per step index: replays after a
+        migration re-commit steps but never re-deliver them."""
+        with self._lock:
+            if step <= self._streamed:
+                return
+            self._streamed = step
+        if self.on_step is not None:
+            self.on_step(self.sid, step, output)
+        self.future._publish(step, output)
+
+    def snapshot_due(self) -> bool:
+        with self._lock:
+            return (
+                self._cursor < self.steps
+                and self._cursor - self._snapshot["cursor"]
+                >= self.snapshot_every
+            )
+
+    def take_snapshot(self) -> int:
+        """Copy the carry (and the committed prefix) host-side — the
+        state a migration replays from. Returns the snapshot cursor."""
+        with self._lock:
+            self._snapshot = {
+                "cursor": self._cursor,
+                "sample": self._sample,
+                "outputs": list(self._outputs),
+            }
+            return self._cursor
+
+    # -- migration (router threads) ----------------------------------------
+
+    def restore_from_snapshot(self) -> int:
+        """Roll the session back to its last snapshot (cursor, carry,
+        committed prefix) and count one migration. Returns the step the
+        replay resumes from (the snapshot cursor). At-least-once: steps
+        past the snapshot re-execute on the new owner; ``publish_step``
+        suppresses their re-delivery."""
+        with self._lock:
+            self._cursor = self._snapshot["cursor"]
+            self._sample = self._snapshot["sample"]
+            self._outputs = list(self._snapshot["outputs"])
+            self._migrations += 1
+            return self._cursor
+
+    # -- resolution (exactly once, any thread) -----------------------------
+
+    def resolve(
+        self,
+        ok: bool,
+        reason: str,
+        *,
+        drained_at_step: int | None = None,
+        detail: str = "",
+    ) -> bool:
+        """Resolve the client future with a terminal ``RolloutResult``
+        (idempotent — the first caller wins; late duplicates from a
+        drain racing the worker are no-ops). Returns True when THIS
+        call resolved it."""
+        with self._lock:
+            if self._resolved:
+                return False
+            self._resolved = True
+            result = RolloutResult(
+                ok=ok,
+                reason=reason,
+                session=self.sid,
+                steps=self.steps,
+                steps_completed=self._cursor,
+                outputs=list(self._outputs),
+                drained_at_step=drained_at_step,
+                migrations=self._migrations,
+                detail=detail,
+            )
+        self.future.set_result(result)
+        self.future._close_stream()
+        return True
+
+
+def parity_check(
+    served: Sequence[np.ndarray],
+    reference: Sequence[np.ndarray],
+    *,
+    atol: float = 1e-5,
+) -> float:
+    """Max absolute per-step deviation of a served rollout from the
+    offline reference (raises on step-count mismatch — a truncated
+    trajectory is not 'close')."""
+    if len(served) != len(reference):
+        raise ValueError(
+            f"served rollout has {len(served)} steps, reference "
+            f"{len(reference)}"
+        )
+    worst = 0.0
+    for got, want in zip(served, reference):
+        worst = max(worst, float(np.max(np.abs(got - want))))
+    return worst

@@ -32,6 +32,22 @@ engine per device or mesh slice) and decides placement per request:
    one replica warms at any moment (the rollout lock). Each step emits
    a ``rolling_reload`` event.
 
+4. **Rollout sessions** (``submit_rollout``, serve/rollout.py): a
+   K-step autoregressive session places ONCE (health + affinity, one
+   ``route`` event tagged with the session id) and then stays on its
+   owner — steps 2..K never re-route while the owner is healthy
+   (session affinity; the carry is resident there). Load accounting is
+   session-aware: placement weighs in-system requests PLUS resident
+   sessions, so a replica holding many K-step commitments is not
+   preferred for new work. When the owner fails mid-rollout (breaker
+   open, NaN/dispatch error, ``replica_kill``/worker death, stale
+   carry) the session is re-placed on a sibling FROM its last
+   host-side snapshot and replays forward (``session_migrate`` event;
+   at-least-once step semantics, re-delivery suppressed) — zero lost
+   sessions under single-replica failures; with ``session_migration``
+   off or the budget spent the future resolves with the failure,
+   counted ``lost`` in the sessions rollup.
+
 Every placement is observable: one ``route`` event per submitted
 request (replica, bucket, policy, decision reason, target depth), and
 ``drain()`` emits a pool-level ``serve_summary`` whose ``per_replica``
@@ -61,6 +77,7 @@ from gnot_tpu.serve.policies import (
     ReplicaHealthPolicy,
 )
 from gnot_tpu.serve.replica import EngineReplica
+from gnot_tpu.serve.rollout import RolloutFuture, RolloutSession
 from gnot_tpu.serve.server import PACKED_BUCKET, InferenceServer
 
 
@@ -99,6 +116,9 @@ class ReplicaRouter:
         tracer=None,
         pack_plan: PackPlan | None = None,
         wedge_after_s: float = 2.0,
+        session_snapshot_every: int = 1,
+        session_migration: bool = True,
+        max_session_migrations: int = 3,
     ):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
@@ -143,7 +163,22 @@ class ReplicaRouter:
             clock=clock,
             tracer=tracer,
             pack_plan=pack_plan,
+            session_snapshot_every=session_snapshot_every,
         )
+        # Rollout-session policy (serve/rollout.py): whether a session
+        # whose owner fails mid-rollout is re-placed from its snapshot
+        # (the fault-tolerant default) or resolved with the failure
+        # (the chaos A/B's no-migration twin), and how many re-
+        # placements one session may consume before the failure is
+        # terminal (a pool-wide outage must not bounce sessions
+        # forever).
+        self.session_migration = session_migration
+        if max_session_migrations < 0:
+            raise ValueError(
+                "max_session_migrations must be >= 0, got "
+                f"{max_session_migrations}"
+            )
+        self.max_session_migrations = max_session_migrations
         for r in self.replicas:
             r.attach_server(
                 InferenceServer(
@@ -171,6 +206,15 @@ class ReplicaRouter:
         # become replica_health events; steady state stays silent).
         self._health_seen: dict[int, str] = {}  #: guarded_by _lock
         self._rollouts = 0  #: guarded_by _lock
+        # Rollout-session ledger: id allocation and pool-level outcome
+        # counters for the serve_summary sessions rollup. (Ownership
+        # needs no router-side map: a session IS resident on its owning
+        # server — the replica's session table is the affinity record.)
+        # Mutated by submitting threads AND the migration callback
+        # (which runs on a failed replica's worker thread).
+        self._sessions_started = 0  #: guarded_by _lock
+        self._sessions_migrated = 0  #: guarded_by _lock
+        self._sessions_lost = 0  #: guarded_by _lock
         # Rollout sequencing: holding it means "a rolling reload is in
         # progress"; one replica warms at a time by construction.
         self._reload_lock = threading.Lock()
@@ -385,8 +429,16 @@ class ReplicaRouter:
 
     @staticmethod
     def _load(r: EngineReplica) -> tuple:
+        # In-system requests PLUS resident rollout sessions: a session
+        # is a standing K-step commitment that keeps re-entering the
+        # replica's queue between its visible requests, so a replica
+        # holding many sessions must not read as idle to least_loaded/
+        # cold_assign placement (the ISSUE 13 load-accounting audit).
         # Tie-break on replica_id for determinism under equal load.
-        return (r.server.depth(), r.replica_id)
+        return (
+            r.server.depth() + r.server.resident_sessions(),
+            r.replica_id,
+        )
 
     @staticmethod
     def _has_room(r: EngineReplica) -> bool:
@@ -422,6 +474,135 @@ class ReplicaRouter:
                     reason=verdict.reason,
                 )
         return verdict
+
+    # -- rollout sessions (serve/rollout.py) -------------------------------
+
+    def submit_rollout(
+        self,
+        sample: MeshSample,
+        steps: int,
+        *,
+        deadline_ms: float | None = None,
+        rollout_deadline_ms: float | None = None,
+        on_step=None,
+    ) -> RolloutFuture:
+        """Place one autoregressive rollout session. The FIRST step
+        routes like any request (health gate + affinity/policy — one
+        ``route`` event, tagged with the session id); steps 2..K stay
+        on the owning replica (session affinity: the carry is resident
+        there, and spilling a healthy session would forfeit it). When
+        the owner fails mid-rollout (breaker open, NaN/dispatch error,
+        worker death) the session is re-placed on a sibling FROM its
+        last host-side snapshot and replays forward (``session_migrate``
+        event) — zero lost sessions, at-least-once step semantics —
+        unless ``session_migration`` is off or the migration budget is
+        spent, in which case the future resolves with the failure. The
+        future ALWAYS resolves."""
+        sc = self._server_kwargs
+        ms = (
+            deadline_ms
+            if deadline_ms is not None
+            else sc["default_deadline_ms"]
+        )
+        with self._lock:
+            self._sessions_started += 1
+            sid = f"r{self._sessions_started:05d}"
+        session = RolloutSession(
+            sid,
+            sample,
+            steps,
+            snapshot_every=sc["session_snapshot_every"],
+            step_deadline_ms=ms or None,
+            rollout_deadline=(
+                self._clock() + rollout_deadline_ms / 1e3
+                if rollout_deadline_ms
+                else None
+            ),
+            on_step=on_step,
+        )
+        session.migrate_cb = self._session_failed
+        key, label = self._bucket_of(sample)
+        replica, reason = self._place(key)
+        with self._lock:
+            self._submitted += 1
+            rid = replica.replica_id
+            self._routed[rid] = self._routed.get(rid, 0) + 1
+            if reason == "spill":
+                self._spills += 1
+        self._event(
+            events.ROUTE,
+            replica=rid,
+            bucket=label,
+            policy=self.route_policy,
+            reason=reason,
+            depth=replica.server.depth(),
+            dtype=self._dtype,
+            session=sid,
+        )
+        replica.server.submit_rollout(session=session)
+        return session.future
+
+    def _session_failed(
+        self, session: RolloutSession, reason: str, detail: str,
+        from_replica: int | None,
+    ) -> None:
+        """Migration callback, invoked by the failed owner's server
+        (on its worker/drain thread) when a session step dies on a
+        backend signal. Re-place the session from its snapshot on a
+        sibling — or, with migration off / budget spent / no sibling
+        left, resolve the future with the failure (a LOST session,
+        counted loudly)."""
+        # Assess the failed owner FIRST: a mid-rollout death/trip must
+        # land its replica_health edge now, not at the next unrelated
+        # placement — the event stream's story of the failure starts
+        # with the owner going unhealthy.
+        if from_replica is not None:
+            for r in self._pool():
+                if r.replica_id == from_replica:
+                    self._assess(r, self._clock())
+        give_up = (
+            not self.session_migration
+            or self._drained.is_set()
+            or session.migrations >= self.max_session_migrations
+        )
+        target = None
+        if not give_up:
+            now = self._clock()
+            replicas = [
+                r for r in self._pool() if r.replica_id != from_replica
+            ]
+            healthy = [
+                r for r in replicas if self._assess(r, now).healthy
+            ]
+            # Fallback candidates must at least have a LIVE worker: a
+            # dead sibling would swallow the re-placed step into a
+            # queue nobody drains and the session future would hang —
+            # resolving as lost is the honest answer when the pool is
+            # out of alive replicas.
+            alive = [r for r in replicas if r.server.worker_alive()]
+            pool = healthy or alive
+            if pool:
+                with self._lock:
+                    target = min(pool, key=self._load)
+        if target is None:
+            if session.resolve(False, reason, detail=detail):
+                with self._lock:
+                    self._sessions_lost += 1
+            return
+        at_step = session.cursor
+        replay_from = session.restore_from_snapshot()
+        with self._lock:
+            self._sessions_migrated += 1
+        self._event(
+            events.SESSION_MIGRATE,
+            session=session.sid,
+            from_replica=from_replica,
+            to_replica=target.replica_id,
+            at_step=at_step,
+            replay_from=replay_from,
+            reason=reason,
+        )
+        target.server.submit_rollout(session=session)
 
     # -- rolling hot-reload ------------------------------------------------
 
@@ -526,11 +707,22 @@ class ReplicaRouter:
             )
         arr = np.asarray(lat, dtype=np.float64)
         warm_by_id = {r.replica_id: r.warm_stats for r in pool}
+        # Pool-level rollout-session rollup: outcome counters are
+        # router-truth (started/migrated/lost) plus the summed
+        # per-replica terminals; the per-step latency percentiles need
+        # the raw pooled population, exactly like the request ones.
+        step_lat: list[float] = []
+        for r in pool:
+            step_lat.extend(r.server.step_latencies_ms())
+        step_arr = np.asarray(step_lat, dtype=np.float64)
         with self._lock:
             routed = dict(self._routed)
             spills = self._spills
             rollouts = self._rollouts
             submitted = self._submitted
+            sessions_started = self._sessions_started
+            sessions_migrated = self._sessions_migrated
+            sessions_lost = self._sessions_lost
         summary = {
             "dtype": self._dtype,
             "requests": sum(s["requests"] for s in per.values()),
@@ -586,6 +778,35 @@ class ReplicaRouter:
                 "rollouts": rollouts,
             },
         }
+        if sessions_started:
+            summary["sessions"] = {
+                "started": sessions_started,
+                "completed": sum(
+                    (s.get("sessions") or {}).get("completed", 0)
+                    for s in per.values()
+                ),
+                "drained": sum(
+                    (s.get("sessions") or {}).get("drained", 0)
+                    for s in per.values()
+                ),
+                "shed": sum(
+                    (s.get("sessions") or {}).get("shed", 0)
+                    for s in per.values()
+                ),
+                "migrated": sessions_migrated,
+                "lost": sessions_lost,
+                "steps": len(step_lat),
+                "step_latency_p50_ms": (
+                    float(np.percentile(step_arr, 50))
+                    if step_arr.size
+                    else None
+                ),
+                "step_latency_p99_ms": (
+                    float(np.percentile(step_arr, 99))
+                    if step_arr.size
+                    else None
+                ),
+            }
         if not self._drained.is_set():
             self._drained.set()
             self._event(events.SERVE_SUMMARY, **summary)

@@ -41,6 +41,22 @@ flow through the same spans/events as padded dispatches (the
 ``capacity_tokens``; ``serve_summary`` gains ``pad_waste_by_bucket``).
 Oversize requests fall back to the ordinary padded per-bucket path.
 
+Stateful rollout sessions (``serve/rollout.py``, ``submit_rollout``):
+one request becomes K CHAINED dispatches — each committed step advances
+the session's replica-resident carry and the next step re-enters the
+ordinary admission/batcher/dispatch pipeline (so concurrent sessions at
+different step indices batch and pack together, and every robustness
+policy above applies per step). Completed steps emit ``rollout_step``
+events and stream to the client; the carry is snapshotted host-side
+every ``session_snapshot_every`` steps (``session_snapshot`` events —
+the supervisor's rolling last-good pattern). A step that fails on a
+backend signal (breaker, NaN, dispatch error, replica death) hands the
+session back to the router's migration callback instead of losing it;
+deadline/queue sheds terminate the session with the honest reason; a
+drain mid-rollout persists a final snapshot and resolves the future
+with the completed prefix plus a ``drained_at_step`` marker — a
+session future, like a request future, ALWAYS resolves.
+
 With a ``tracer`` (``obs/tracing.py``, ``--trace_path``) every request
 additionally gets a ``trace_id`` at submit and a host-side span chain
 ``admission -> queue_wait -> batch_assembly -> dispatch -> device ->
@@ -73,6 +89,7 @@ from gnot_tpu.serve.policies import (
     CircuitBreaker,
     Deadline,
 )
+from gnot_tpu.serve.rollout import RolloutFuture, RolloutSession
 
 #: The bucket key every plan-fitting request shares under packed
 #: dispatch mode (``pack_plan=``). Distinct from any ``(pn, pf)``
@@ -91,6 +108,24 @@ REASONS = (
     "rejected_draining",
     "error_nan_output",
     "error_dispatch",
+    # rollout-session step failures (serve/rollout.py)
+    "error_replica_dead",
+    "error_stale_session",
+)
+
+#: Step-failure reasons that indicate a SICK OWNER rather than a sick
+#: request: the session is handed to the router's migration callback
+#: (re-placed on a sibling from its snapshot) instead of terminating.
+#: Deadline/queue/validation sheds stay terminal — a deadline storm
+#: sheds sessions honestly, it does not bounce them around the pool.
+MIGRATABLE_REASONS = frozenset(
+    (
+        "rejected_breaker_open",
+        "error_nan_output",
+        "error_dispatch",
+        "error_replica_dead",
+        "error_stale_session",
+    )
 )
 
 
@@ -114,6 +149,19 @@ class _Request:
     submitted: float
     deadline: Deadline | None
     trace: str | None = None  # tracer trace_id; None = off / unsampled
+    # Rollout-session step plumbing (serve/rollout.py): the owning
+    # session (None = ordinary one-shot request) and the server's
+    # 1-indexed rollout-step admission ordinal (the replica_kill/
+    # stale_session/rollout_nan fault key).
+    session: RolloutSession | None = None
+    rollout_ordinal: int = 0
+
+
+class _ReplicaKilled(Exception):
+    """Internal control flow for the ``replica_kill`` fault: raised at
+    the dispatch about to run, caught by the worker loop, which fails
+    every in-system request (``error_replica_dead``) and exits — the
+    router's ``dead`` health signal, with no Future left hanging."""
 
 
 class InferenceServer:
@@ -147,6 +195,7 @@ class InferenceServer:
         tracer=None,
         pack_plan: PackPlan | None = None,
         replica: int | None = None,
+        session_snapshot_every: int = 1,
     ):
         self.engine = engine
         self.sink = sink
@@ -242,6 +291,31 @@ class InferenceServer:
         # the router's wedge signal. Written by the worker, read by
         # router threads.
         self._last_progress = clock()  #: guarded_by _lock
+        # Rollout-session state (serve/rollout.py): the resident-session
+        # table (read by router load accounting — a replica holding many
+        # sessions must not look idle), per-server session counters for
+        # the serve_summary sessions rollup, the rollout-step admission
+        # ordinal (the replica_kill/stale_session/rollout_nan fault
+        # key), and the per-step latency population.
+        if session_snapshot_every < 1:
+            raise ValueError(
+                "session_snapshot_every must be >= 1, got "
+                f"{session_snapshot_every}"
+            )
+        self.session_snapshot_every = session_snapshot_every
+        self._sessions: dict[str, RolloutSession] = {}  #: guarded_by _lock
+        self._sessions_started = 0  #: guarded_by _lock
+        self._sessions_completed = 0  #: guarded_by _lock
+        self._sessions_drained = 0  #: guarded_by _lock
+        self._sessions_shed = 0  #: guarded_by _lock
+        self._sessions_failed = 0  #: guarded_by _lock
+        self._rollout_steps = 0  #: guarded_by _lock
+        self._step_latencies_ms: list[float] = []  #: guarded_by _lock
+        # Set by _die (the replica_kill fault) the moment the worker
+        # starts failing everything: the router must read this replica
+        # as dead IMMEDIATELY — migration callbacks run on the dying
+        # thread itself, before it has actually exited.
+        self._dead = False  #: guarded_by _lock
 
     # -- client side -------------------------------------------------------
 
@@ -335,6 +409,262 @@ class InferenceServer:
         self._trace_span(trace, "admission", now, reason="admitted")
         return fut
 
+    def submit_rollout(
+        self,
+        sample: MeshSample | None = None,
+        steps: int | None = None,
+        *,
+        deadline_ms: float | None = None,
+        rollout_deadline_ms: float | None = None,
+        on_step: Callable | None = None,
+        session: RolloutSession | None = None,
+    ) -> RolloutFuture:
+        """Admit one autoregressive rollout: ``steps`` chained
+        dispatches whose carry stays resident on THIS server between
+        steps (serve/rollout.py). Each step re-enters the ordinary
+        admission/batcher/dispatch pipeline — concurrent sessions at
+        different step indices batch together, and every one-shot
+        policy (deadline shed, breaker, finiteness) applies per step.
+        ``deadline_ms`` is the PER-STEP budget (default: the server's
+        ``default_deadline_ms``); ``rollout_deadline_ms`` bounds the
+        whole trajectory. ``on_step(sid, step, output)`` streams
+        committed steps (the returned ``RolloutFuture.iter_steps()`` is
+        the pull-style twin). ``session`` re-places an existing session
+        (router placement / migration) and ignores the other arguments.
+
+        The future ALWAYS resolves with a ``RolloutResult``: completed,
+        partial-with-``drained_at_step``, or shed-with-reason."""
+        if session is None:
+            if sample is None or steps is None:
+                raise ValueError(
+                    "submit_rollout needs (sample, steps) or a session"
+                )
+            with self._lock:
+                self._sessions_started += 1
+                n = self._sessions_started
+            prefix = "s" if self.replica is None else f"s{self.replica}."
+            ms = (
+                deadline_ms
+                if deadline_ms is not None
+                else self.default_deadline_ms
+            )
+            session = RolloutSession(
+                f"{prefix}{n:04d}",
+                sample,
+                steps,
+                snapshot_every=self.session_snapshot_every,
+                step_deadline_ms=ms or None,
+                rollout_deadline=(
+                    self._clock() + rollout_deadline_ms / 1e3
+                    if rollout_deadline_ms
+                    else None
+                ),
+                on_step=on_step,
+            )
+        else:
+            # A router placement or a migrated arrival: the session
+            # carries its own budgets/cursor; it just takes residence
+            # here (counted — the per-replica sessions rollup reports
+            # sessions ACCEPTED, migrated arrivals included).
+            with self._lock:
+                self._sessions_started += 1
+        with self._lock:
+            self._sessions[session.sid] = session
+        self._submit_step(session)
+        return session.future
+
+    # -- rollout-session internals (serve/rollout.py) ----------------------
+
+    def _submit_step(self, session: RolloutSession) -> None:
+        """Enqueue the session's next step as an internal request (the
+        worker batches and dispatches it like any other). Terminal
+        conditions (drain, exhausted rollout budget, invalid carry,
+        full queue) resolve the session NOW instead — a session never
+        strands between steps."""
+        now = self._clock()
+        if self._draining.is_set():
+            self._end_session(session, reason="drained", kind="drained")
+            return
+        rd = session.rollout_deadline
+        if rd is not None and now >= rd:
+            self._end_session(
+                session,
+                reason="shed_deadline",
+                kind="shed",
+                detail="whole-rollout deadline exhausted",
+            )
+            return
+        try:
+            self.engine.validate([session.sample])
+        except ValueError as err:
+            self._end_session(
+                session, reason="rejected_invalid", kind="shed",
+                detail=str(err),
+            )
+            return
+        if not self.admission.try_admit():
+            self._end_session(
+                session,
+                reason="shed_queue_full",
+                kind="shed",
+                detail=f"admission full at step {session.cursor + 1}",
+            )
+            return
+        ms = session.step_deadline_ms
+        at = now + ms / 1e3 if ms is not None else None
+        if rd is not None:
+            at = rd if at is None else min(at, rd)
+        raced_shutdown = False
+        with self._lock:
+            if self._draining.is_set():
+                raced_shutdown = True
+            else:
+                self._submitted += 1
+                self._admitted += 1
+                self._rollout_steps += 1
+                req = _Request(
+                    sample=session.sample,
+                    future=Future(),
+                    ordinal=self._admitted,
+                    submitted=now,
+                    deadline=Deadline(at) if at is not None else None,
+                    session=session,
+                    rollout_ordinal=self._rollout_steps,
+                )
+                self._inbound.put(req)
+        if raced_shutdown:
+            self.admission.release()
+            self._end_session(session, reason="drained", kind="drained")
+
+    def _session_step_done(self, req: _Request, result: ServeResult) -> None:
+        """One session step left the system: commit + chain the next
+        step, or resolve/migrate the session per the failure reason.
+        Runs on whichever thread finished the step (worker or drain)."""
+        session = req.session
+        if result.ok:
+            step = session.record_step(result.output)
+            with self._lock:
+                self._step_latencies_ms.append(result.latency_ms)
+            self._event(
+                events.ROLLOUT_STEP,
+                session=session.sid,
+                step=step,
+                steps=session.steps,
+                latency_ms=result.latency_ms,
+            )
+            session.publish_step(step, result.output)
+            if session.snapshot_due():
+                self._event(
+                    events.SESSION_SNAPSHOT,
+                    session=session.sid,
+                    step=session.take_snapshot(),
+                )
+            if session.finished:
+                if session.resolve(True, "ok"):
+                    with self._lock:
+                        self._sessions_completed += 1
+                self._drop_session(session)
+            else:
+                self._submit_step(session)
+            return
+        reason = result.reason
+        if reason == "rejected_draining":
+            self._end_session(session, reason="drained", kind="drained")
+        elif reason in MIGRATABLE_REASONS:
+            # A sick OWNER, not a sick request: hand the session (with
+            # its snapshot) back to the router for re-placement; on a
+            # standalone server the failure is terminal but still
+            # resolves — never a hang.
+            self._drop_session(session)
+            if session.migrate_cb is not None:
+                session.migrate_cb(session, reason, result.detail, self.replica)
+            else:
+                if session.resolve(False, reason, detail=result.detail):
+                    with self._lock:
+                        self._sessions_failed += 1
+                self._event(
+                    events.SHED, reason=reason, session=session.sid,
+                    step=session.cursor,
+                )
+        else:
+            self._end_session(
+                session, reason=reason, kind="shed", detail=result.detail
+            )
+
+    def _end_session(
+        self, session: RolloutSession, *, reason: str, kind: str,
+        detail: str = "",
+    ) -> None:
+        """Terminal (non-ok) session resolution on this server: persist
+        a FINAL snapshot (the SIGTERM-drain contract — an open
+        session's last-good state survives the exit), resolve the
+        future (idempotent; ``drained`` carries the
+        ``drained_at_step`` marker), emit the shed event carrying the
+        session id, drop the residence entry."""
+        step = session.take_snapshot()
+        drained = kind == "drained"
+        resolved = session.resolve(
+            False,
+            reason,
+            drained_at_step=step if drained else None,
+            detail=detail,
+        )
+        self._drop_session(session)
+        if not resolved:
+            return
+        with self._lock:
+            if drained:
+                self._sessions_drained += 1
+            else:
+                self._sessions_shed += 1
+        self._event(events.SESSION_SNAPSHOT, session=session.sid, step=step)
+        self._event(
+            events.SHED, reason=reason, session=session.sid, step=step
+        )
+
+    def _drop_session(self, session: RolloutSession) -> None:
+        with self._lock:
+            self._sessions.pop(session.sid, None)
+
+    def _open_sessions(self) -> list[RolloutSession]:
+        with self._lock:
+            return list(self._sessions.values())
+
+    def _die(self, pending: list[_Request]) -> None:
+        """The ``replica_kill`` fault fired: this replica is gone. Every
+        request still in the system resolves NOW with
+        ``error_replica_dead`` (a Future must never hang on a dead
+        replica — resident sessions migrate through their failure
+        path), then the worker thread exits: ``worker_alive()`` flips
+        False, the router's ``dead`` health signal."""
+        with self._lock:
+            self._dead = True
+        dead = ServeResult(
+            ok=False,
+            reason="error_replica_dead",
+            detail="replica killed (injected replica_kill)",
+        )
+        n = 0
+        for r in pending:
+            self._finish(r, dead)
+            n += 1
+        try:
+            while True:
+                item = self._inbound.get_nowait()
+                if item is not None:
+                    self._finish(item, dead)
+                    n += 1
+        except queue.Empty:
+            pass
+        # pop_ready(flush_all) REMOVES the swept requests, so a later
+        # drain() sweep cannot double-finish them.
+        for _, rs in self.batcher.pop_ready(self._clock(), flush_all=True):
+            for r in rs:
+                self._finish(r, dead)
+                n += 1
+        if n:
+            self._count_shed("error_replica_dead", n=n)
+
     def reload(self, *, deadline_ms: float = 0.0) -> bool:
         """Hot-swap weights from the reload source (synchronous, on the
         CALLER's thread — the worker keeps serving old weights
@@ -395,6 +725,15 @@ class InferenceServer:
                 # (double-finish, concurrent Batcher mutation); report
                 # and return what we have instead.
                 self._event(events.DRAIN_TIMEOUT, timeout_s=timeout_s)
+                # Open sessions must still resolve (the wedged worker
+                # may never chain them): partial-with-marker, via the
+                # idempotent resolve — if the worker un-wedges later
+                # its own finalization is a no-op.
+                for session in self._open_sessions():
+                    self._end_session(
+                        session, reason="drained", kind="drained",
+                        detail="drain timed out behind a wedged dispatch",
+                    )
                 return self._summary(emit=not self._drained.is_set())
         # The worker has exited (or never ran): resolve anything still
         # queued or batched — a request must NEVER be left hanging.
@@ -426,6 +765,12 @@ class InferenceServer:
                 r.trace, "queue_wait", r.submitted,
                 reason="rejected_draining",
             )
+        # Sessions still resident (their in-flight step was swept above,
+        # or they raced registration against the drain flag): resolve
+        # partial-with-marker, final snapshot persisted. Idempotent —
+        # sessions the worker already finalized are no-ops.
+        for session in self._open_sessions():
+            self._end_session(session, reason="drained", kind="drained")
         if not self._drained.is_set():
             self._drained.set()
             return self._summary(emit=True)
@@ -472,12 +817,20 @@ class InferenceServer:
             # exactly the wedge shape the router's health check wants.
             with self._lock:
                 self._last_progress = now
-            for key, reqs in self.batcher.pop_ready(
+            batches = self.batcher.pop_ready(
                 now, flush_all=self._draining.is_set()
-            ):
+            )
+            for bi, (key, reqs) in enumerate(batches):
                 with self._lock:
                     self._last_progress = self._clock()
-                self._dispatch(key, reqs)
+                try:
+                    self._dispatch(key, reqs)
+                except _ReplicaKilled:
+                    # The kill fires BEFORE any _finish in _dispatch,
+                    # so the current batch (and every later popped one)
+                    # is still wholly unresolved — sweep them all.
+                    self._die([r for _, rs in batches[bi:] for r in rs])
+                    return
             if (
                 self._draining.is_set()
                 and len(self.batcher) == 0
@@ -495,6 +848,44 @@ class InferenceServer:
             plan = None
             pn, pf = key
             bucket = f"{pn}x{pf}"
+        # Rollout-session faults, keyed by the server's 1-indexed
+        # rollout-step admission ordinal (docs/robustness.md).
+        # replica_kill first — a dying replica fails EVERYTHING, so it
+        # must fire before any per-request resolution; then per-step
+        # stale-carry failures, which drop their victims from the batch
+        # (the session restores from its snapshot via migration).
+        if self.faults is not None:
+            for r in reqs:
+                if r.session is not None and self.faults.maybe_replica_kill(
+                    r.rollout_ordinal
+                ):
+                    raise _ReplicaKilled()
+            fresh = []
+            for r in reqs:
+                if r.session is not None and self.faults.maybe_stale_session(
+                    r.rollout_ordinal
+                ):
+                    self._count_shed("error_stale_session")
+                    self._event(
+                        events.SHED,
+                        reason="error_stale_session",
+                        ordinal=r.ordinal,
+                        session=r.session.sid,
+                    )
+                    self._finish(
+                        r,
+                        ServeResult(
+                            ok=False,
+                            reason="error_stale_session",
+                            detail="resident carry lost (injected "
+                            "stale_session)",
+                        ),
+                    )
+                else:
+                    fresh.append(r)
+            reqs = fresh
+            if not reqs:
+                return
         # Injected straggler: stall until the victim's deadline passes
         # (deterministic head-of-line blocking — docs/serving.md).
         if self.faults is not None:
@@ -666,6 +1057,16 @@ class InferenceServer:
         self._note_pack(bucket, real_tokens, capacity_tokens)
         if self.faults is not None and self.faults.maybe_nan_output(dispatch):
             outs = [np.full_like(o, np.nan) for o in outs]
+        if self.faults is not None and [
+            r
+            for r in live
+            if r.session is not None
+            and self.faults.maybe_rollout_nan(r.rollout_ordinal)
+        ]:
+            # rollout_nan: the whole dispatch is poisoned (a sick chip
+            # does not scope its garbage to one segment) — the victim
+            # session and any riders fail and replay/resolve.
+            outs = [np.full_like(o, np.nan) for o in outs]
         bad = [
             i for i, o in enumerate(outs) if not np.all(np.isfinite(o))
         ]
@@ -806,11 +1207,30 @@ class InferenceServer:
         with self._lock:
             return list(self._latencies_ms)
 
+    def resident_sessions(self) -> int:
+        """Rollout sessions currently resident on this server — the
+        router's session-aware load signal: a replica with few
+        in-flight requests but many live sessions has K-step commitments
+        queued behind every new placement and must not read as idle."""
+        with self._lock:
+            return len(self._sessions)
+
+    def step_latencies_ms(self) -> list[float]:
+        """Snapshot of committed rollout-step latencies (ms) — the raw
+        population for the router's pooled per-step percentiles."""
+        with self._lock:
+            return list(self._step_latencies_ms)
+
     def worker_alive(self) -> bool:
         """False only when a started worker thread has EXITED (a crash
         — drain sets ``_draining`` first, so a drained server reads as
-        draining, not dead). Not-yet-started reads True: the router
+        draining, not dead) or is mid-death (``_die`` — migration
+        callbacks run on the dying thread itself, and the router must
+        already see it dead). Not-yet-started reads True: the router
         assesses replicas it is still warming."""
+        with self._lock:
+            if self._dead:
+                return False
         w = self._worker
         return w.is_alive() if w is not None else True
 
@@ -839,6 +1259,11 @@ class InferenceServer:
         self.admission.release()
         if not req.future.done():
             req.future.set_result(result)
+        # A session step's result chains the session forward (commit +
+        # next step, finalize, or migrate) — AFTER the request-level
+        # bookkeeping, on the finishing thread.
+        if req.session is not None:
+            self._session_step_done(req, result)
 
     def _resolve_now(
         self, fut: Future, reason: str, now: float, *, detail: str = ""
@@ -876,6 +1301,30 @@ class InferenceServer:
                 for k, v in self._bucket_stats.items()
             }
             pack_stats = {k: dict(v) for k, v in self._pack_stats.items()}
+            step_lat = np.asarray(self._step_latencies_ms, dtype=np.float64)
+            if self._sessions_started:
+                # Rollout-session rollup (serve/rollout.py): sessions
+                # ACCEPTED here (migrated arrivals included) and how
+                # each left, plus the per-step latency percentiles.
+                summary["sessions"] = {
+                    "started": self._sessions_started,
+                    "completed": self._sessions_completed,
+                    "drained": self._sessions_drained,
+                    "shed": self._sessions_shed,
+                    "failed": self._sessions_failed,
+                    "resident": len(self._sessions),
+                    "steps": len(self._step_latencies_ms),
+                    "step_latency_p50_ms": (
+                        float(np.percentile(step_lat, 50))
+                        if step_lat.size
+                        else None
+                    ),
+                    "step_latency_p99_ms": (
+                        float(np.percentile(step_lat, 99))
+                        if step_lat.size
+                        else None
+                    ),
+                }
         if pack_stats:
             # Per-bucket pad-waste / packing efficiency over every
             # executed dispatch: fill = real/capacity node tokens,

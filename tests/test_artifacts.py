@@ -215,6 +215,44 @@ def test_coldstart_ab_artifact_schema():
     assert summary["shed_cold"] > 0
 
 
+def test_rollout_ab_artifact_schema():
+    """The committed rollout chaos A/B (tools/rollout_ab.py): a storm
+    of concurrent K-step rollout sessions with a replica KILLED
+    mid-storm, run twice — the ISSUE 13 acceptance bars: the migration
+    arm loses ZERO sessions (vs measured losses on the no-migration
+    twin, so the kill was not vacuous), and every served rollout
+    matches the offline engine-only K-step loop to <= 1e-5 per step at
+    original tolerances (no loosening)."""
+    path = os.path.join(ARTIFACT_DIR, "rollout_ab.jsonl")
+    with open(path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    arms = {r["arm"]: r for r in recs if "arm" in r}
+    assert set(arms) == {"migration", "no_migration"}
+    for r in arms.values():
+        # Identical storm + identical fault across the arms.
+        assert r["sessions"] > 0 and r["steps"] > 1
+        assert r["killed_replica"] == 0 and r["kill_at_step"] >= 1
+        assert r["snapshot_every"] >= 2  # migration exercises a replay
+        assert r["completed"] + r["lost"] + r["drained"] + r["shed"] == (
+            r["sessions"]
+        )
+    # The acceptance bars.
+    assert arms["migration"]["lost"] == 0
+    assert arms["migration"]["migrated"] >= 1
+    assert arms["migration"]["completed"] == arms["migration"]["sessions"]
+    assert arms["no_migration"]["lost"] >= 1
+    assert arms["no_migration"]["lost_reasons"] == ["error_replica_dead"]
+    (parity,) = [r for r in recs if r.get("probe") == "parity"]
+    assert parity["sessions_checked"] == arms["migration"]["sessions"]
+    assert parity["max_abs_diff"] <= parity["bar"] == 1e-5
+    (summary,) = [r for r in recs if r.get("summary") == "rollout_ab"]
+    assert summary["quick"] is False
+    assert summary["lost_migration"] == 0 == summary["bar_lost_migration"]
+    assert summary["lost_no_migration"] == arms["no_migration"]["lost"] >= 1
+    assert summary["migrated"] == arms["migration"]["migrated"]
+    assert summary["max_abs_diff"] <= summary["bar_numeric"] == 1e-5
+
+
 def test_serve_trace_example_is_complete_chrome_trace():
     """The committed example trace (docs/observability.md "Reading a
     trace"): a real serve-smoke run whose completed requests each carry

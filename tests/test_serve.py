@@ -1600,3 +1600,507 @@ def test_lowprec_ab_quick_smoke(tmp_path):
     arms = {r.get("arm") for r in recs if "arm" in r}
     assert {"serve_f32", "serve_bf16", "host_python", "host_native"} <= arms
     assert summary["bf16_dispatch_slowdown_cpu"] > 0
+
+
+# --- rollout serving: stateful sessions (ISSUE 13) ------------------------
+
+
+def _offline(engine, sample, steps):
+    from gnot_tpu.serve import offline_rollout
+
+    return offline_rollout(engine, sample, steps, rows=MAX_BATCH)
+
+
+def test_serve_config_validates_rollout_knobs():
+    with pytest.raises(ValueError, match="rollout_steps"):
+        make_config(**{"serve.rollout_steps": -1})
+    with pytest.raises(ValueError, match="session_snapshot_every"):
+        make_config(**{"serve.session_snapshot_every": 0})
+    cfg = make_config(
+        **{"serve.rollout_steps": 8, "serve.session_snapshot_every": 2}
+    )
+    assert cfg.serve.rollout_steps == 8
+
+
+def test_rollout_session_completes_streams_and_matches_offline(
+    setup, tmp_path
+):
+    """THE basic rollout contract: K chained dispatches, each step
+    streamed (iterator AND callback) exactly once in order, carry
+    advanced between steps, rollout_step/session_snapshot events at
+    the configured cadence, a sessions rollup in serve_summary — and
+    the served trajectory matches the offline engine-only loop."""
+    model, params, samples, engine = setup
+    K = 4
+    server, sink, path = make_server(
+        setup, tmp_path, session_snapshot_every=2
+    )
+    pushed = []
+    with sink:
+        server.start()
+        fut = server.submit_rollout(
+            samples[0], K, on_step=lambda sid, k, out: pushed.append(k)
+        )
+        streamed = list(fut.iter_steps(timeout=30))
+        res = fut.result(timeout=30)
+        summary = server.drain()
+    assert res.ok and res.reason == "ok"
+    assert res.steps == K and res.steps_completed == K
+    assert [k for k, _ in streamed] == [1, 2, 3, 4] == pushed
+    ref = _offline(engine, samples[0], K)
+    for got, want in zip(res.outputs, ref):
+        np.testing.assert_allclose(got, want, atol=1e-5)
+    for (_, out), want in zip(streamed, ref):
+        np.testing.assert_allclose(out, want, atol=1e-5)
+    events = read_events(path)
+    steps = [e for e in events if e["event"] == "rollout_step"]
+    assert [e["step"] for e in steps] == [1, 2, 3, 4]
+    assert all(
+        e["session"] == res.session and e["steps"] == K for e in steps
+    )
+    # Snapshot cadence 2: snapshots at steps 2 and 4... but the final
+    # step completes the session (no snapshot needed), so exactly the
+    # step-2 rolling snapshot lands.
+    snaps = [e for e in events if e["event"] == "session_snapshot"]
+    assert [e["step"] for e in snaps] == [2]
+    sess = summary["sessions"]
+    assert sess["started"] == 1 and sess["completed"] == 1
+    assert sess["steps"] == K
+    assert sess["step_latency_p50_ms"] <= sess["step_latency_p99_ms"]
+
+
+def test_rollout_drain_resolves_partial_with_marker(setup, tmp_path):
+    """ISSUE 13 satellite: drain mid-rollout resolves the session
+    future with the completed prefix plus a terminal drained_at_step
+    marker and a shed event carrying the session id — never a hang.
+    (The one-shot drain guarantee, extended to multi-step sessions.)"""
+    model, params, samples, engine = setup
+    server, sink, path = make_server(setup, tmp_path)
+    with sink:
+        server.start()
+        fut = server.submit_rollout(samples[0], 50)
+        it = fut.iter_steps(timeout=30)
+        next(it)
+        next(it)  # at least two steps committed
+        server.drain()
+        res = fut.result(timeout=5)  # resolved, no hang
+    assert not res.ok and res.reason == "drained"
+    assert 2 <= res.steps_completed < 50
+    assert res.drained_at_step == res.steps_completed
+    assert len(res.outputs) == res.steps_completed
+    # The completed prefix is still the true trajectory prefix.
+    ref = _offline(engine, samples[0], res.steps_completed)
+    for got, want in zip(res.outputs, ref):
+        np.testing.assert_allclose(got, want, atol=1e-5)
+    events = read_events(path)
+    sheds = [
+        e for e in events
+        if e["event"] == "shed" and e.get("session") == res.session
+    ]
+    assert sheds and sheds[-1]["reason"] == "drained"
+    # The drain persisted a final snapshot at the stop point.
+    snaps = [e for e in events if e["event"] == "session_snapshot"]
+    assert snaps[-1]["step"] == res.drained_at_step
+    sess = [
+        e for e in events if e["event"] == "serve_summary"
+    ][0]["sessions"]
+    assert sess["drained"] == 1 and sess["completed"] == 0
+
+
+def test_rollout_sigterm_drain_resolves_every_session(setup, tmp_path):
+    """ISSUE 13 acceptance: SIGTERM during a rollout storm resolves
+    EVERY session future — completed or partial-with-marker, no hangs,
+    no orphaned sessions left resident."""
+    with PreemptionHandler() as preempt:
+        server, sink, path = make_server(
+            setup, tmp_path, preempt=preempt, max_wait_ms=2.0
+        )
+        _, _, samples, _ = setup
+        server.start()
+        futs = [server.submit_rollout(s, 25) for s in samples[:4]]
+        time.sleep(0.1)  # some steps commit
+        os.kill(os.getpid(), signal.SIGTERM)
+        results = [f.result(timeout=30) for f in futs]
+        summary = server.drain()
+        sink.close()
+    for r in results:
+        assert r.ok or (
+            r.reason == "drained" and r.drained_at_step is not None
+        ), (r.reason, r.detail)
+    sess = summary["sessions"]
+    assert sess["resident"] == 0  # no orphaned device/session state
+    assert sess["completed"] + sess["drained"] + sess["shed"] == 4
+    # Streams all terminated too (no consumer left blocked).
+    for f in futs:
+        assert list(f.iter_steps(timeout=1)) is not None
+
+
+def test_rollout_per_step_deadline_shed(setup, tmp_path):
+    """ISSUE 13 satellite: a per-step deadline expiry (injected
+    straggler stalling step 1) sheds the SESSION with the correct
+    reason — partial outputs, shed event carrying the session id."""
+    server, sink, path = make_server(
+        setup,
+        tmp_path,
+        default_deadline_ms=150.0,
+        faults=FaultInjector.from_spec("slow_request@1"),
+    )
+    _, _, samples, _ = setup
+    with sink:
+        server.start()
+        fut = server.submit_rollout(samples[0], 4)
+        res = fut.result(timeout=30)
+        server.drain()
+    assert not res.ok and res.reason == "shed_deadline"
+    assert res.steps_completed == 0 and res.outputs == []
+    sheds = [
+        e for e in read_events(path)
+        if e["event"] == "shed" and e.get("session") == res.session
+    ]
+    assert sheds and sheds[-1]["reason"] == "shed_deadline"
+
+
+def test_rollout_whole_budget_shed(setup, tmp_path):
+    """The whole-rollout deadline bounds the trajectory: a generous
+    per-step budget still ends the session when the rollout budget
+    runs out (reason shed_deadline, partial prefix intact)."""
+    model, params, samples, engine = setup
+    server, sink, path = make_server(setup, tmp_path)
+    with sink:
+        server.start()
+        fut = server.submit_rollout(
+            samples[0], 500, rollout_deadline_ms=250.0
+        )
+        res = fut.result(timeout=30)
+        server.drain()
+    assert not res.ok and res.reason == "shed_deadline"
+    assert 0 < res.steps_completed < 500
+    ref = _offline(engine, samples[0], min(res.steps_completed, 3))
+    for got, want in zip(res.outputs[:3], ref):
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_rollout_replica_kill_migrates_and_matches_offline(setup, tmp_path):
+    """THE ISSUE 13 chaos scenario: replica 0 dies mid-rollout
+    (replica_kill) — every orphaned session migrates to the sibling
+    from its snapshot, replays forward, and completes with outputs
+    matching the offline engine-only rollout; zero lost sessions; the
+    dead replica's health edge lands in the event stream."""
+    from gnot_tpu.serve import ReplicaRouter
+
+    model, params, samples, engine = setup
+    K = 4
+    replicas = _make_replicas(setup, 2)
+    for r in replicas:
+        r.warm(samples[:1], rows=MAX_BATCH)
+    sink = MetricsSink(str(tmp_path / "serve.jsonl"))
+    with sink:
+        router = ReplicaRouter(
+            replicas,
+            sink=sink,
+            max_batch=MAX_BATCH,
+            max_wait_ms=2.0,
+            session_snapshot_every=2,
+            faults={0: FaultInjector.from_spec("replica_kill@2")},
+        ).start()
+        futs = [router.submit_rollout(s, K) for s in samples[:4]]
+        results = [f.result(timeout=60) for f in futs]
+        summary = router.drain()
+    assert all(r.ok for r in results), [
+        (r.reason, r.detail) for r in results
+    ]
+    sess = summary["sessions"]
+    assert sess["lost"] == 0 and sess["completed"] == 4
+    assert sess["migrated"] >= 1
+    for s, r in zip(samples[:4], results):
+        ref = _offline(engine, s, K)
+        assert len(r.outputs) == K
+        for got, want in zip(r.outputs, ref):
+            np.testing.assert_allclose(got, want, atol=1e-5)
+    events = _read_all(str(tmp_path / "serve.jsonl"))
+    migs = [e for e in events if e.get("event") == "session_migrate"]
+    assert migs and all(
+        e["from_replica"] == 0 and e["to_replica"] == 1
+        and e["reason"] == "error_replica_dead"
+        and e["replay_from"] <= e["at_step"]
+        for e in migs
+    )
+    assert any(
+        e.get("event") == "replica_health" and e["reason"] == "dead"
+        and e["replica"] == 0
+        for e in events
+    )
+    # Migrated sessions committed each step exactly once client-side:
+    # rollout_step coverage per session is exactly 1..K.
+    by_session: dict = {}
+    for e in events:
+        if e.get("event") == "rollout_step":
+            by_session.setdefault(e["session"], set()).add(e["step"])
+    for r in results:
+        assert by_session[r.session] == set(range(1, K + 1))
+
+
+def test_rollout_breaker_trip_mid_session_migrates(setup, tmp_path):
+    """Breaker trip mid-session (rollout_nan trips a threshold-1
+    breaker on the owner): the session is handed to a sibling instead
+    of dying behind the sick backend, and its trajectory still matches
+    the offline loop — the poisoned step was replayed, never
+    committed."""
+    from gnot_tpu.serve import ReplicaRouter
+
+    model, params, samples, engine = setup
+    K = 4
+    replicas = _make_replicas(setup, 2)
+    for r in replicas:
+        r.warm(samples[:1], rows=MAX_BATCH)
+    sink = MetricsSink(str(tmp_path / "serve.jsonl"))
+    with sink:
+        router = ReplicaRouter(
+            replicas,
+            sink=sink,
+            max_batch=MAX_BATCH,
+            max_wait_ms=2.0,
+            breaker_threshold=1,
+            breaker_cooldown_s=30.0,  # stays open for the whole test
+            faults={0: FaultInjector.from_spec("rollout_nan@2")},
+        ).start()
+        futs = [router.submit_rollout(s, K) for s in samples[:4]]
+        results = [f.result(timeout=60) for f in futs]
+        summary = router.drain()
+    assert all(r.ok for r in results), [
+        (r.reason, r.detail) for r in results
+    ]
+    assert summary["sessions"]["lost"] == 0
+    assert summary["sessions"]["migrated"] >= 1
+    assert summary["breaker_trips"] >= 1
+    for s, r in zip(samples[:4], results):
+        ref = _offline(engine, s, K)
+        for got, want in zip(r.outputs, ref):
+            np.testing.assert_allclose(got, want, atol=1e-5)
+    events = _read_all(str(tmp_path / "serve.jsonl"))
+    migs = [e for e in events if e.get("event") == "session_migrate"]
+    assert migs and all(e["to_replica"] == 1 for e in migs)
+    assert any(e.get("event") == "breaker_open" for e in events)
+
+
+def test_rollout_stale_session_replays_from_snapshot(setup, tmp_path):
+    """stale_session: the resident carry is lost under a live session —
+    the step fails error_stale_session, the session restores from its
+    snapshot on a sibling, and the trajectory is still exact."""
+    from gnot_tpu.serve import ReplicaRouter
+
+    model, params, samples, engine = setup
+    K = 4
+    replicas = _make_replicas(setup, 2)
+    for r in replicas:
+        r.warm(samples[:1], rows=MAX_BATCH)
+    sink = MetricsSink(str(tmp_path / "serve.jsonl"))
+    with sink:
+        router = ReplicaRouter(
+            replicas,
+            sink=sink,
+            max_batch=MAX_BATCH,
+            max_wait_ms=2.0,
+            session_snapshot_every=1,
+            faults={0: FaultInjector.from_spec("stale_session@2")},
+        ).start()
+        futs = [router.submit_rollout(s, K) for s in samples[:2]]
+        results = [f.result(timeout=60) for f in futs]
+        summary = router.drain()
+    assert all(r.ok for r in results), [
+        (r.reason, r.detail) for r in results
+    ]
+    assert summary["sessions"]["lost"] == 0
+    for s, r in zip(samples[:2], results):
+        ref = _offline(engine, s, K)
+        for got, want in zip(r.outputs, ref):
+            np.testing.assert_allclose(got, want, atol=1e-5)
+    events = _read_all(str(tmp_path / "serve.jsonl"))
+    migs = [e for e in events if e.get("event") == "session_migrate"]
+    assert migs and migs[0]["reason"] == "error_stale_session"
+    # snapshot_every=1: the replay resumed from the failure point, no
+    # committed step was re-run.
+    assert migs[0]["replay_from"] == migs[0]["at_step"]
+
+
+def test_rollout_rolling_reload_keeps_sessions_serving(setup, tmp_path):
+    """ISSUE 13 satellite: a rolling hot-reload with live sessions —
+    the warming replica keeps serving ITS resident sessions to
+    completion (only NEW placements drain to siblings), every session
+    completes, zero lost/shed."""
+    from gnot_tpu.serve import ReplicaRouter
+
+    model, params, samples, _ = setup
+    host_params = jax.tree.map(np.array, jax.device_get(params))
+    reloads = []
+
+    def reload_fn(deadline_ms=None):
+        reloads.append(1)
+        return host_params, {"epoch": len(reloads)}
+
+    replicas = _make_replicas(setup, 2)
+    for r in replicas:
+        r.warm(samples[:1], rows=MAX_BATCH)
+    sink = MetricsSink(str(tmp_path / "serve.jsonl"))
+    with sink:
+        router = ReplicaRouter(
+            replicas,
+            sink=sink,
+            max_batch=MAX_BATCH,
+            max_wait_ms=2.0,
+            reload_fn=reload_fn,
+        ).start()
+        futs = [router.submit_rollout(s, 8) for s in samples[:4]]
+        assert router.reload() == 2  # rolling, mid-storm
+        results = [f.result(timeout=60) for f in futs]
+        summary = router.drain()
+    assert all(r.ok for r in results), [
+        (r.reason, r.detail) for r in results
+    ]
+    sess = summary["sessions"]
+    assert sess["completed"] == 4 and sess["lost"] == 0
+    assert summary["shed"] == {}
+    events = _read_all(str(tmp_path / "serve.jsonl"))
+    steps = [e for e in events if e.get("event") == "rolling_reload"]
+    assert [e["ok"] for e in steps] == [True, True]
+
+
+def test_rollout_mixed_with_oneshot_keeps_bucket_discipline(
+    setup, tmp_path
+):
+    """Concurrent one-shot + rollout traffic: bucket discipline holds
+    (no dispatch outside a real bucket), both kinds resolve, and the
+    summary carries both the request counters and the sessions
+    rollup."""
+    model, params, samples, engine = setup
+    server, sink, path = make_server(setup, tmp_path, max_wait_ms=2.0)
+    with sink:
+        server.start()
+        one_shot = [server.submit(s) for s in samples[:4]]
+        sessions = [server.submit_rollout(s, 3) for s in samples[4:6]]
+        ones = [f.result(timeout=30) for f in one_shot]
+        rolls = [f.result(timeout=30) for f in sessions]
+        summary = server.drain()
+    assert all(r.ok for r in ones)
+    assert all(r.ok for r in rolls)
+    # One-shot answers are unaffected by the session traffic sharing
+    # their buckets/dispatches.
+    for s, r in zip(samples[:4], ones):
+        key = engine.bucket_key(s)
+        solo = engine.infer(
+            [s], pad_nodes=key[0], pad_funcs=key[1], rows=MAX_BATCH
+        )[0]
+        np.testing.assert_allclose(r.output, solo, rtol=1e-5, atol=1e-5)
+    events = read_events(path)
+    dispatches = [e for e in events if e["event"] == "queue_depth"]
+    keys = {engine.bucket_key(s) for s in samples[:6]}
+    assert {
+        (e["bucket_nodes"], e["bucket_funcs"]) for e in dispatches
+    } <= keys
+    assert summary["sessions"]["completed"] == 2
+    assert summary["completed"] == 4 + 2 * 3  # requests + steps
+
+
+def test_router_load_accounting_counts_resident_sessions(setup, tmp_path):
+    """ISSUE 13 satellite (the load-accounting audit): a replica
+    holding a resident session must not be preferred for new
+    placements even when its visible queue depth ties the sibling's."""
+    from gnot_tpu.serve import ReplicaRouter
+
+    _, _, samples, _ = setup
+    replicas = _make_replicas(setup, 2)
+    sink = MetricsSink(str(tmp_path / "serve.jsonl"))
+    with sink:
+        # Workers NOT started: queues only fill, state is frozen.
+        router = ReplicaRouter(
+            replicas, sink=sink, max_batch=MAX_BATCH,
+            route_policy="least_loaded",
+        )
+        f0 = router.submit_rollout(samples[0], 5)  # -> replica 0
+        assert replicas[0].server.resident_sessions() == 1
+        # Both replicas now hold ONE in-system request each (the
+        # session's queued step vs nothing yet on 1): the next two
+        # placements must both prefer replica 1 — depth ties at the
+        # second, and only the session accounting breaks it.
+        f1 = router.submit(samples[1])  # depths 1 vs 0 -> replica 1
+        f2 = router.submit(samples[2])  # 1+1session vs 1 -> replica 1
+        routes = [
+            e for e in _read_all(str(tmp_path / "serve.jsonl"))
+            if e.get("event") == "route"
+        ]
+        assert [e["replica"] for e in routes] == [0, 1, 1]
+        assert routes[0].get("session")  # session placement is tagged
+        router.drain()
+        for f in (f1, f2):
+            assert f.result(timeout=5).reason == "rejected_draining"
+        assert f0.result(timeout=5).reason in ("drained",)
+
+
+def test_serve_smoke_tool_rollout(tmp_path):
+    """Tier-1 wiring of tools/serve_smoke.py --rollout: the K-step
+    session storm through the 2-replica router passes every session
+    assertion (one rollout_step per step, affinity honored, zero lost
+    sessions)."""
+    import serve_smoke
+
+    summary = serve_smoke.run(
+        [
+            "--n", "6", "--rollout", "3", "--replicas", "2",
+            "--metrics_path", str(tmp_path / "smoke.jsonl"),
+        ]
+    )
+    assert summary["failures"] == []
+    assert summary["sessions"]["completed"] == 6
+    assert summary["sessions"]["lost"] == 0
+
+
+@pytest.mark.slow
+def test_rollout_ab_quick_smoke(tmp_path):
+    """tools/rollout_ab.py --quick end-to-end (in-process: structure
+    and bookkeeping, not the committed artifact's bars, which
+    test_artifacts pins): migration arm loses nothing, the twin loses
+    measurably, parity within the bar."""
+    import rollout_ab
+
+    out = str(tmp_path / "ab.jsonl")
+    summary = rollout_ab.run(["--quick", "--out", out])
+    assert summary["failures"] == []
+    assert summary["lost_migration"] == 0
+    assert summary["lost_no_migration"] >= 1
+    assert summary["max_abs_diff"] <= summary["bar_numeric"]
+
+
+def test_rollout_whole_pool_death_resolves_lost_not_hang(setup, tmp_path):
+    """Code-review regression: when EVERY replica dies mid-rollout the
+    router must resolve the orphaned sessions as lost — re-placing onto
+    a dead sibling would swallow the step into a queue nobody drains
+    and hang the future forever."""
+    from gnot_tpu.serve import ReplicaRouter
+
+    _, _, samples, _ = setup
+    replicas = _make_replicas(setup, 2)
+    for r in replicas:
+        r.warm(samples[:1], rows=MAX_BATCH)
+    sink = MetricsSink(str(tmp_path / "serve.jsonl"))
+    with sink:
+        router = ReplicaRouter(
+            replicas,
+            sink=sink,
+            max_batch=MAX_BATCH,
+            max_wait_ms=2.0,
+            faults={
+                0: FaultInjector.from_spec("replica_kill@1"),
+                1: FaultInjector.from_spec("replica_kill@1"),
+            },
+        ).start()
+        futs = [router.submit_rollout(s, 6) for s in samples[:4]]
+        # The futures MUST resolve (lost), well inside the timeout.
+        results = [f.result(timeout=30) for f in futs]
+        summary = router.drain()
+    assert all(not r.ok for r in results)
+    assert {r.reason for r in results} == {"error_replica_dead"}
+    sess = summary["sessions"]
+    assert sess["lost"] == 4 and sess["completed"] == 0
+    # Streams terminated too — no consumer left blocked.
+    for f in futs:
+        list(f.iter_steps(timeout=1))

@@ -1,0 +1,253 @@
+"""Chaos A/B: fault-tolerant rollout serving vs a no-migration twin.
+
+The ISSUE 13 acceptance artifact: a storm of concurrent K-step
+autoregressive rollout sessions (serve/rollout.py) over a 2-replica
+pool, with replica 0 KILLED mid-storm (``replica_kill@N`` — its worker
+dies, every in-system request fails ``error_replica_dead``). Two arms,
+identical traffic, identical fault:
+
+* ``migration`` — ``session_migration=True`` (the default): the router
+  re-places every orphaned session on the surviving replica from its
+  last host-side snapshot and replays forward. Bar: **0 lost
+  sessions**, and every served rollout matches the offline engine-only
+  K-step loop (``offline_rollout``) to <= 1e-5 per step — at-least-once
+  replay is EXACT, not approximately recovered.
+* ``no_migration`` — the twin with migration disabled: sessions
+  resident on the killed replica resolve with the failure. Bar:
+  **measured losses > 0** (the kill genuinely orphaned sessions — the
+  migration arm's zero is an achievement, not a vacuous storm).
+
+Writes JSONL to ``--out`` (committed as
+``docs/artifacts/rollout_ab.jsonl``; schema pinned by
+``tests/test_artifacts.py::test_rollout_ab_artifact_schema``).
+
+Usage::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/rollout_ab.py --out docs/artifacts/rollout_ab.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np
+
+BAR_NUMERIC = 1e-5
+
+
+def run(argv=None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", type=str, required=True, help="JSONL output")
+    p.add_argument("--sessions", type=int, default=12)
+    p.add_argument("--steps", type=int, default=6)
+    p.add_argument(
+        "--kill_at_step", type=int, default=8,
+        help="replica 0 dies before dispatching its Nth rollout step "
+             "(1-indexed per-server step admission ordinal) — mid-storm"
+    )
+    p.add_argument(
+        "--snapshot_every", type=int, default=2,
+        help="session snapshot cadence > 1, so migration exercises a "
+             "REAL replay (steps past the snapshot re-execute)"
+    )
+    p.add_argument("--max_batch", type=int, default=2)
+    p.add_argument(
+        "--quick", action="store_true",
+        help="smaller storm for the in-process test-suite smoke"
+    )
+    args = p.parse_args(argv)
+    if args.quick:
+        args.sessions, args.steps, args.kill_at_step = 6, 4, 4
+
+    import jax
+
+    import serve_smoke
+
+    from gnot_tpu.resilience.faults import FaultInjector
+    from gnot_tpu.serve import (
+        ReplicaRouter,
+        build_replicas,
+        offline_rollout,
+        rollout,
+    )
+    from gnot_tpu.utils.metrics import MetricsSink
+
+    engine = serve_smoke.build_engine(max_batch=args.max_batch)
+    traffic = serve_smoke.mixed_traffic(
+        args.sessions, seed=7, mesh_lo=100, mesh_hi=300
+    )
+    engine.warmup(traffic, rows=args.max_batch)
+    records: list[dict] = []
+    failures: list[str] = []
+
+    def check(ok: bool, msg: str) -> None:
+        if not ok:
+            failures.append(msg)
+
+    # The offline engine-only reference trajectories (no serve stack).
+    reference = [
+        offline_rollout(engine, s, args.steps, rows=args.max_batch)
+        for s in traffic
+    ]
+
+    arm_stats: dict[str, dict] = {}
+    arm_results: dict[str, list] = {}
+    for arm, migrate in (("migration", True), ("no_migration", False)):
+        replicas = build_replicas(
+            engine.model,
+            engine.params,
+            2,
+            batch_size=args.max_batch,
+            devices=jax.devices()[:2],
+        )
+        for r in replicas:
+            r.warm(traffic, rows=args.max_batch)
+        sink_path = f"{args.out}.{arm}.events.jsonl"
+        with MetricsSink(sink_path) as sink:
+            router = ReplicaRouter(
+                replicas,
+                sink=sink,
+                max_batch=args.max_batch,
+                max_wait_ms=2.0,
+                session_snapshot_every=args.snapshot_every,
+                session_migration=migrate,
+                faults={
+                    0: FaultInjector.from_spec(
+                        f"replica_kill@{args.kill_at_step}"
+                    )
+                },
+            ).start()
+            futures = [
+                router.submit_rollout(s, args.steps) for s in traffic
+            ]
+            results = [f.result(timeout=120) for f in futures]
+            summary = router.drain()
+        sess = summary.get("sessions") or {}
+        lost = [r for r in results if not r.ok]
+        check(
+            len(results) == args.sessions,
+            f"{arm}: {len(results)} futures resolved != {args.sessions}",
+        )
+        check(
+            sess.get("lost", 0) == len(lost),
+            f"{arm}: rollup lost={sess.get('lost')} != observed "
+            f"{len(lost)}",
+        )
+        events = [json.loads(l) for l in open(sink_path) if l.strip()]
+        kills = [
+            e for e in events
+            if e.get("event") == "replica_health"
+            and e.get("reason") == "dead"
+        ]
+        check(
+            bool(kills),
+            f"{arm}: replica 0 never read dead — the kill didn't land",
+        )
+        arm_stats[arm] = {
+            "arm": arm,
+            "sessions": args.sessions,
+            "steps": args.steps,
+            "snapshot_every": args.snapshot_every,
+            "killed_replica": 0,
+            "kill_at_step": args.kill_at_step,
+            "completed": sess.get("completed", 0),
+            "lost": len(lost),
+            "lost_reasons": sorted({r.reason for r in lost}),
+            "migrated": sess.get("migrated", 0),
+            "drained": sess.get("drained", 0),
+            "shed": sess.get("shed", 0),
+            "steps_committed": sum(r.steps_completed for r in results),
+            "step_latency_p50_ms": sess.get("step_latency_p50_ms"),
+            "step_latency_p99_ms": sess.get("step_latency_p99_ms"),
+        }
+        records.append(arm_stats[arm])
+        arm_results[arm] = results
+        os.remove(sink_path)
+
+    # The bars: zero lost with migration, measured losses without.
+    mig, nomig = arm_stats["migration"], arm_stats["no_migration"]
+    check(
+        mig["lost"] == 0,
+        f"migration arm lost {mig['lost']} sessions (must be 0)",
+    )
+    check(
+        mig["completed"] == args.sessions,
+        f"migration arm completed {mig['completed']}/{args.sessions}",
+    )
+    check(mig["migrated"] >= 1, "migration arm never migrated a session")
+    check(
+        nomig["lost"] >= 1,
+        "no-migration twin lost nothing — the kill was vacuous",
+    )
+
+    # Parity: every served rollout (migrated sessions included) matches
+    # the offline engine-only loop per step, at the original tolerance.
+    worst = 0.0
+    for r, ref in zip(arm_results["migration"], reference):
+        if not r.ok:
+            continue
+        worst = max(
+            worst, rollout.parity_check(r.outputs, ref, atol=BAR_NUMERIC)
+        )
+    check(
+        worst <= BAR_NUMERIC,
+        f"served rollouts drifted {worst} from the offline loop "
+        f"(bar {BAR_NUMERIC})",
+    )
+    records.append(
+        {
+            "probe": "parity",
+            "sessions_checked": sum(
+                r.ok for r in arm_results["migration"]
+            ),
+            "steps": args.steps,
+            "max_abs_diff": worst,
+            "bar": BAR_NUMERIC,
+        }
+    )
+
+    summary_rec = {
+        "summary": "rollout_ab",
+        "quick": args.quick,
+        "sessions": args.sessions,
+        "steps": args.steps,
+        "snapshot_every": args.snapshot_every,
+        "kill_at_step": args.kill_at_step,
+        "lost_migration": mig["lost"],
+        "lost_no_migration": nomig["lost"],
+        "migrated": mig["migrated"],
+        "max_abs_diff": worst,
+        "bar_numeric": BAR_NUMERIC,
+        "bar_lost_migration": 0,
+    }
+    records.append(summary_rec)
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+    with open(args.out, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    print(
+        f"rollout_ab: migration lost={mig['lost']} "
+        f"(migrated={mig['migrated']}) vs no_migration "
+        f"lost={nomig['lost']}; parity max |diff| = {worst:.2e} "
+        f"(bar {BAR_NUMERIC}); wrote {args.out}"
+    )
+    for msg in failures:
+        print(f"FAIL: {msg}")
+    summary_rec = dict(summary_rec)
+    summary_rec["failures"] = failures
+    return summary_rec
+
+
+def main(argv=None) -> int:
+    return 1 if run(argv)["failures"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -153,6 +153,24 @@ def run(argv=None) -> dict:
         choices=["affinity", "least_loaded", "round_robin"],
     )
     p.add_argument(
+        "--rollout", type=int, default=0, metavar="K",
+        help="autoregressive rollout mode (serve/rollout.py, "
+             "docs/serving.md 'Rollout serving'): drive each traffic "
+             "sample as ONE K-step stateful session instead of a "
+             "one-shot request. The smoke then asserts the SESSION "
+             "contract: every session future resolves, zero lost "
+             "sessions, exactly one rollout_step event per committed "
+             "step (1..K in order), session affinity honored (an "
+             "unmigrated session's steps all ran on one replica), and "
+             "the serve_summary sessions rollup is coherent. Default "
+             "fault in this mode: none (arm one explicitly to chaos-"
+             "test)"
+    )
+    p.add_argument(
+        "--session_snapshot_every", type=int, default=1,
+        help="rollout mode: host-side carry-snapshot cadence (steps)"
+    )
+    p.add_argument(
         "--prewarm", action="store_true",
         help="deploy-time AOT prewarm (serve/aot.py): compile + "
              "snapshot the whole program family for the target "
@@ -166,7 +184,12 @@ def run(argv=None) -> dict:
     if args.inject_fault == "none":
         args.inject_fault = ""
     elif not args.inject_fault:
-        args.inject_fault = f"slow_request@{args.n}"
+        # Rollout mode defaults to a clean storm (its assertions pin
+        # zero lost sessions); the one-shot smoke keeps its classic
+        # straggler-sheds-the-last-request scenario.
+        args.inject_fault = (
+            "" if args.rollout else f"slow_request@{args.n}"
+        )
 
     from gnot_tpu.data.batch import bucket_length
     from gnot_tpu.resilience.faults import FaultInjector
@@ -270,6 +293,7 @@ def run(argv=None) -> dict:
                 faults=FaultInjector.from_spec(args.inject_fault),
                 tracer=tracer,
                 pack_plan=pack_plan,
+                session_snapshot_every=args.session_snapshot_every,
             )
             if replicas is not None:
                 from gnot_tpu.serve import ReplicaRouter
@@ -286,7 +310,12 @@ def run(argv=None) -> dict:
             else:
                 server = InferenceServer(engine, **common).start()
             t_submit = _time.perf_counter()
-            futures = [server.submit(s) for s in traffic]
+            if args.rollout:
+                futures = [
+                    server.submit_rollout(s, args.rollout) for s in traffic
+                ]
+            else:
+                futures = [server.submit(s) for s in traffic]
             results = [f.result(timeout=120) for f in futures]
             wall_s = _time.perf_counter() - t_submit
             summary = server.drain()
@@ -311,7 +340,19 @@ def run(argv=None) -> dict:
         n_ok + n_shed == args.n,
         f"every request must resolve: {n_ok}+{n_shed} != {args.n}",
     )
-    check(summary["completed"] == n_ok, "summary.completed != observed oks")
+    if args.rollout:
+        # results are RolloutResults: completed counts ok STEP
+        # dispatches (>= the committed steps — a migration replays the
+        # post-snapshot tail, at-least-once).
+        check(
+            summary["completed"]
+            >= sum(r.steps_completed for r in results),
+            "summary.completed < committed rollout steps",
+        )
+    else:
+        check(
+            summary["completed"] == n_ok, "summary.completed != observed oks"
+        )
     check(n_ok >= 1, "storm completed zero requests")
     if "slow_request" in args.inject_fault and args.deadline_ms:
         check(
@@ -410,6 +451,79 @@ def run(argv=None) -> dict:
         any(e.get("event") == "serve_summary" for e in events),
         "no serve_summary event in the sink",
     )
+    if args.rollout:
+        # The session contract (docs/serving.md "Rollout serving").
+        migrated = {
+            e["session"]
+            for e in events
+            if e.get("event") == "session_migrate"
+        }
+        rsteps = [e for e in events if e.get("event") == "rollout_step"]
+        by_session: dict = {}
+        for e in rsteps:
+            by_session.setdefault(e["session"], []).append(e)
+        for r in results:
+            if not r.ok:
+                continue
+            got = sorted(e["step"] for e in by_session.get(r.session, []))
+            # Exactly one rollout_step event per committed step, 1..K
+            # (a migrated session may log replayed duplicates of the
+            # post-snapshot tail — committed coverage must still be
+            # exactly 1..K).
+            want = list(range(1, args.rollout + 1))
+            ok_steps = (
+                got == want
+                if r.session not in migrated
+                else sorted(set(got)) == want
+            )
+            check(
+                ok_steps,
+                f"session {r.session}: rollout_step events {got} != "
+                f"1..{args.rollout}",
+            )
+            # Session affinity: an unmigrated session's steps all ran
+            # on ONE replica (steps 2..K never re-route).
+            if replicas is not None and r.session not in migrated:
+                owners = {
+                    e.get("replica") for e in by_session.get(r.session, [])
+                }
+                check(
+                    len(owners) == 1,
+                    f"session {r.session} steps spread over replicas "
+                    f"{owners} without a migration",
+                )
+        # "Lost" matches the router rollup's definition: a migration
+        # give-up, i.e. a terminal BACKEND failure — not a deadline/
+        # queue shed (those count under `shed`) and not a drain
+        # (drained_at_step is set, possibly 0).
+        from gnot_tpu.serve.server import MIGRATABLE_REASONS
+
+        lost = [
+            r
+            for r in results
+            if not r.ok
+            and r.drained_at_step is None
+            and r.reason in MIGRATABLE_REASONS
+        ]
+        if not args.inject_fault:
+            check(
+                not lost,
+                f"clean rollout storm lost sessions: "
+                f"{[(r.session, r.reason) for r in lost]}",
+            )
+        sess = summary.get("sessions") or {}
+        check(
+            sess.get("started", 0) >= args.n,
+            f"sessions rollup malformed: {sess}",
+        )
+        if replicas is not None:
+            check(
+                sess.get("lost", 0) == len(lost),
+                f"sessions rollup lost={sess.get('lost')} != observed "
+                f"{len(lost)}",
+            )
+        snaps = [e for e in events if e.get("event") == "session_snapshot"]
+        check(bool(snaps), "rollout storm took no session snapshots")
     if args.prewarm:
         # The prewarmed tier must have compiled NOTHING: hydration is
         # snapshot deserialization (zero compile-cache consultations),
@@ -535,6 +649,8 @@ def run(argv=None) -> dict:
         )
 
     p50, p99 = summary["latency_p50_ms"], summary["latency_p99_ms"]
+    if args.rollout and summary.get("sessions"):
+        print(f"serve_smoke: sessions rollup {summary['sessions']}")
     print(
         f"serve_smoke: {n_ok}/{args.n} ok, shed={summary['shed']}, "
         f"p50={p50 if p50 is None else round(p50, 1)}ms "
