@@ -386,6 +386,28 @@ class ServeConfig:
     # (zero replay on migration); larger trades snapshot copies for
     # at-least-once replayed steps.
     session_snapshot_every: int = 1
+    # Live metrics plane (obs/metrics.py, docs/observability.md "Live
+    # metrics"): with metrics_interval_s > 0 a MetricsPublisher polls
+    # the serving tier's metric registry every interval — windowed
+    # log-bucketed latency histograms, shed/route counters, depth/
+    # breaker gauges — and publishes each snapshot as a
+    # `metrics_snapshot` event, one JSONL time-series row
+    # (<metrics-stem>.series.jsonl) and an atomically-rewritten
+    # Prometheus-text exposition file (<metrics-stem>.prom), while an
+    # SLOEvaluator turns the snapshot history into `slo_alert`
+    # fire/clear edges. 0 = off (the historical drain-time-only path;
+    # serve_summary itself is unchanged either way).
+    metrics_interval_s: float = 0.0
+    # SLO objectives the evaluator checks over fast/slow burn-rate
+    # windows (both must burn > 1.0 to FIRE; the fast window clearing
+    # CLEARS — edges only, never level spam). slo_p99_ms 0 disables
+    # the latency objective; slo_shed_frac is the tolerated windowed
+    # shed fraction (0 disables). Breaker-open, queue-saturation and
+    # session-loss objectives are always on when the plane is.
+    slo_p99_ms: float = 0.0
+    slo_shed_frac: float = 0.05
+    slo_fast_window_s: float = 5.0
+    slo_slow_window_s: float = 30.0
     # Deploy-time AOT prewarm manifest (tools/aot_prewarm.py,
     # docs/serving.md "Deploy-time prewarm"): when set, serving
     # hydrates each engine's executables from the manifest's
@@ -436,6 +458,24 @@ class ServeConfig:
             raise ValueError(
                 "session_snapshot_every must be >= 1, got "
                 f"{self.session_snapshot_every}"
+            )
+        if self.metrics_interval_s < 0:
+            raise ValueError(
+                f"metrics_interval_s must be >= 0, got "
+                f"{self.metrics_interval_s}"
+            )
+        if self.slo_p99_ms < 0:
+            raise ValueError(
+                f"slo_p99_ms must be >= 0, got {self.slo_p99_ms}"
+            )
+        if not 0.0 <= self.slo_shed_frac <= 1.0:
+            raise ValueError(
+                f"slo_shed_frac must be in [0, 1], got {self.slo_shed_frac}"
+            )
+        if not 0 < self.slo_fast_window_s <= self.slo_slow_window_s:
+            raise ValueError(
+                "need 0 < slo_fast_window_s <= slo_slow_window_s, got "
+                f"{self.slo_fast_window_s}/{self.slo_slow_window_s}"
             )
         from gnot_tpu.models.precision import SERVE_DTYPES
 

@@ -261,6 +261,26 @@ def build_parser() -> argparse.ArgumentParser:
              "host-side carry snapshots — the state a migration "
              "replays from; 1 = every step)"
     )
+    p.add_argument(
+        "--metrics_interval_s", type=float, default=0.0,
+        help="serving: live metrics plane (obs/metrics.py, docs/"
+             "observability.md 'Live metrics') — publish a registry "
+             "snapshot every N seconds: metrics_snapshot events, a "
+             "JSONL time series (<metrics-stem>.series.jsonl), a "
+             "Prometheus exposition file (<metrics-stem>.prom), and "
+             "slo_alert burn-rate fire/clear edges; 0 = off"
+    )
+    p.add_argument(
+        "--slo_p99_ms", type=float, default=0.0,
+        help="serving SLO: windowed pool p99 latency objective (ms) "
+             "the live metrics plane alerts on; 0 = no latency "
+             "objective"
+    )
+    p.add_argument(
+        "--slo_shed_frac", type=float, default=0.05,
+        help="serving SLO: tolerated windowed shed fraction before "
+             "the live metrics plane fires an slo_alert; 0 = off"
+    )
     p.add_argument("--checkpoint_every", type=int, default=0)
     p.add_argument(
         "--stop_after_epoch", type=int, default=0,
@@ -432,6 +452,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "serve.prewarm_manifest": args.serve_prewarm,
             "serve.rollout_steps": args.serve_rollout_steps,
             "serve.session_snapshot_every": args.session_snapshot_every,
+            "serve.metrics_interval_s": args.metrics_interval_s,
+            "serve.slo_p99_ms": args.slo_p99_ms,
+            "serve.slo_shed_frac": args.slo_shed_frac,
             "mesh.data": args.mesh_data,
             "mesh.seq": args.mesh_seq,
             "mesh.model": args.mesh_model,
@@ -712,9 +735,22 @@ def main(argv=None) -> float:
                 )
 
             stack.callback(_flush_trace)
+        # Live metrics plane (obs/metrics.py, --metrics_interval_s):
+        # ONE registry for the whole run — the serving tier's series on
+        # a --serve run, the telemetry drain's train_step_time_ms /
+        # train_slow_steps_total on a training run. Process-0-only like
+        # the sink/tracer. The publisher is built where the run shape
+        # is known: _run_serve (with the SLO evaluator) for serving,
+        # below (plain streaming) for training.
+        metrics_registry = None
+        if cfg.serve.metrics_interval_s > 0 and jax.process_index() == 0:
+            from gnot_tpu.obs.metrics import MetricsRegistry
+
+            metrics_registry = MetricsRegistry()
         trainer = Trainer(
             cfg, mc, train_samples, test_samples, metrics_sink=sink,
             checkpointer=checkpointer, tracer=tracer,
+            metrics_registry=metrics_registry,
         )
         # Late-arriving manifest fields (e.g. the serve-warmup compile-
         # cache hit/miss stats — known only after warmup ran); the
@@ -761,6 +797,7 @@ def main(argv=None) -> float:
             result = _run_serve(
                 args, cfg, trainer, full_test_samples, sink, checkpointer,
                 tracer=tracer, manifest_extra=manifest_extra,
+                registry=metrics_registry,
             )
             if manifests_on:
                 # Record which checkpoint serving actually restored AND
@@ -783,7 +820,30 @@ def main(argv=None) -> float:
                 # 'latest' to 'best' must be visible in run.json, not
                 # just the console.
                 write_run_manifest()
-            result = trainer.fit()
+            if metrics_registry is not None and cfg.train.metrics_path:
+                # Stream the training run's registry (the telemetry
+                # drain's step-time histogram + slow-step counter) at
+                # the same cadence the serving plane uses — no SLO
+                # evaluator (the declared objectives are serving ones).
+                from gnot_tpu.obs.metrics import MetricsPublisher
+
+                stem = os.path.splitext(cfg.train.metrics_path)[0]
+                fit_pub = MetricsPublisher(
+                    metrics_registry,
+                    interval_s=cfg.serve.metrics_interval_s,
+                    sink=sink,
+                    series_path=f"{stem}.series.jsonl",
+                    exposition_path=f"{stem}.prom",
+                ).start()
+                try:
+                    result = trainer.fit()
+                finally:
+                    fit_pub.close()
+                manifest_extra["metrics"] = fit_pub.stats()
+                if manifests_on:
+                    write_run_manifest()
+            else:
+                result = trainer.fit()
 
         if (args.export_torch or args.predict_out) and not args.eval_only:
             if checkpointer is not None:
@@ -812,7 +872,7 @@ def main(argv=None) -> float:
 
 def _run_serve(
     args, cfg, trainer, samples, sink, checkpointer, tracer=None,
-    manifest_extra=None,
+    manifest_extra=None, registry=None,
 ) -> float:
     """``--serve``: restore weights, start the fault-tolerant serving
     tier — ONE InferenceServer, or with ``--serve_replicas N`` the
@@ -956,6 +1016,38 @@ def _run_serve(
                 f"serves {sc.dtype!r} — re-run tools/aot_prewarm.py "
                 "with the matching --serve_dtype"
             )
+    # Live metrics plane (obs/metrics.py): one registry shared by the
+    # whole serving tier (per-replica servers record replica-labeled
+    # series that merge losslessly into the pool view), a publisher
+    # polling it every --metrics_interval_s, and the config-declared
+    # SLO objectives evaluated over fast/slow burn-rate windows.
+    publisher = None
+    if sc.metrics_interval_s > 0:
+        import tempfile
+
+        from gnot_tpu.obs import metrics as metrics_lib
+
+        # main() hands over the run's registry (the trainer's telemetry
+        # drain already records into it); a direct library caller gets
+        # a fresh one.
+        if registry is None:
+            registry = metrics_lib.MetricsRegistry()
+        if cfg.train.metrics_path:
+            stem = os.path.splitext(cfg.train.metrics_path)[0]
+        else:
+            stem = os.path.join(
+                tempfile.mkdtemp(prefix="gnot_metrics_"), "serve"
+            )
+        publisher = metrics_lib.MetricsPublisher(
+            registry,
+            interval_s=sc.metrics_interval_s,
+            sink=sink,
+            series_path=f"{stem}.series.jsonl",
+            exposition_path=f"{stem}.prom",
+            evaluator=metrics_lib.SLOEvaluator(
+                metrics_lib.default_objectives(sc)
+            ),
+        )
     with PreemptionHandler() as preempt:
         common = dict(
             max_batch=sc.max_batch,
@@ -971,6 +1063,7 @@ def _run_serve(
             preempt=preempt,
             tracer=tracer,
             session_snapshot_every=sc.session_snapshot_every,
+            metrics=registry,
         )
         if replicas is not None:
             server = ReplicaRouter(
@@ -1042,32 +1135,48 @@ def _run_serve(
                 **warm_stats,
             }
         server.start()
-        futures = []
+        if publisher is not None:
+            publisher.start()
         rollout_k = sc.rollout_steps
-        for i, s in enumerate(samples):
-            if preempt.triggered:
-                break
-            if rollout_k:
-                # Rollout serving (docs/serving.md "Rollout serving"):
-                # each sample becomes one K-step stateful session — K
-                # chained dispatches, carry resident on the owning
-                # replica, streamed partial results, migration on
-                # owner failure.
-                futures.append(server.submit_rollout(s, rollout_k))
-            else:
-                futures.append(server.submit(s))
-            if (
-                args.serve_reload_every
-                and checkpointer is not None
-                and (i + 1) % args.serve_reload_every == 0
-            ):
-                # On the router this is the ROLLING reload: one replica
-                # warms at a time, old weights keep serving.
-                server.reload(deadline_ms=sc.deadline_ms)
-        session_timeout = sc.drain_timeout_s * max(1, rollout_k)
-        for f in futures:
-            f.result(timeout=session_timeout)
-        summary = server.drain(sc.drain_timeout_s)
+        try:
+            summary, futures = _serve_storm(
+                args, sc, server, samples, checkpointer, preempt
+            )
+        finally:
+            # The publisher thread must stop BEFORE the sink can close
+            # (the enclosing ExitStack) on any exit path — a wedged
+            # storm or mid-stream crash must not leave a daemon thread
+            # ticking into a closed file. close() is idempotent: the
+            # success path below re-calls it for the final row without
+            # publishing twice.
+            if publisher is not None:
+                publisher.close()
+        if publisher is not None:
+            # The FINAL snapshot was taken AFTER the drain, so it reads
+            # the settled end-state counters — the drain-time
+            # serve_summary and the live plane's last word must agree
+            # (within the documented histogram estimate bound).
+            from gnot_tpu.obs import metrics as metrics_lib
+
+            final = publisher.close()
+            disagreements = metrics_lib.summary_agrees(summary, final)
+            if disagreements:
+                print(
+                    "WARNING: serve_summary and the final "
+                    f"metrics_snapshot disagree: {disagreements}"
+                )
+            if manifest_extra is not None:
+                manifest_extra["metrics"] = {
+                    **publisher.stats(),
+                    "summary_agrees": not disagreements,
+                }
+            print(
+                f"Metrics plane: {publisher.seq} snapshots every "
+                f"{sc.metrics_interval_s}s, {publisher.alerts} SLO "
+                f"alert edges -> {publisher.series_path} + "
+                f"{publisher.exposition_path} (summarize with "
+                "tools/metrics_report.py)"
+            )
     routing = summary.get("routing")
     sessions = summary.get("sessions")
     print(
@@ -1095,6 +1204,40 @@ def _run_serve(
         done = sum(1 for f in futures if f.result().ok)
         return done / max(1, len(futures))
     return summary["completed"] / max(1, summary["requests"])
+
+
+def _serve_storm(args, sc, server, samples, checkpointer, preempt):
+    """Drive the in-process demo storm through a started server and
+    drain it: returns ``(summary, futures)``. Factored out of
+    ``_run_serve`` so the metrics publisher can wrap the WHOLE storm in
+    one try/finally — any exit path stops the publisher thread before
+    the sink closes."""
+    futures = []
+    rollout_k = sc.rollout_steps
+    for i, s in enumerate(samples):
+        if preempt.triggered:
+            break
+        if rollout_k:
+            # Rollout serving (docs/serving.md "Rollout serving"):
+            # each sample becomes one K-step stateful session — K
+            # chained dispatches, carry resident on the owning
+            # replica, streamed partial results, migration on
+            # owner failure.
+            futures.append(server.submit_rollout(s, rollout_k))
+        else:
+            futures.append(server.submit(s))
+        if (
+            args.serve_reload_every
+            and checkpointer is not None
+            and (i + 1) % args.serve_reload_every == 0
+        ):
+            # On the router this is the ROLLING reload: one replica
+            # warms at a time, old weights keep serving.
+            server.reload(deadline_ms=sc.deadline_ms)
+    session_timeout = sc.drain_timeout_s * max(1, rollout_k)
+    for f in futures:
+        f.result(timeout=session_timeout)
+    return server.drain(sc.drain_timeout_s), futures
 
 
 def _write_predictions(samples, preds, path: str) -> None:

@@ -2,7 +2,7 @@
 monitors, and host-side span tracing (ROADMAP north star: every
 perf/parity PR must be debuggable).
 
-Four pieces, all off the hot path by construction:
+Five pieces, all off the hot path by construction:
 
 * ``telemetry`` — model-internals scalars (grad/param/update norms,
   per-layer MoE gate load + entropy, padding waste) computed as side
@@ -19,4 +19,12 @@ Four pieces, all off the hot path by construction:
   trace-event JSON; ``tools/trace_report.py`` prints per-kind
   percentiles, the per-bucket queue-wait/device split, and the
   critical path of the slowest request or step.
+* ``metrics`` — the LIVE metrics plane: a thread-safe registry of
+  counters/gauges/windowed log-bucketed histograms (O(1) memory,
+  lossless replica->pool merge), a publisher streaming snapshots
+  (``metrics_snapshot`` events, JSONL time series, Prometheus-text
+  exposition) every ``--metrics_interval_s``, and burn-rate SLO
+  evaluation emitting ``slo_alert`` fire/clear edges;
+  ``tools/metrics_report.py`` renders the series and cross-checks the
+  final snapshot against ``serve_summary``.
 """

@@ -53,6 +53,8 @@ NATIVE_PACKER = "native_packer"
 ROLLOUT_STEP = "rollout_step"
 SESSION_SNAPSHOT = "session_snapshot"
 SESSION_MIGRATE = "session_migrate"
+METRICS_SNAPSHOT = "metrics_snapshot"
+SLO_ALERT = "slo_alert"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -278,6 +280,29 @@ EVENTS: dict[str, EventSpec] = {
         "after its owner failed mid-rollout (`reason` names the "
         "failure; replay resumes from the `replay_from` snapshot "
         "cursor — at-least-once step semantics, zero lost sessions)",
+    ),
+    "metrics_snapshot": EventSpec(
+        fields=("seq", "interval_s", "series", "pool"),
+        module="gnot_tpu/obs/metrics.py",
+        doc="one live metrics-plane publish cycle (obs/metrics.py, "
+        "cadence `--metrics_interval_s`): `pool` is the cross-replica "
+        "rollup (requests/completed/shed, merged-histogram p50/p99, "
+        "queue depth) — the serve_summary numbers, live; the full "
+        "per-series state goes to the JSONL time series and the "
+        "Prometheus exposition file",
+        optional=("series_path",),
+    ),
+    "slo_alert": EventSpec(
+        fields=(
+            "objective", "kind", "state", "threshold", "burn_fast",
+            "burn_slow",
+        ),
+        module="gnot_tpu/obs/metrics.py",
+        doc="an SLO objective crossed a burn-rate EDGE: `state` is "
+        "'fire' (burn >= 1 in BOTH the fast and slow windows) or "
+        "'clear' (the fast window recovered) — never level-triggered "
+        "spam; `value` carries the observed quantity",
+        optional=("value", "fast_window_s", "slow_window_s"),
     ),
     "trace_flush": EventSpec(
         fields=("path", "spans", "dropped"),

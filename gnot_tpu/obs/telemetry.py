@@ -197,6 +197,13 @@ class TelemetryBuffer:
     ``keep_batches=False`` drops the batch refs (multi-process runs,
     where the watchdog skips the localization re-run anyway — no point
     pinning a window of padded batches in host RAM).
+
+    ``metrics`` (an ``obs.metrics.MetricsRegistry``, optional) is the
+    live metrics plane's train-side tap: every drained dispatch
+    interval lands in the ``train_step_time_ms`` windowed histogram and
+    every slow-step outlier bumps ``train_slow_steps_total`` — the same
+    registry/publisher machinery the serving tier streams through, at
+    drain cadence (no new host syncs on the hot path).
     """
 
     #: drain cadence when log_every is 0 (telemetry on, records off —
@@ -205,7 +212,7 @@ class TelemetryBuffer:
 
     def __init__(
         self, sink, log_every: int, *, slow_step=None, on_nonfinite=None,
-        keep_batches: bool = True,
+        keep_batches: bool = True, metrics=None,
     ):
         self.sink = sink
         self.record_every = max(0, int(log_every))
@@ -216,6 +223,16 @@ class TelemetryBuffer:
         self._slow = slow_step
         self._on_nonfinite = on_nonfinite
         self._last_t: float | None = None
+        self._step_hist = (
+            metrics.histogram("train_step_time_ms")
+            if metrics is not None
+            else None
+        )
+        self._slow_counter = (
+            metrics.counter("train_slow_steps_total")
+            if metrics is not None
+            else None
+        )
 
     def append(
         self, *, steps, epoch, lrs, loss, telem, batches, span_ids=None
@@ -263,8 +280,12 @@ class TelemetryBuffer:
         fetched = jax.device_get([(e["loss"], e["telem"]) for e in entries])
         for e, (loss, telem) in zip(entries, fetched):
             k = len(e["steps"])
+            if self._step_hist is not None and e["dt"] is not None:
+                self._step_hist.record(e["dt"] * 1e3)
             if self._slow is not None and e["dt"] is not None:
                 outlier = self._slow.observe(e["dt"])
+                if outlier is not None and self._slow_counter is not None:
+                    self._slow_counter.inc()
                 if outlier is not None and self.sink is not None:
                     ids = e.get("span_ids") or []
                     span_id = next((s for s in ids if s is not None), None)

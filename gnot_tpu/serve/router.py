@@ -68,10 +68,9 @@ import time
 from concurrent.futures import Future
 from typing import Callable, Sequence
 
-import numpy as np
-
 from gnot_tpu.data.batch import MeshSample, PackPlan
 from gnot_tpu.obs import events
+from gnot_tpu.obs.metrics import LogHistogram
 from gnot_tpu.serve.policies import (
     ROUTE_POLICIES,
     ReplicaHealthPolicy,
@@ -119,6 +118,7 @@ class ReplicaRouter:
         session_snapshot_every: int = 1,
         session_migration: bool = True,
         max_session_migrations: int = 3,
+        metrics=None,
     ):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
@@ -164,7 +164,22 @@ class ReplicaRouter:
             tracer=tracer,
             pack_plan=pack_plan,
             session_snapshot_every=session_snapshot_every,
+            metrics=metrics,
         )
+        # Live metrics plane (obs/metrics.py): the ONE registry every
+        # per-replica server records into (replica-labeled series merge
+        # losslessly into the pool view the publisher snapshots), plus
+        # the router's own placement/migration counters and pool-size
+        # gauge — the sensor layer the autoscaling controller reads.
+        self._metrics = metrics
+        # Per-replica wedge gauges, cached off the hot path (health is
+        # assessed per placement — registry get-or-create is for misses
+        # only). Benign races resolve to the same registry object.
+        self._wedge_gauges: dict = {}
+        if metrics is not None:
+            metrics.gauge(
+                "pool_replicas", fn=lambda: float(len(self._pool()))
+            )
         # Rollout-session policy (serve/rollout.py): whether a session
         # whose owner fails mid-rollout is re-placed from its snapshot
         # (the fault-tolerant default) or resolved with the failure
@@ -352,6 +367,7 @@ class ReplicaRouter:
             self._routed[rid] = self._routed.get(rid, 0) + 1
             if reason == "spill":
                 self._spills += 1
+        self._note_route(reason)
         self._event(
             events.ROUTE,
             replica=replica.replica_id,
@@ -362,6 +378,14 @@ class ReplicaRouter:
             dtype=self._dtype,
         )
         return replica.server.submit(sample, deadline_ms=deadline_ms)
+
+    def _note_route(self, reason: str) -> None:
+        """One placement decision into the live registry: the per-
+        reason route counter (spills therefore have their own series —
+        the duplicated-compile pressure gauge the affinity policy is
+        judged by)."""
+        if self._metrics is not None:
+            self._metrics.counter("router_routes_total", reason=reason).inc()
 
     def _bucket_of(self, sample: MeshSample) -> tuple:
         """(affinity key, human label) for a request — the same bucket
@@ -459,6 +483,17 @@ class ReplicaRouter:
             # transition — runs only at dispatch).
             breaker_trial_due=r.server.breaker.trial_due(),
         )
+        if self._metrics is not None:
+            # The SLO evaluator's `wedged` objective reads this level:
+            # 1.0 while the policy judges the replica wedged (requests
+            # in-system, worker loop silent past wedge_after_s).
+            g = self._wedge_gauges.get(r.replica_id)
+            if g is None:
+                g = self._metrics.gauge(
+                    "serve_wedged", replica=r.replica_id
+                )
+                self._wedge_gauges[r.replica_id] = g
+            g.set(1.0 if verdict.reason == "wedged" else 0.0)
         with self._lock:
             changed = self._health_seen.get(r.replica_id) != verdict.reason
             if changed:
@@ -529,6 +564,7 @@ class ReplicaRouter:
             self._routed[rid] = self._routed.get(rid, 0) + 1
             if reason == "spill":
                 self._spills += 1
+        self._note_route(reason)
         self._event(
             events.ROUTE,
             replica=rid,
@@ -588,11 +624,17 @@ class ReplicaRouter:
             if session.resolve(False, reason, detail=detail):
                 with self._lock:
                     self._sessions_lost += 1
+                if self._metrics is not None:
+                    self._metrics.counter(
+                        "rollout_sessions_lost_total"
+                    ).inc()
             return
         at_step = session.cursor
         replay_from = session.restore_from_snapshot()
         with self._lock:
             self._sessions_migrated += 1
+        if self._metrics is not None:
+            self._metrics.counter("router_migrations_total").inc()
         self._event(
             events.SESSION_MIGRATE,
             session=session.sid,
@@ -663,7 +705,6 @@ class ReplicaRouter:
         # drain_timeouts and strand their queued Futures). Replica
         # drains are independent — each touches only its own server.
         per: dict[int, dict] = {}
-        lat: list[float] = []
         pool = self._pool()
 
         def _drain_one(r):
@@ -677,10 +718,16 @@ class ReplicaRouter:
             t.start()
         for t in threads:
             t.join()
+        # AFTER the drains: a drain flushes queued requests, whose
+        # latencies must be in the pool percentiles too. The pool view
+        # is the LOSSLESS merge of the per-replica log-bucketed
+        # histograms (obs/metrics.py) — bucket counts add exactly, so
+        # the pool p50/p99 carry the same estimate-error bound as each
+        # replica's own (per-replica percentiles can never be averaged
+        # into pool ones; merged populations can).
+        pool_hist = LogHistogram()
         for r in pool:
-            # AFTER the drains: a drain flushes queued requests, whose
-            # latencies must be in the pool percentiles too.
-            lat.extend(r.server.latencies_ms())
+            pool_hist.merge(r.server.latency_histogram())
         shed: dict[str, int] = {}
         for s in per.values():
             for reason, n in s["shed"].items():
@@ -705,16 +752,15 @@ class ReplicaRouter:
             st["pad_waste_frac"] = (
                 1.0 - st["real_tokens"] / cap if cap else None
             )
-        arr = np.asarray(lat, dtype=np.float64)
         warm_by_id = {r.replica_id: r.warm_stats for r in pool}
         # Pool-level rollout-session rollup: outcome counters are
         # router-truth (started/migrated/lost) plus the summed
-        # per-replica terminals; the per-step latency percentiles need
-        # the raw pooled population, exactly like the request ones.
-        step_lat: list[float] = []
+        # per-replica terminals; the per-step latency percentiles merge
+        # the per-replica step histograms, exactly like the request
+        # ones.
+        step_hist = LogHistogram()
         for r in pool:
-            step_lat.extend(r.server.step_latencies_ms())
-        step_arr = np.asarray(step_lat, dtype=np.float64)
+            step_hist.merge(r.server.step_latency_histogram())
         with self._lock:
             routed = dict(self._routed)
             spills = self._spills
@@ -737,12 +783,8 @@ class ReplicaRouter:
             "compiled_shapes": sum(
                 s["compiled_shapes"] for s in per.values()
             ),
-            "latency_p50_ms": (
-                float(np.percentile(arr, 50)) if arr.size else None
-            ),
-            "latency_p99_ms": (
-                float(np.percentile(arr, 99)) if arr.size else None
-            ),
+            "latency_p50_ms": pool_hist.percentile(0.50),
+            "latency_p99_ms": pool_hist.percentile(0.99),
             **(
                 {"pad_waste_by_bucket": dict(sorted(pad_waste.items()))}
                 if pad_waste
@@ -795,17 +837,9 @@ class ReplicaRouter:
                 ),
                 "migrated": sessions_migrated,
                 "lost": sessions_lost,
-                "steps": len(step_lat),
-                "step_latency_p50_ms": (
-                    float(np.percentile(step_arr, 50))
-                    if step_arr.size
-                    else None
-                ),
-                "step_latency_p99_ms": (
-                    float(np.percentile(step_arr, 99))
-                    if step_arr.size
-                    else None
-                ),
+                "steps": step_hist.count,
+                "step_latency_p50_ms": step_hist.percentile(0.50),
+                "step_latency_p99_ms": step_hist.percentile(0.99),
             }
         if not self._drained.is_set():
             self._drained.set()

@@ -81,6 +81,7 @@ import numpy as np
 
 from gnot_tpu.data.batch import MeshSample, PackPlan, pack_prefix
 from gnot_tpu.obs import events
+from gnot_tpu.obs.metrics import LogHistogram, Reservoir
 from gnot_tpu.obs.tracing import percentiles
 from gnot_tpu.serve.batcher import Batcher
 from gnot_tpu.serve.engine import InferenceEngine
@@ -196,6 +197,7 @@ class InferenceServer:
         pack_plan: PackPlan | None = None,
         replica: int | None = None,
         session_snapshot_every: int = 1,
+        metrics=None,
     ):
         self.engine = engine
         self.sink = sink
@@ -267,7 +269,62 @@ class InferenceServer:
         self._shed: dict[str, int] = {}  #: guarded_by _lock
         self._dispatches = 0  #: guarded_by _lock
         self._reloads = 0  #: guarded_by _lock
-        self._latencies_ms: list[float] = []  #: guarded_by _lock
+        # BOUNDED latency retention (obs/metrics.py, ISSUE 14): the
+        # windowed log-bucketed histogram is the percentile source
+        # (O(1) memory, lossless pool merge, estimates within
+        # metrics.REL_ERROR of exact nearest-rank) and the reservoir is
+        # the bounded raw-sample escape hatch (`latencies_ms()`). Both
+        # are internally locked — no `_lock` needed at the record/read
+        # sites, so the publisher thread can poll mid-dispatch. When a
+        # live `metrics` registry is attached, the histogram IS the
+        # registry's per-replica series, so serve_summary and every
+        # metrics_snapshot read the same buckets by construction.
+        self._metrics = metrics
+        lbl = {"replica": replica} if replica is not None else {}
+        self._metric_labels = lbl
+        if metrics is not None:
+            self._lat_hist = metrics.histogram(
+                "serve_request_latency_ms", **lbl
+            )
+            self._step_hist = metrics.histogram(
+                "rollout_step_latency_ms", **lbl
+            )
+            self._c_requests = metrics.counter("serve_requests_total", **lbl)
+            self._c_completed = metrics.counter(
+                "serve_completed_total", **lbl
+            )
+            self._c_dispatches = metrics.counter(
+                "serve_dispatches_total", **lbl
+            )
+            self._c_steps = metrics.counter("rollout_steps_total", **lbl)
+            metrics.gauge(
+                "serve_queue_depth",
+                fn=lambda: self.admission.depth, **lbl,
+            )
+            metrics.gauge(
+                "serve_breaker_open",
+                fn=lambda: 1.0 if self.breaker.state == "open" else 0.0,
+                **lbl,
+            )
+            metrics.gauge(
+                "serve_resident_sessions",
+                fn=self.resident_sessions, **lbl,
+            )
+        else:
+            self._lat_hist = LogHistogram()
+            self._step_hist = LogHistogram()
+            self._c_requests = None
+            self._c_completed = None
+            self._c_dispatches = None
+            self._c_steps = None
+        self._lat_res = Reservoir()
+        self._step_res = Reservoir()
+        # Hot-path series caches: registry get-or-create is a string
+        # build + lock per call — fine at shed/alert cadence, not per
+        # completed request. Benign races (two threads missing the
+        # cache together) resolve to the SAME registry object.
+        self._bucket_hists: dict[str, LogHistogram] = {}
+        self._shed_counters: dict = {}
         # Span-derived per-bucket timing for serve_summary: bucket key
         # -> {"queue_ms": one wait per TRACED request (shed included),
         # "device_ms": the dispatch's device time once per traced
@@ -310,7 +367,6 @@ class InferenceServer:
         self._sessions_shed = 0  #: guarded_by _lock
         self._sessions_failed = 0  #: guarded_by _lock
         self._rollout_steps = 0  #: guarded_by _lock
-        self._step_latencies_ms: list[float] = []  #: guarded_by _lock
         # Set by _die (the replica_kill fault) the moment the worker
         # starts failing everything: the router must read this replica
         # as dead IMMEDIATELY — migration callbacks run on the dying
@@ -346,6 +402,8 @@ class InferenceServer:
         )
         with self._lock:
             self._submitted += 1
+        if self._c_requests is not None:
+            self._c_requests.inc()
         if self._draining.is_set():
             self._trace_span(trace, "admission", now, reason="rejected_draining")
             return self._resolve_now(fut, "rejected_draining", now)
@@ -442,6 +500,7 @@ class InferenceServer:
             with self._lock:
                 self._sessions_started += 1
                 n = self._sessions_started
+            self._note_session("started")
             prefix = "s" if self.replica is None else f"s{self.replica}."
             ms = (
                 deadline_ms
@@ -468,6 +527,7 @@ class InferenceServer:
             # sessions ACCEPTED, migrated arrivals included).
             with self._lock:
                 self._sessions_started += 1
+            self._note_session("started")
         with self._lock:
             self._sessions[session.sid] = session
         self._submit_step(session)
@@ -535,6 +595,11 @@ class InferenceServer:
         if raced_shutdown:
             self.admission.release()
             self._end_session(session, reason="drained", kind="drained")
+            return
+        if self._c_requests is not None:
+            self._c_requests.inc()
+        if self._c_steps is not None:
+            self._c_steps.inc()
 
     def _session_step_done(self, req: _Request, result: ServeResult) -> None:
         """One session step left the system: commit + chain the next
@@ -543,8 +608,8 @@ class InferenceServer:
         session = req.session
         if result.ok:
             step = session.record_step(result.output)
-            with self._lock:
-                self._step_latencies_ms.append(result.latency_ms)
+            self._step_hist.record(result.latency_ms)
+            self._step_res.add(result.latency_ms)
             self._event(
                 events.ROLLOUT_STEP,
                 session=session.sid,
@@ -563,6 +628,7 @@ class InferenceServer:
                 if session.resolve(True, "ok"):
                     with self._lock:
                         self._sessions_completed += 1
+                    self._note_session("completed")
                 self._drop_session(session)
             else:
                 self._submit_step(session)
@@ -582,6 +648,7 @@ class InferenceServer:
                 if session.resolve(False, reason, detail=result.detail):
                     with self._lock:
                         self._sessions_failed += 1
+                    self._note_session("failed", lost=True)
                 self._event(
                     events.SHED, reason=reason, session=session.sid,
                     step=session.cursor,
@@ -617,6 +684,7 @@ class InferenceServer:
                 self._sessions_drained += 1
             else:
                 self._sessions_shed += 1
+        self._note_session("drained" if drained else "shed")
         self._event(events.SESSION_SNAPSHOT, session=session.sid, step=step)
         self._event(
             events.SHED, reason=reason, session=session.sid, step=step
@@ -978,6 +1046,8 @@ class InferenceServer:
         with self._lock:
             self._dispatches += 1
             dispatch = self._dispatches
+        if self._c_dispatches is not None:
+            self._c_dispatches.inc()
         # Traced members of this batch: queue_wait closes at dispatch
         # pop; the batch-level phases below are recorded per member
         # (same trace_id) with member_trace_ids linking the riders.
@@ -1096,8 +1166,8 @@ class InferenceServer:
         for r, o in zip(live, outs):
             lat = (done - r.submitted) * 1e3
             with self._lock:
-                self._latencies_ms.append(lat)
                 self._completed += 1
+            self._note_latency(lat, bucket)
             self._finish(
                 r,
                 ServeResult(ok=True, reason="ok", output=o, latency_ms=lat),
@@ -1201,11 +1271,22 @@ class InferenceServer:
         return self.admission.depth
 
     def latencies_ms(self) -> list[float]:
-        """Snapshot of completed-request latencies (ms). The router's
-        pool-level percentiles need the raw population — per-replica
-        p50/p99 cannot be averaged into a pool p50/p99."""
-        with self._lock:
-            return list(self._latencies_ms)
+        """BOUNDED snapshot of completed-request latencies (ms): the
+        raw reservoir sample (exact for populations up to its size,
+        uniform beyond — obs/metrics.py). Pool percentiles no longer
+        concatenate raw lists; they merge the per-replica histograms
+        losslessly (`latency_histogram`)."""
+        return self._lat_res.values()
+
+    def latency_histogram(self) -> LogHistogram:
+        """Point-in-time copy of the request-latency histogram — the
+        router's pool merge input (lossless: per-replica bucket counts
+        sum exactly to the pool histogram)."""
+        return self._lat_hist.copy()
+
+    def step_latency_histogram(self) -> LogHistogram:
+        """Point-in-time copy of the rollout-step latency histogram."""
+        return self._step_hist.copy()
 
     def resident_sessions(self) -> int:
         """Rollout sessions currently resident on this server — the
@@ -1216,10 +1297,9 @@ class InferenceServer:
             return len(self._sessions)
 
     def step_latencies_ms(self) -> list[float]:
-        """Snapshot of committed rollout-step latencies (ms) — the raw
-        population for the router's pooled per-step percentiles."""
-        with self._lock:
-            return list(self._step_latencies_ms)
+        """BOUNDED snapshot of committed rollout-step latencies (ms) —
+        the raw reservoir sample (see ``latencies_ms``)."""
+        return self._step_res.values()
 
     def worker_alive(self) -> bool:
         """False only when a started worker thread has EXITED (a crash
@@ -1275,6 +1355,50 @@ class InferenceServer:
     def _count_shed(self, reason: str, n: int = 1) -> None:
         with self._lock:
             self._shed[reason] = self._shed.get(reason, 0) + n
+        if self._metrics is not None:
+            c = self._shed_counters.get(reason)
+            if c is None:
+                c = self._metrics.counter(
+                    "serve_shed_total", reason=reason, **self._metric_labels
+                )
+                self._shed_counters[reason] = c
+            c.inc(n)
+
+    def _note_latency(self, lat_ms: float, bucket: str) -> None:
+        """One completed request's latency into the bounded retention:
+        the per-server histogram (the percentile source serve_summary
+        and the pool merge read), the raw reservoir, and — with a live
+        registry — the per-bucket latency series and completion
+        counter. All targets are internally locked; never called under
+        ``_lock``."""
+        self._lat_hist.record(lat_ms)
+        self._lat_res.add(lat_ms)
+        if self._metrics is not None:
+            self._c_completed.inc()
+            h = self._bucket_hists.get(bucket)
+            if h is None:
+                h = self._metrics.histogram(
+                    "serve_bucket_latency_ms", bucket=bucket,
+                    **self._metric_labels,
+                )
+                self._bucket_hists[bucket] = h
+            h.record(lat_ms)
+
+    def _note_session(self, outcome: str, lost: bool = False) -> None:
+        """One session outcome into the live registry (`started`,
+        `completed`, `drained`, `shed`, `failed`); ``lost`` additionally
+        bumps the SLO evaluator's session-loss counter (a session that
+        terminally failed on a backend signal with nobody to migrate
+        it)."""
+        if self._metrics is None:
+            return
+        self._metrics.counter(
+            "rollout_sessions_total", outcome=outcome, **self._metric_labels
+        ).inc()
+        if lost:
+            self._metrics.counter(
+                "rollout_sessions_lost_total", **self._metric_labels
+            ).inc()
 
     def _event(self, event: str, **fields) -> None:
         if self.sink is not None:
@@ -1286,8 +1410,14 @@ class InferenceServer:
         # Snapshot the shared counters under the lock (drain() may be
         # summarizing while a wedged worker still mutates them — the
         # drain_timeout path); the percentile math runs on the copies.
+        # Percentiles come from the bounded log-bucketed histograms
+        # (obs/metrics.py): estimates within metrics.REL_ERROR of the
+        # exact nearest-rank values (documented in
+        # docs/observability.md "Live metrics"), and — when a live
+        # registry is attached — the SAME buckets every
+        # metrics_snapshot published, so the drain-time view and the
+        # final snapshot agree by construction (summary_agrees).
         with self._lock:
-            lat = np.asarray(self._latencies_ms, dtype=np.float64)
             summary = {
                 "requests": self._submitted,
                 "admitted": self._admitted,
@@ -1301,7 +1431,6 @@ class InferenceServer:
                 for k, v in self._bucket_stats.items()
             }
             pack_stats = {k: dict(v) for k, v in self._pack_stats.items()}
-            step_lat = np.asarray(self._step_latencies_ms, dtype=np.float64)
             if self._sessions_started:
                 # Rollout-session rollup (serve/rollout.py): sessions
                 # ACCEPTED here (migrated arrivals included) and how
@@ -1313,17 +1442,9 @@ class InferenceServer:
                     "shed": self._sessions_shed,
                     "failed": self._sessions_failed,
                     "resident": len(self._sessions),
-                    "steps": len(self._step_latencies_ms),
-                    "step_latency_p50_ms": (
-                        float(np.percentile(step_lat, 50))
-                        if step_lat.size
-                        else None
-                    ),
-                    "step_latency_p99_ms": (
-                        float(np.percentile(step_lat, 99))
-                        if step_lat.size
-                        else None
-                    ),
+                    "steps": self._step_hist.count,
+                    "step_latency_p50_ms": self._step_hist.percentile(0.50),
+                    "step_latency_p99_ms": self._step_hist.percentile(0.99),
                 }
         if pack_stats:
             # Per-bucket pad-waste / packing efficiency over every
@@ -1376,8 +1497,8 @@ class InferenceServer:
             dtype=getattr(self.engine, "dtype", "float32"),
             breaker_trips=self.breaker.trips,
             compiled_shapes=self.engine.compiled_shapes,
-            latency_p50_ms=float(np.percentile(lat, 50)) if lat.size else None,
-            latency_p99_ms=float(np.percentile(lat, 99)) if lat.size else None,
+            latency_p50_ms=self._lat_hist.percentile(0.50),
+            latency_p99_ms=self._lat_hist.percentile(0.99),
         )
         if emit:
             self._event(events.SERVE_SUMMARY, **summary)

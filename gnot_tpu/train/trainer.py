@@ -424,8 +424,14 @@ class Trainer:
         metrics_sink=None,
         checkpointer=None,
         tracer=None,
+        metrics_registry=None,
     ):
         self.config = config
+        # Live metrics plane (obs/metrics.py): when a registry is
+        # attached, the telemetry drain feeds the train_step_time_ms
+        # windowed histogram and the slow-step counter — the same
+        # series/publisher machinery the serving tier streams through.
+        self._metrics_registry = metrics_registry
         # obs.tracing.Tracer (--trace_path) or None = tracing off. All
         # trainer spans are host-side (around dispatch, never inside
         # the compiled step — GL002 enforces that); one trace per
@@ -1178,6 +1184,7 @@ class Trainer:
                 # localization; multi-process skips it, so don't pin a
                 # drain window of padded batches per host for nothing.
                 keep_batches=jax.process_count() == 1,
+                metrics=self._metrics_registry,
             )
         import contextlib
 

@@ -71,6 +71,31 @@ def test_tracing_ab_artifact_schema():
     assert summary["ms_per_step_on"] == arms["tracing_on"]["ms_per_step"]
 
 
+def test_metrics_ab_artifact_schema():
+    """The committed metrics-plane overhead A/B (tools/metrics_ab.py):
+    interleaved serve-storm arms with the registry + publisher +
+    evaluator off vs on, plus a summary whose overhead_frac meets the
+    <=2% acceptance bar (the ISSUE 14 criterion) with the publisher
+    demonstrably running (snapshots published mid-storm)."""
+    path = os.path.join(ARTIFACT_DIR, "metrics_overhead_ab.jsonl")
+    with open(path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    arms = {r["arm"]: r for r in recs if "arm" in r}
+    assert set(arms) == {"metrics_off", "metrics_on"}
+    for r in arms.values():
+        assert r["requests_per_s"] > 0 and r["requests"] >= 1000
+        assert r["repeats"] >= 3  # interleaved best-of, not one sample
+    assert arms["metrics_on"]["snapshots"] >= 1  # the publisher RAN
+    (summary,) = [r for r in recs if r.get("summary") == "metrics_overhead"]
+    assert isinstance(summary["overhead_frac"], float)
+    assert summary["overhead_frac"] <= 0.02
+    assert summary["requests_per_s_on"] == arms["metrics_on"]["requests_per_s"]
+    assert summary["overhead_frac"] == pytest.approx(
+        1.0 - summary["requests_per_s_on"] / summary["requests_per_s_off"],
+        abs=1e-3,
+    )
+
+
 def test_sanitizer_ab_artifact_schema():
     """The committed donation-sanitizer A/B (tools/sanitizer_ab.py):
     three timed arms plus a summary meeting both ISSUE 11 bars —

@@ -390,6 +390,54 @@ def test_serve_manifest_records_warmup_cache(tmp_path):
         assert events_registry.validate_record(rec) == [], rec
 
 
+def test_metrics_snapshot_and_slo_alert_validate_against_registry(tmp_path):
+    """The live metrics plane's event kinds (ISSUE 14): REAL
+    metrics_snapshot records (from a publisher polling a serving
+    registry) and REAL slo_alert fire/clear edges (from a breached
+    objective) validate against the central registry specs."""
+    from gnot_tpu.obs.metrics import (
+        MetricsPublisher,
+        MetricsRegistry,
+        SLOEvaluator,
+        SLOObjective,
+    )
+
+    clock = {"t": 0.0}
+    reg = MetricsRegistry()
+    reqs = reg.counter("serve_requests_total")
+    shed = reg.counter("serve_shed_total", reason="shed_deadline")
+    mp = str(tmp_path / "m.jsonl")
+    with MetricsSink(mp) as sink:
+        pub = MetricsPublisher(
+            reg, interval_s=1.0, sink=sink,
+            series_path=str(tmp_path / "m.series.jsonl"),
+            exposition_path=str(tmp_path / "m.prom"),
+            evaluator=SLOEvaluator([
+                SLOObjective("shed_fraction", "shed_frac", 0.1,
+                             fast_window_s=1.0, slow_window_s=2.0),
+            ]),
+            clock=lambda: clock["t"],
+        )
+        for i in range(4):
+            reqs.inc(10)
+            if i == 2:
+                shed.inc(10)  # breach -> fire, then clear next window
+            pub.tick()
+            clock["t"] += 1.0
+    recs = read_jsonl(mp)
+    kinds = [r.get("event") for r in recs]
+    assert kinds.count("metrics_snapshot") == 4
+    states = [r["state"] for r in recs if r.get("event") == "slo_alert"]
+    assert states == ["fire", "clear"]
+    from gnot_tpu.obs import events as events_registry
+
+    for rec in recs:
+        assert events_registry.validate_record(rec) == [], rec
+    # Snapshot pool block mirrors the registry totals.
+    last = [r for r in recs if r.get("event") == "metrics_snapshot"][-1]
+    assert last["pool"]["requests"] == 40 and last["pool"]["shed"] == 10
+
+
 # --- health monitors ------------------------------------------------------
 
 

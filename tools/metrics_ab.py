@@ -1,0 +1,167 @@
+"""Metrics-plane overhead A/B: serve-storm throughput with the live
+metrics plane OFF vs ON.
+
+The acceptance bar for the metrics plane (docs/observability.md "Live
+metrics", mirroring the tracing/telemetry subsystems) is <=2%
+throughput cost with the publisher running. The ON arm is the WHOLE
+plane at its real sites: a ``MetricsRegistry`` attached to the server
+(per-request histogram records, per-bucket series, shed/dispatch
+counters, snapshot-time gauges), a ``MetricsPublisher`` thread
+polling it on a sub-second interval (JSONL time series + Prometheus
+exposition + ``metrics_snapshot`` events to a live sink), and the
+``SLOEvaluator`` burning every snapshot — against an OFF arm running
+the identical storm with no registry. Timed windows are best-of-N and
+interleaved off/on like tools/tracing_ab.py, so ambient machine-load
+drift hits both arms alike.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/metrics_ab.py \
+        --n 400 --repeats 3 --out docs/artifacts/metrics_overhead_ab.jsonl
+
+Emits one JSONL record per arm plus a summary record with
+``overhead_frac``; committed as docs/artifacts/metrics_overhead_ab.jsonl
+and schema-pinned by tests/test_artifacts.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def _window(
+    engine, traffic, *, on: bool, interval_s: float, max_batch: int
+) -> tuple[float, dict]:
+    """One timed storm window: submit -> all resolved, on a fresh
+    server over the shared warm engine. Returns (seconds, info)."""
+    from gnot_tpu.obs.metrics import (
+        MetricsPublisher,
+        MetricsRegistry,
+        SLOEvaluator,
+        SLOObjective,
+    )
+    from gnot_tpu.serve import InferenceServer
+    from gnot_tpu.utils.metrics import MetricsSink
+
+    tmp = tempfile.mkdtemp(prefix="metrics_ab_")
+    registry = publisher = None
+    info: dict = {}
+    # BOTH arms write the ordinary event stream (queue_depth per
+    # dispatch, serve_summary at drain): the sink is the deployment's
+    # baseline, not part of the metrics plane — the A/B isolates what
+    # the registry + publisher + evaluator ADD on top of it.
+    sink = MetricsSink(os.path.join(tmp, "events.jsonl"))
+    if on:
+        registry = MetricsRegistry()
+        publisher = MetricsPublisher(
+            registry,
+            interval_s=interval_s,
+            sink=sink,
+            series_path=os.path.join(tmp, "series.jsonl"),
+            exposition_path=os.path.join(tmp, "expo.prom"),
+            evaluator=SLOEvaluator([
+                SLOObjective("shed_fraction", "shed_frac", 0.05,
+                             fast_window_s=0.5, slow_window_s=2.0),
+                SLOObjective("breaker_open", "breaker_open", 1.0,
+                             fast_window_s=0.5, slow_window_s=2.0),
+            ]),
+        )
+    server = InferenceServer(
+        engine, max_batch=max_batch, max_wait_ms=2.0,
+        queue_limit=4 * len(traffic), metrics=registry, sink=sink,
+    ).start()
+    if publisher is not None:
+        publisher.start()
+    t0 = time.perf_counter()
+    futures = [server.submit(s) for s in traffic]
+    for f in futures:
+        r = f.result(timeout=120)
+        assert r.ok, r.reason
+    seconds = time.perf_counter() - t0
+    server.drain()
+    if publisher is not None:
+        info["snapshots"] = publisher.close()["seq"]
+    sink.close()
+    return seconds, info
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--n", type=int, default=400, help="requests per window")
+    p.add_argument("--max_batch", type=int, default=4)
+    p.add_argument("--interval_s", type=float, default=0.25,
+                   help="publisher cadence in the ON arm")
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--out", type=str, default="")
+    args = p.parse_args()
+
+    import jax
+
+    from serve_smoke import build_engine
+    from gnot_tpu.data import datasets
+
+    platform = jax.devices()[0].platform
+    engine = build_engine(max_batch=args.max_batch)
+    # Uniform darcy64 traffic: ONE bucket, warmed up front, so the
+    # windows time dispatch + the metrics plane — never a compile.
+    traffic = datasets.synth_darcy2d(args.n, seed=0, grid_n=8)
+    engine.warmup(traffic[: args.max_batch], rows=args.max_batch)
+
+    best = {"off": float("inf"), "on": float("inf")}
+    snapshots = 0
+    for _ in range(max(1, args.repeats)):
+        # Interleaved off/on (the telemetry/tracing A/B methodology):
+        # ambient load drift cancels across arms.
+        sec_off, _ = _window(
+            engine, traffic, on=False, interval_s=args.interval_s,
+            max_batch=args.max_batch,
+        )
+        sec_on, info = _window(
+            engine, traffic, on=True, interval_s=args.interval_s,
+            max_batch=args.max_batch,
+        )
+        best["off"] = min(best["off"], sec_off)
+        best["on"] = min(best["on"], sec_on)
+        snapshots = max(snapshots, info.get("snapshots", 0))
+
+    records = []
+    for arm in ("off", "on"):
+        records.append({
+            "arm": f"metrics_{arm}",
+            "requests": args.n,
+            "seconds": round(best[arm], 4),
+            "requests_per_s": round(args.n / best[arm], 2),
+            "platform": platform,
+            "max_batch": args.max_batch,
+            "interval_s": args.interval_s,
+            "repeats": args.repeats,
+            **({"snapshots": snapshots} if arm == "on" else {}),
+        })
+    rps_off = records[0]["requests_per_s"]
+    rps_on = records[1]["requests_per_s"]
+    records.append({
+        "summary": "metrics_overhead",
+        "config": "darcy64_storm",
+        "requests_per_s_off": rps_off,
+        "requests_per_s_on": rps_on,
+        "snapshots_on": snapshots,
+        "overhead_frac": round(1.0 - rps_on / rps_off, 4),
+        "bar": "overhead_frac <= 0.02 with the publisher running",
+    })
+    out = "\n".join(json.dumps(r) for r in records) + "\n"
+    sys.stdout.write(out)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,229 @@
+"""Render a live-metrics time series (obs/metrics.py) and cross-check
+the final snapshot against the drain-time ``serve_summary``.
+
+The metrics plane writes one JSONL row per publish cycle
+(``<metrics-stem>.series.jsonl`` under ``--metrics_interval_s``); this
+tool is its ``trace_report``-style reader:
+
+* **per-series history** — for every histogram series: windowed p50 /
+  p99 / rate (observations per second) over time, computed from the
+  cumulative bucket deltas between consecutive rows; for counters: the
+  per-interval rate; for gauges: the level.
+* **SLO breach intervals** — the fire->clear windows reconstructed
+  from the ``slo_alert`` edges in the event stream (``--metrics`` JSONL
+  from the same run), asserted to alternate (edge discipline: a second
+  ``fire`` without an intervening ``clear`` is a bug, not load).
+* **final-snapshot cross-check** — the last series row against the
+  ``serve_summary`` event, number-for-number: counters exactly,
+  percentiles within the documented histogram estimate bound
+  (``summary_agrees`` — the same check ``main.py --serve`` runs at
+  drain).
+
+Usage::
+
+    python tools/metrics_report.py run/serve.series.jsonl \
+        --metrics run/serve.jsonl
+
+Exit code 0 iff the series parses, the alert stream is edge-
+disciplined, and (when ``--metrics`` has a serve_summary) the final
+snapshot agrees with it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from gnot_tpu.obs.metrics import (  # noqa: E402
+    LogHistogram,
+    summary_agrees,
+)
+
+
+def load_rows(path: str) -> list[dict]:
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def series_history(rows: list[dict]) -> dict[str, list[dict]]:
+    """Per-series derived history: one entry per row transition with
+    windowed stats (histograms: delta p50/p99 + rate; counters: rate;
+    gauges: level)."""
+    out: dict[str, list[dict]] = {}
+    prev: dict | None = None
+    for row in rows:
+        t = row["t"]
+        dt = (t - prev["t"]) if prev is not None else None
+        for key, st in row["series"].items():
+            hist = out.setdefault(key, [])
+            kind = st["type"]
+            entry: dict = {"seq": row["seq"], "t": t, "type": kind}
+            if kind == "histogram":
+                base = (prev or {}).get("series", {}).get(key)
+                delta = LogHistogram.delta(st, base)
+                entry.update(
+                    count=st["count"],
+                    window_n=delta.count,
+                    p50_ms=delta.percentile(0.50),
+                    p99_ms=delta.percentile(0.99),
+                    rate=(delta.count / dt) if dt else None,
+                )
+            elif kind == "counter":
+                base = (prev or {}).get("series", {}).get(key)
+                d = st["value"] - (base["value"] if base else 0)
+                entry.update(
+                    value=st["value"], rate=(d / dt) if dt else None
+                )
+            else:
+                entry.update(value=st["value"])
+            hist.append(entry)
+        prev = row
+    return out
+
+
+def breach_intervals(events: list[dict]) -> tuple[list[dict], list[str]]:
+    """(fire->clear intervals per objective, edge-discipline problems)
+    from the ``slo_alert`` records of a metrics-event JSONL."""
+    alerts = [e for e in events if e.get("event") == "slo_alert"]
+    open_at: dict[str, dict] = {}
+    intervals: list[dict] = []
+    problems: list[str] = []
+    for a in alerts:
+        name = a["objective"]
+        if a["state"] == "fire":
+            if name in open_at:
+                problems.append(
+                    f"objective {name!r}: second fire without a clear"
+                )
+            open_at[name] = a
+        elif a["state"] == "clear":
+            start = open_at.pop(name, None)
+            if start is None:
+                problems.append(
+                    f"objective {name!r}: clear without a prior fire"
+                )
+                continue
+            intervals.append(
+                {
+                    "objective": name,
+                    "kind": a["kind"],
+                    "fired_ts": start.get("ts"),
+                    "cleared_ts": a.get("ts"),
+                    "peak_burn_fast": start["burn_fast"],
+                }
+            )
+        else:
+            problems.append(f"unknown slo_alert state {a['state']!r}")
+    for name, a in open_at.items():
+        intervals.append(
+            {
+                "objective": name,
+                "kind": a["kind"],
+                "fired_ts": a.get("ts"),
+                "cleared_ts": None,  # still burning at end of stream
+                "peak_burn_fast": a["burn_fast"],
+            }
+        )
+    return intervals, problems
+
+
+def run(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("series", help="the <stem>.series.jsonl time series")
+    p.add_argument(
+        "--metrics", default="",
+        help="the run's metrics-event JSONL (for slo_alert intervals "
+             "and the serve_summary cross-check)",
+    )
+    p.add_argument(
+        "--tail", type=int, default=5,
+        help="history rows to print per series",
+    )
+    args = p.parse_args(argv)
+
+    rows = load_rows(args.series)
+    if not rows:
+        print(f"FAIL: {args.series} is empty")
+        return 1
+    failures: list[str] = []
+    seqs = [r["seq"] for r in rows]
+    if seqs != sorted(set(seqs)):
+        failures.append(f"snapshot seq not strictly increasing: {seqs}")
+
+    hist = series_history(rows)
+    print(f"{args.series}: {len(rows)} snapshots, {len(hist)} series\n")
+    for key in sorted(hist):
+        entries = hist[key][-args.tail:]
+        kind = entries[-1]["type"]
+        print(f"  {key} [{kind}]")
+        for e in entries:
+            if kind == "histogram":
+                p50 = e["p50_ms"]
+                p99 = e["p99_ms"]
+                rate = e["rate"]
+                print(
+                    f"    seq {e['seq']:>4}  n={e['window_n']:>6}  "
+                    f"p50={p50 if p50 is None else round(p50, 2)}ms  "
+                    f"p99={p99 if p99 is None else round(p99, 2)}ms  "
+                    f"rate={rate if rate is None else round(rate, 2)}/s"
+                )
+            elif kind == "counter":
+                rate = e["rate"]
+                print(
+                    f"    seq {e['seq']:>4}  total={e['value']:>8}  "
+                    f"rate={rate if rate is None else round(rate, 2)}/s"
+                )
+            else:
+                print(f"    seq {e['seq']:>4}  value={e['value']}")
+
+    if args.metrics:
+        events = load_rows(args.metrics)
+        intervals, problems = breach_intervals(events)
+        failures.extend(problems)
+        print(f"\nSLO breach intervals ({len(intervals)}):")
+        for iv in intervals:
+            end = (
+                "open"
+                if iv["cleared_ts"] is None
+                else f"cleared @{iv['cleared_ts']:.3f}"
+            )
+            print(
+                f"  {iv['objective']} [{iv['kind']}] fired "
+                f"@{iv['fired_ts']:.3f} -> {end} "
+                f"(burn_fast {iv['peak_burn_fast']})"
+            )
+        summaries = [
+            e
+            for e in events
+            if e.get("event") == "serve_summary" and "routing" not in e
+        ] or [e for e in events if e.get("event") == "serve_summary"]
+        if summaries:
+            # Prefer the pool-level summary when a router emitted both
+            # tiers (per-replica summaries cover a subset each).
+            pool = [e for e in events if e.get("event") == "serve_summary"
+                    and ("per_replica" in e or "routing" in e)]
+            summary = (pool or summaries)[-1]
+            problems = summary_agrees(summary, rows[-1])
+            if problems:
+                failures.extend(
+                    f"final snapshot vs serve_summary: {p}" for p in problems
+                )
+            else:
+                print(
+                    "\nfinal snapshot agrees with serve_summary "
+                    f"(requests={summary['requests']}, "
+                    f"completed={summary['completed']}, "
+                    f"p99={summary['latency_p99_ms']})"
+                )
+
+    for msg in failures:
+        print(f"FAIL: {msg}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())

@@ -171,6 +171,40 @@ def run(argv=None) -> dict:
         help="rollout mode: host-side carry-snapshot cadence (steps)"
     )
     p.add_argument(
+        "--metrics_interval_s", type=float, default=0.0, metavar="S",
+        help="live metrics plane (obs/metrics.py): attach a "
+             "MetricsRegistry to the tier and publish snapshots every "
+             "S seconds (plus one guaranteed MID-STORM tick and the "
+             "final post-drain tick). The smoke then ALSO asserts the "
+             "ISSUE 14 contract: a mid-storm snapshot reports a "
+             "NONZERO live pool p99 before drain, the final snapshot "
+             "agrees with serve_summary number-for-number (counters "
+             "exact, percentiles within the documented histogram "
+             "bound), metrics_snapshot/slo_alert records validate "
+             "against the event registry, and the alert stream is "
+             "edge-disciplined (fire/clear alternation, no spam)"
+    )
+    p.add_argument(
+        "--slo_shed_frac", type=float, default=0.05,
+        help="metrics mode: tolerated windowed shed fraction before "
+             "the shed_fraction objective fires"
+    )
+    p.add_argument(
+        "--slo_fast_window_s", type=float, default=0.5,
+        help="metrics mode: fast burn-rate window (smoke timescale)"
+    )
+    p.add_argument(
+        "--slo_slow_window_s", type=float, default=2.0,
+        help="metrics mode: slow burn-rate window (smoke timescale)"
+    )
+    p.add_argument(
+        "--pace_s", type=float, default=0.0,
+        help="sleep between submissions: shapes the storm over wall "
+             "time (an open-loop trickle instead of one burst), so "
+             "cadence-driven metrics snapshots land genuinely "
+             "mid-storm"
+    )
+    p.add_argument(
         "--prewarm", action="store_true",
         help="deploy-time AOT prewarm (serve/aot.py): compile + "
              "snapshot the whole program family for the target "
@@ -283,6 +317,16 @@ def run(argv=None) -> dict:
             if pack_plan is not None:
                 engine.warmup_packed(traffic, pack_plan)
 
+        registry = publisher = mid_snap = final_snap = None
+        if args.metrics_interval_s > 0:
+            from gnot_tpu.obs.metrics import (
+                MetricsPublisher,
+                MetricsRegistry,
+                SLOEvaluator,
+                SLOObjective,
+            )
+
+            registry = MetricsRegistry()
         with MetricsSink(metrics_path) as sink:
             common = dict(
                 max_batch=args.max_batch,
@@ -294,7 +338,29 @@ def run(argv=None) -> dict:
                 tracer=tracer,
                 pack_plan=pack_plan,
                 session_snapshot_every=args.session_snapshot_every,
+                metrics=registry,
             )
+            if registry is not None:
+                w = dict(
+                    fast_window_s=args.slo_fast_window_s,
+                    slow_window_s=args.slo_slow_window_s,
+                )
+                stem = os.path.splitext(metrics_path)[0]
+                publisher = MetricsPublisher(
+                    registry,
+                    interval_s=args.metrics_interval_s,
+                    sink=sink,
+                    series_path=f"{stem}.series.jsonl",
+                    exposition_path=f"{stem}.prom",
+                    evaluator=SLOEvaluator([
+                        SLOObjective(
+                            "shed_fraction", "shed_frac",
+                            args.slo_shed_frac, **w,
+                        ),
+                        SLOObjective("breaker_open", "breaker_open", 1.0, **w),
+                        SLOObjective("session_loss", "session_loss", 1.0, **w),
+                    ]),
+                )
             if replicas is not None:
                 from gnot_tpu.serve import ReplicaRouter
 
@@ -309,16 +375,45 @@ def run(argv=None) -> dict:
                 server.start()
             else:
                 server = InferenceServer(engine, **common).start()
+            if publisher is not None:
+                publisher.start()
             t_submit = _time.perf_counter()
-            if args.rollout:
-                futures = [
-                    server.submit_rollout(s, args.rollout) for s in traffic
-                ]
-            else:
-                futures = [server.submit(s) for s in traffic]
-            results = [f.result(timeout=120) for f in futures]
+            futures = []
+            for s in traffic:
+                if args.rollout:
+                    futures.append(server.submit_rollout(s, args.rollout))
+                else:
+                    futures.append(server.submit(s))
+                if args.pace_s:
+                    _time.sleep(args.pace_s)
+            results = []
+            for i, f in enumerate(futures):
+                results.append(f.result(timeout=120))
+                if (
+                    publisher is not None
+                    and mid_snap is None
+                    and i + 1 >= max(1, args.n // 2)
+                ):
+                    # The guaranteed MID-STORM snapshot (the cadence
+                    # thread publishes too; this tick pins one while
+                    # requests are demonstrably still in flight) —
+                    # live p99 must be nonzero BEFORE drain.
+                    mid_snap = publisher.tick()
             wall_s = _time.perf_counter() - t_submit
             summary = server.drain()
+            if publisher is not None:
+                if args.inject_fault:
+                    # Observe the fault's breach right at drain (the
+                    # fire edge, if cadence didn't catch it), then let
+                    # it leave the FAST window and observe once more so
+                    # the alert CLEARS before the final snapshot (the
+                    # fire->clear edge-pair acceptance criterion — a
+                    # drained tier with a still-open alert would read
+                    # as a live incident).
+                    publisher.tick()
+                    _time.sleep(args.slo_fast_window_s * 1.25)
+                    publisher.tick()
+                final_snap = publisher.close()
             if tracer is not None:
                 tracer.flush(sink=sink)
     # Storm throughput (submit -> last resolve; the pack_ab serve
@@ -571,6 +666,86 @@ def run(argv=None) -> dict:
                 and all(e["source"] == "snapshot" for e in warms),
                 f"replica_warm events malformed: {warms}",
             )
+
+    if publisher is not None:
+        # Live-metrics-plane assertions (ISSUE 14 acceptance).
+        from gnot_tpu.obs import events as events_registry
+        from gnot_tpu.obs.metrics import summary_agrees
+
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import metrics_report
+
+        snaps = [e for e in events if e.get("event") == "metrics_snapshot"]
+        alerts = [e for e in events if e.get("event") == "slo_alert"]
+        check(
+            len(snaps) >= 2,
+            f"metrics plane published {len(snaps)} snapshots; need the "
+            "mid-storm tick plus the final post-drain one at minimum",
+        )
+        for rec in snaps + alerts:
+            check(
+                events_registry.validate_record(rec) == [],
+                f"metrics record fails registry validation: {rec}",
+            )
+        check(
+            mid_snap is not None
+            and mid_snap["pool"]["completed"] > 0
+            and (mid_snap["pool"]["p99_ms"] or 0) > 0,
+            f"mid-storm snapshot must report a nonzero live pool p99 "
+            f"BEFORE drain: {mid_snap and mid_snap['pool']}",
+        )
+        agree_problems = summary_agrees(summary, final_snap)
+        check(
+            not agree_problems,
+            f"final snapshot disagrees with serve_summary: "
+            f"{agree_problems}",
+        )
+        _, alert_problems = metrics_report.breach_intervals(events)
+        check(
+            not alert_problems,
+            f"slo_alert stream is not edge-disciplined: {alert_problems}",
+        )
+        if "slow_request" in args.inject_fault and args.deadline_ms:
+            # The injected straggler's deadline sheds breach the shed
+            # SLO exactly once — one fire edge mid-storm, one clear
+            # edge after the quiet post-drain window, never spam — IF
+            # the breach was real at slow-window scale (the storm's
+            # overall shed fraction exceeded the objective). A blip
+            # the slow window correctly suppressed must stay silent:
+            # that suppression is the design, not a miss.
+            frac = sum(summary["shed"].values()) / max(
+                1, summary["requests"]
+            )
+            states = [
+                a["state"] for a in alerts
+                if a["objective"] == "shed_fraction"
+            ]
+            want = (
+                ["fire", "clear"] if frac > args.slo_shed_frac else []
+            )
+            check(
+                states == want,
+                f"shed SLO edges {states} != {want} (storm shed "
+                f"fraction {frac:.4f} vs objective "
+                f"{args.slo_shed_frac})",
+            )
+        stem = os.path.splitext(metrics_path)[0]
+        rows = metrics_report.load_rows(f"{stem}.series.jsonl")
+        check(
+            len(rows) == publisher.seq and rows[-1]["seq"] == publisher.seq,
+            f"series file rows ({len(rows)}) != published snapshots "
+            f"({publisher.seq})",
+        )
+        check(
+            os.path.exists(f"{stem}.prom")
+            and "serve_request_latency_ms_count" in open(f"{stem}.prom").read(),
+            "Prometheus exposition file missing or incomplete",
+        )
+        print(
+            f"serve_smoke: metrics plane {publisher.seq} snapshots, "
+            f"{len(alerts)} alert edges, mid-storm p99="
+            f"{round(mid_snap['pool']['p99_ms'], 1)}ms"
+        )
 
     if tracer is not None:
         # Trace-file assertions (ISSUE 5 acceptance): every completed
