@@ -408,6 +408,40 @@ class ServeConfig:
     slo_shed_frac: float = 0.05
     slo_fast_window_s: float = 5.0
     slo_slow_window_s: float = 30.0
+    # Self-healing elastic serving (serve/autoscaler.py,
+    # docs/serving.md "Elastic capacity"): with autoscale on, an
+    # AutoscaleController subscribes to the live metrics plane (the
+    # registry + SLO evaluator — requires metrics_interval_s > 0 for
+    # the alert signals; the load gauges work either way) and scales
+    # the replica pool between autoscale_min and autoscale_max:
+    # prewarm-before-join scale-out under SLO pressure / high
+    # per-replica load, drain-then-remove scale-in after sustained
+    # calm (resident rollout sessions migrate to siblings; the retired
+    # replica's latency history stays in the pool rollup), and
+    # self-healing replacement of dead/wedged/breaker-stuck replicas.
+    # Stability guards: per-direction cooldowns (autoscale_cooldown_s),
+    # up/down load hysteresis (autoscale_up_load > autoscale_down_load,
+    # per-replica in-system requests+sessions), a consecutive-calm-tick
+    # requirement before any scale-in, and a flap suppressor (no
+    # scale-in within 3 cooldowns of a scale-out).
+    autoscale: bool = False
+    autoscale_min: int = 1
+    autoscale_max: int = 4
+    autoscale_interval_s: float = 0.5
+    autoscale_cooldown_s: float = 2.0
+    autoscale_up_load: float = 8.0
+    autoscale_down_load: float = 1.0
+    autoscale_down_ticks: int = 3
+    autoscale_heal_after_s: float = 5.0
+    # On-disk rollout-session persistence (serve/rollout.py::
+    # SessionStore): with a directory set, every client-NAMED session
+    # (submit_rollout(name=...)) drained mid-rollout (SIGTERM, restart,
+    # pool teardown) persists its final carry snapshot there, and a
+    # restarted server/router resumes it from its last snapshotted
+    # step (resume_rollout). Auto-id sessions never persist — their
+    # ids restart per process, so persisting them would let one run's
+    # snapshots clobber another's. "" = off.
+    session_dir: str = ""
     # Deploy-time AOT prewarm manifest (tools/aot_prewarm.py,
     # docs/serving.md "Deploy-time prewarm"): when set, serving
     # hydrates each engine's executables from the manifest's
@@ -476,6 +510,44 @@ class ServeConfig:
             raise ValueError(
                 "need 0 < slo_fast_window_s <= slo_slow_window_s, got "
                 f"{self.slo_fast_window_s}/{self.slo_slow_window_s}"
+            )
+        if not 1 <= self.autoscale_min <= self.autoscale_max:
+            raise ValueError(
+                "need 1 <= autoscale_min <= autoscale_max, got "
+                f"{self.autoscale_min}/{self.autoscale_max}"
+            )
+        if self.autoscale and not (
+            self.autoscale_min <= self.replicas <= self.autoscale_max
+        ):
+            raise ValueError(
+                f"--autoscale needs the founding pool size (replicas="
+                f"{self.replicas}) within [autoscale_min, autoscale_max]"
+                f" = [{self.autoscale_min}, {self.autoscale_max}]"
+            )
+        if self.autoscale_interval_s <= 0:
+            raise ValueError(
+                "autoscale_interval_s must be > 0, got "
+                f"{self.autoscale_interval_s}"
+            )
+        if self.autoscale_cooldown_s < 0:
+            raise ValueError(
+                "autoscale_cooldown_s must be >= 0, got "
+                f"{self.autoscale_cooldown_s}"
+            )
+        if not 0 <= self.autoscale_down_load < self.autoscale_up_load:
+            raise ValueError(
+                "autoscale hysteresis needs 0 <= down_load < up_load, "
+                f"got {self.autoscale_down_load}/{self.autoscale_up_load}"
+            )
+        if self.autoscale_down_ticks < 1:
+            raise ValueError(
+                "autoscale_down_ticks must be >= 1, got "
+                f"{self.autoscale_down_ticks}"
+            )
+        if self.autoscale_heal_after_s <= 0:
+            raise ValueError(
+                "autoscale_heal_after_s must be > 0, got "
+                f"{self.autoscale_heal_after_s}"
             )
         from gnot_tpu.models.precision import SERVE_DTYPES
 

@@ -262,6 +262,46 @@ def build_parser() -> argparse.ArgumentParser:
              "replays from; 1 = every step)"
     )
     p.add_argument(
+        "--session_dir", type=str, default="",
+        help="serving: persist drained rollout sessions' final carry "
+             "snapshots in this directory (serve/rollout.py::"
+             "SessionStore) — a restarted server resumes a named "
+             "session from its last snapshotted step (resume_rollout)"
+    )
+    p.add_argument(
+        "--autoscale", action="store_true",
+        help="serving: self-healing elastic pool (serve/autoscaler.py, "
+             "docs/serving.md 'Elastic capacity') — an "
+             "AutoscaleController scales the replica pool against live "
+             "SLO/load pressure: prewarm-before-join scale-out, "
+             "drain-then-remove scale-in (resident sessions migrate to "
+             "siblings), self-healing replacement of dead/wedged "
+             "replicas; guards: min/max bounds, per-direction "
+             "cooldowns, hysteresis, flap suppression"
+    )
+    p.add_argument(
+        "--autoscale_min", type=int, default=1,
+        help="autoscale: pool floor (the controller never shrinks "
+             "below it)"
+    )
+    p.add_argument(
+        "--autoscale_max", type=int, default=4,
+        help="autoscale: pool ceiling — also the device-slot topology "
+             "(slots partition the device set max-wide, so an AOT "
+             "manifest compiled for the max topology hydrates any "
+             "scale-out slot)"
+    )
+    p.add_argument(
+        "--autoscale_cooldown_s", type=float, default=2.0,
+        help="autoscale: per-direction cooldown between actions; the "
+             "flap suppressor additionally vetoes any scale-in within "
+             "3 cooldowns of a scale-out"
+    )
+    p.add_argument(
+        "--autoscale_interval_s", type=float, default=0.5,
+        help="autoscale: controller tick cadence (seconds)"
+    )
+    p.add_argument(
         "--metrics_interval_s", type=float, default=0.0,
         help="serving: live metrics plane (obs/metrics.py, docs/"
              "observability.md 'Live metrics') — publish a registry "
@@ -452,6 +492,12 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "serve.prewarm_manifest": args.serve_prewarm,
             "serve.rollout_steps": args.serve_rollout_steps,
             "serve.session_snapshot_every": args.session_snapshot_every,
+            "serve.session_dir": args.session_dir,
+            "serve.autoscale": args.autoscale,
+            "serve.autoscale_min": args.autoscale_min,
+            "serve.autoscale_max": args.autoscale_max,
+            "serve.autoscale_cooldown_s": args.autoscale_cooldown_s,
+            "serve.autoscale_interval_s": args.autoscale_interval_s,
             "serve.metrics_interval_s": args.metrics_interval_s,
             "serve.slo_p99_ms": args.slo_p99_ms,
             "serve.slo_shed_frac": args.slo_shed_frac,
@@ -913,13 +959,14 @@ def _run_serve(
         else:
             print("note: no restorable checkpoint — serving fresh weights")
     sc = cfg.serve
-    if sc.replicas > 1 and trainer.mesh is not None:
+    replicated = sc.replicas > 1 or sc.autoscale
+    if replicated and trainer.mesh is not None:
         raise ValueError(
-            "--serve_replicas builds its own per-replica mesh slices; "
-            "drop --distributed (the trainer mesh) when serving "
-            "replicated"
+            "--serve_replicas/--autoscale build their own per-replica "
+            "mesh slices; drop --distributed (the trainer mesh) when "
+            "serving replicated"
         )
-    if sc.replicas > 1 and (
+    if replicated and (
         trainer.model.config.scan_layers or cfg.optim.flat_params
     ):
         # build_replicas' forward is the standard-layout apply_batch;
@@ -946,7 +993,10 @@ def _run_serve(
             chunk=sc.pack_chunk,
             batch_size=sc.max_batch,
             per_devices=(
-                len(_jax.devices()) // sc.replicas if sc.replicas > 1 else 1
+                len(_jax.devices())
+                // (sc.autoscale_max if sc.autoscale else sc.replicas)
+                if replicated
+                else 1
             ),
         )
     reload_fn = (
@@ -955,7 +1005,46 @@ def _run_serve(
         else None
     )
     replicas = None
-    if sc.replicas > 1:
+    autoscale_slots = None
+    if sc.autoscale:
+        # Elastic pool: device slots partition the device set
+        # autoscale_max-wide (NOT founding-pool-wide), so every future
+        # scale-out replica has a slice waiting — and an AOT manifest
+        # compiled for the max topology hydrates any slot.
+        from gnot_tpu.serve import build_replica
+
+        devices = list(jax.devices())
+        if sc.autoscale_max > len(devices):
+            raise ValueError(
+                f"--autoscale_max {sc.autoscale_max} needs at least one "
+                f"device per replica; only {len(devices)} visible (CPU: "
+                "raise --xla_force_host_platform_device_count)"
+            )
+        per = len(devices) // sc.autoscale_max
+        autoscale_slots = [
+            devices[i * per : (i + 1) * per]
+            for i in range(sc.autoscale_max)
+        ]
+        tl = trainer.train_loader
+
+        # ONE construction path for founding and scale-out replicas (a
+        # kwarg added here reaches both, or they silently diverge); the
+        # AutoscaleController gets this same factory.
+        def autoscale_factory(rid, slot):
+            return build_replica(
+                trainer.model,
+                trainer.state.params,
+                rid,
+                autoscale_slots[slot],
+                batch_size=sc.max_batch,
+                bucket=cfg.data.bucket,
+                pad_nodes=tl.pad_nodes,
+                pad_funcs=tl.pad_funcs,
+                dtype=sc.dtype,
+            )
+
+        replicas = [autoscale_factory(i, i) for i in range(sc.replicas)]
+    elif sc.replicas > 1:
         tl = trainer.train_loader
         replicas = build_replicas(
             trainer.model,
@@ -1001,7 +1090,13 @@ def _run_serve(
         from gnot_tpu.serve import aot
 
         prewarm = aot.load_manifest(sc.prewarm_manifest)
-        expect = sc.replicas if sc.replicas > 1 else 1
+        if sc.autoscale:
+            # An elastic pool hydrates from the MAX-topology manifest:
+            # founding replicas take their slots' blocks now, and every
+            # scale-out slot has a block waiting (prewarm-before-join).
+            expect = sc.autoscale_max
+        else:
+            expect = sc.replicas if sc.replicas > 1 else 1
         if prewarm["replicas"] != expect:
             raise ValueError(
                 f"--serve_prewarm manifest was compiled for "
@@ -1048,6 +1143,11 @@ def _run_serve(
                 metrics_lib.default_objectives(sc)
             ),
         )
+    session_store = None
+    if sc.session_dir:
+        from gnot_tpu.serve import SessionStore
+
+        session_store = SessionStore(sc.session_dir)
     with PreemptionHandler() as preempt:
         common = dict(
             max_batch=sc.max_batch,
@@ -1064,6 +1164,7 @@ def _run_serve(
             tracer=tracer,
             session_snapshot_every=sc.session_snapshot_every,
             metrics=registry,
+            session_store=session_store,
         )
         if replicas is not None:
             server = ReplicaRouter(
@@ -1137,20 +1238,71 @@ def _run_serve(
         server.start()
         if publisher is not None:
             publisher.start()
+        # Self-healing elastic pool (serve/autoscaler.py): the
+        # controller subscribes to the registry/evaluator the publisher
+        # polls and scales the founding pool between the configured
+        # bounds while the storm runs.
+        controller = None
+        if sc.autoscale:
+            from gnot_tpu.serve import AutoscaleController
+
+            controller = AutoscaleController(
+                server,
+                replica_factory=autoscale_factory,
+                min_replicas=sc.autoscale_min,
+                max_replicas=sc.autoscale_max,
+                interval_s=sc.autoscale_interval_s,
+                cooldown_s=sc.autoscale_cooldown_s,
+                up_load=sc.autoscale_up_load,
+                down_load=sc.autoscale_down_load,
+                down_ticks=sc.autoscale_down_ticks,
+                heal_after_s=sc.autoscale_heal_after_s,
+                drain_timeout_s=sc.drain_timeout_s,
+                registry=registry,
+                evaluator=(
+                    publisher.evaluator if publisher is not None else None
+                ),
+                warm_samples=samples,
+                pack_plan=pack_plan,
+                prewarm_manifest=prewarm,
+                sink=sink,
+            ).start()
         rollout_k = sc.rollout_steps
         try:
             summary, futures = _serve_storm(
-                args, sc, server, samples, checkpointer, preempt
+                args, sc, server, samples, checkpointer, preempt,
+                controller=controller,
             )
         finally:
-            # The publisher thread must stop BEFORE the sink can close
-            # (the enclosing ExitStack) on any exit path — a wedged
-            # storm or mid-stream crash must not leave a daemon thread
-            # ticking into a closed file. close() is idempotent: the
-            # success path below re-calls it for the final row without
-            # publishing twice.
+            # The controller and publisher threads must stop BEFORE the
+            # sink can close (the enclosing ExitStack) on any exit path
+            # — a wedged storm or mid-stream crash must not leave a
+            # daemon thread ticking into a closed file. close() is
+            # idempotent: the success path below re-calls it for the
+            # final row without publishing twice.
+            if controller is not None:
+                controller.close()
             if publisher is not None:
                 publisher.close()
+        if controller is not None:
+            # Already closed (storm success path closes it before the
+            # drain; the finally covers error paths) — just read.
+            ast_stats = controller.stats()
+            if manifest_extra is not None:
+                manifest_extra["autoscale"] = {
+                    **ast_stats,
+                    "replica_seconds": round(
+                        controller.replica_seconds(), 3
+                    ),
+                }
+            print(
+                f"Autoscale: pool [{sc.autoscale_min}, "
+                f"{sc.autoscale_max}], {ast_stats['scale_ups']} up / "
+                f"{ast_stats['scale_downs']} down / "
+                f"{ast_stats['replaces']} replaced over "
+                f"{ast_stats['ticks']} ticks; "
+                f"{controller.replica_seconds():.1f} replica-seconds"
+            )
         if publisher is not None:
             # The FINAL snapshot was taken AFTER the drain, so it reads
             # the settled end-state counters — the drain-time
@@ -1206,12 +1358,16 @@ def _run_serve(
     return summary["completed"] / max(1, summary["requests"])
 
 
-def _serve_storm(args, sc, server, samples, checkpointer, preempt):
+def _serve_storm(
+    args, sc, server, samples, checkpointer, preempt, controller=None
+):
     """Drive the in-process demo storm through a started server and
     drain it: returns ``(summary, futures)``. Factored out of
     ``_run_serve`` so the metrics publisher can wrap the WHOLE storm in
     one try/finally — any exit path stops the publisher thread before
-    the sink closes."""
+    the sink closes. The autoscale ``controller`` (when elastic) is
+    closed BETWEEN the last resolved future and the pool drain, so a
+    scale action can never race the final rollup."""
     futures = []
     rollout_k = sc.rollout_steps
     for i, s in enumerate(samples):
@@ -1237,6 +1393,8 @@ def _serve_storm(args, sc, server, samples, checkpointer, preempt):
     session_timeout = sc.drain_timeout_s * max(1, rollout_k)
     for f in futures:
         f.result(timeout=session_timeout)
+    if controller is not None:
+        controller.close()
     return server.drain(sc.drain_timeout_s), futures
 
 

@@ -55,6 +55,11 @@ SESSION_SNAPSHOT = "session_snapshot"
 SESSION_MIGRATE = "session_migrate"
 METRICS_SNAPSHOT = "metrics_snapshot"
 SLO_ALERT = "slo_alert"
+AUTOSCALE_DECISION = "autoscale_decision"
+SCALE_UP = "scale_up"
+SCALE_DOWN = "scale_down"
+REPLICA_REMOVE = "replica_remove"
+REPLICA_REPLACE = "replica_replace"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -267,8 +272,9 @@ EVENTS: dict[str, EventSpec] = {
         doc="a rollout session's carry was snapshotted host-side (the "
         "rolling last-good state a migration replays from; cadence "
         "`serve.session_snapshot_every`, plus a final persist at "
-        "drain)",
-        optional=("replica",),
+        "drain; `persisted` marks a snapshot written to the on-disk "
+        "session store — the state `resume_rollout` restarts from)",
+        optional=("replica", "persisted"),
     ),
     "session_migrate": EventSpec(
         fields=(
@@ -303,6 +309,57 @@ EVENTS: dict[str, EventSpec] = {
         "'clear' (the fast window recovered) — never level-triggered "
         "spam; `value` carries the observed quantity",
         optional=("value", "fast_window_s", "slow_window_s"),
+    ),
+    "autoscale_decision": EventSpec(
+        fields=("action", "reason", "pool", "min", "max"),
+        module="gnot_tpu/serve/autoscaler.py",
+        doc="the autoscaling controller acted (or was vetoed by a "
+        "stability guard): `action` is 'scale_up' | 'scale_down' | "
+        "'replace' | 'hold'; a 'hold' names the guard that vetoed a "
+        "wanted move (cooldown_up | cooldown_down | cooldown_heal | "
+        "at_max | flap_suppressed | last_replica) and is emitted on "
+        "EDGES only (a steady veto stays silent); `load` is the "
+        "per-replica in-system load the decision read, `alerts` the "
+        "active SLO objectives",
+        optional=("replica", "load", "alerts", "detail"),
+    ),
+    "scale_up": EventSpec(
+        fields=("replica", "pool", "reason", "warm_source", "seconds"),
+        module="gnot_tpu/serve/autoscaler.py",
+        doc="the controller grew the pool: a new replica was built, "
+        "warmed BEFORE joining (`warm_source` 'snapshot' = hydrated "
+        "from the AOT manifest, 'compile' = cold warmup), and admitted "
+        "to routing; `seconds` is build+warm+join, `reason` names the "
+        "pressure signal (load | slo:<objective>)",
+        optional=("load",),
+    ),
+    "scale_down": EventSpec(
+        fields=("replica", "pool", "reason"),
+        module="gnot_tpu/serve/autoscaler.py",
+        doc="the controller shrank the pool: the named replica was "
+        "retired via drain-then-remove (placement stopped, resident "
+        "rollout sessions migrated to siblings, queued work flushed) "
+        "after the calm held for the configured consecutive ticks",
+        optional=("load", "sessions_migrated"),
+    ),
+    "replica_remove": EventSpec(
+        fields=("replica", "reason", "requests", "completed"),
+        module="gnot_tpu/serve/router.py",
+        doc="one replica left the pool (scale-in or self-healing "
+        "replacement): drain-then-remove finished — new placement "
+        "stopped ('retiring' health state), resident sessions handed "
+        "to siblings at a step boundary, its queue flushed, and its "
+        "latency histograms retained in the pool rollup so the final "
+        "serve_summary percentiles keep the retired replica's history",
+        optional=("pool", "sessions_migrated", "drain_timeout_s"),
+    ),
+    "replica_replace": EventSpec(
+        fields=("from_replica", "to_replica", "reason"),
+        module="gnot_tpu/serve/autoscaler.py",
+        doc="self-healing: a dead/wedged/breaker-stuck replica was "
+        "removed and a fresh replacement built+warmed onto its device "
+        "slot (`reason` is the health verdict that condemned it)",
+        optional=("pool", "seconds"),
     ),
     "trace_flush": EventSpec(
         fields=("path", "spans", "dropped"),

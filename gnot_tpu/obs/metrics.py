@@ -394,6 +394,27 @@ class MetricsRegistry:
             out[key] = row
         return out
 
+    def unregister_gauges(self, **labels) -> int:
+        """Drop every GAUGE series whose labels include ``labels`` —
+        the membership-change hook: a removed replica's callback gauges
+        (depth/breaker/sessions/wedge) otherwise pin its server and
+        engine (device weights included) alive forever. Counters and
+        histograms are deliberately kept: they are plain accumulated
+        data, and the pool's cumulative rollups must keep the retired
+        replica's history. Returns the number of series dropped."""
+        with self._lock:
+            doomed = [
+                key
+                for key, (kind, _, lbls, _) in self._series.items()
+                if kind == "gauge"
+                and all(
+                    str(lbls.get(k)) == str(v) for k, v in labels.items()
+                )
+            ]
+            for key in doomed:
+                del self._series[key]
+        return len(doomed)
+
     def aggregate_histogram(self, name: str) -> LogHistogram:
         """Lossless merge of EVERY series named ``name`` across all
         label sets — the pool view (per-replica, per-bucket series sum

@@ -45,12 +45,29 @@ the single-server tier:
   manifest (``prewarm_from``) so scale-out/reload never pays an XLA
   compile.
 
+Elastic capacity (docs/serving.md "Elastic capacity") closes the loop
+from the live metrics plane to pool membership:
+
+* ``autoscaler`` — ``AutoscaleController``: subscribes to the metrics
+  registry / SLO evaluator and scales the pool against live pressure
+  — prewarm-before-join on scale-out, drain-then-remove on scale-in
+  (``ReplicaRouter.remove_replica``: placement stops, resident
+  sessions migrate to siblings, the retired replica's latency history
+  stays in the pool rollup), and self-healing replacement of
+  dead/wedged/breaker-stuck replicas — under first-class stability
+  guards (min/max bounds, per-direction cooldowns, hysteresis, flap
+  suppression).
+* ``rollout.SessionStore`` — on-disk final-carry persistence: a
+  drained session resumes across server restarts
+  (``resume_rollout``) from its last snapshotted step.
+
 Chaos-tested on CPU via the serve-side fault kinds in
 ``resilience.faults`` (``slow_request@N``, ``nan_output@N``,
-``reload_corrupt@N``) — tests/test_serve.py.
+``reload_corrupt@N``) — tests/test_serve.py, tests/test_autoscale.py.
 """
 
 from gnot_tpu.serve import aot  # noqa: F401
+from gnot_tpu.serve.autoscaler import AutoscaleController  # noqa: F401
 from gnot_tpu.serve import rollout  # noqa: F401
 from gnot_tpu.serve.batcher import Batcher  # noqa: F401
 from gnot_tpu.serve.engine import InferenceEngine  # noqa: F401
@@ -70,6 +87,7 @@ from gnot_tpu.serve.rollout import (  # noqa: F401
     RolloutFuture,
     RolloutResult,
     RolloutSession,
+    SessionStore,
     advance_sample,
     offline_rollout,
 )

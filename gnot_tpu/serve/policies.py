@@ -88,7 +88,8 @@ class HealthVerdict:
     rather than shed — the reason names the signal that drained it."""
 
     healthy: bool
-    reason: str  # "ok" | "warming" | "breaker_open" | "wedged" | "dead"
+    # "ok" | "warming" | "breaker_open" | "wedged" | "dead" | "retiring"
+    reason: str
 
 
 class ReplicaHealthPolicy:
@@ -109,6 +110,11 @@ class ReplicaHealthPolicy:
       old weights keep serving what it already holds, but new traffic
       goes to siblings until the swap publishes.
     * ``dead`` — the worker thread exited (crash): never route to it.
+    * ``retiring`` — a scale-in (``ReplicaRouter.remove_replica``) is
+      draining this replica out of the pool: it keeps serving what it
+      already holds (and its resident sessions until they hand over),
+      but new placement goes to siblings — drain-then-remove, never
+      remove-then-shed.
 
     Stateless and deterministic given the inputs — the router samples
     the signals and emits ``replica_health`` events on transitions.
@@ -130,9 +136,12 @@ class ReplicaHealthPolicy:
         depth: int,
         worker_alive: bool = True,
         breaker_trial_due: bool = False,
+        retiring: bool = False,
     ) -> HealthVerdict:
         if not worker_alive:
             return HealthVerdict(False, "dead")
+        if retiring:
+            return HealthVerdict(False, "retiring")
         if warming:
             return HealthVerdict(False, "warming")
         if breaker_state == "open" and not breaker_trial_due:

@@ -58,6 +58,11 @@ class EngineReplica:
         # Rolling-reload drain flag: True while THIS replica's weights
         # are swapping (at most one replica warms at a time).
         self._warming = False  #: guarded_by _lock
+        # Scale-in drain flag (router.remove_replica): True from the
+        # moment the removal starts — the health policy reads the
+        # replica "retiring" (no new placement) while it finishes what
+        # it holds and hands its sessions to siblings.
+        self._retiring = False  #: guarded_by _lock
         # How this replica became serve-ready — written once by
         # warm()/prewarm_from() before the replica takes traffic, read
         # by the router's serve_summary rollup and replica_warm event:
@@ -207,6 +212,15 @@ class EngineReplica:
     def set_warming(self, value: bool) -> None:
         with self._lock:
             self._warming = value
+
+    @property
+    def retiring(self) -> bool:
+        with self._lock:
+            return self._retiring
+
+    def set_retiring(self, value: bool) -> None:
+        with self._lock:
+            self._retiring = value
 
 
 def build_replicas(

@@ -44,7 +44,11 @@ enforces the annotations).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import json
+import os
 import queue
+import re
 import threading
 from concurrent.futures import Future
 from typing import Callable, Iterator, Sequence
@@ -209,6 +213,12 @@ class RolloutSession:
         self.advance = advance
         self.dt = dt
         self.future = RolloutFuture()
+        #: True for client-NAMED sessions (``submit_rollout(name=)``):
+        #: only those persist to a ``SessionStore`` on drain — an
+        #: auto-generated sid restarts from 1 in every process, so
+        #: persisting it would let run 2's "r00001" overwrite (or
+        #: delete) run 1's resumable snapshot.
+        self.named = False
         #: Migration handler installed by the router
         #: (``fn(session, reason, detail, from_replica)``); None on a
         #: standalone server — step failures then resolve the future.
@@ -292,6 +302,59 @@ class RolloutSession:
             }
             return self._cursor
 
+    def snapshot_state(self) -> dict:
+        """JSON/array-ready copy of the last SNAPSHOT (not the live
+        cursor) — what the ``SessionStore`` persists: a restart resumes
+        from exactly the state a migration would have replayed from."""
+        with self._lock:
+            snap = self._snapshot
+            return {
+                "sid": self.sid,
+                "steps": self.steps,
+                "cursor": snap["cursor"],
+                "sample": snap["sample"],
+                "outputs": list(snap["outputs"]),
+                "dt": self.dt,
+            }
+
+    @classmethod
+    def from_state(
+        cls,
+        state: dict,
+        *,
+        snapshot_every: int = 1,
+        step_deadline_ms: float | None = None,
+        rollout_deadline: float | None = None,
+        on_step: Callable | None = None,
+        advance: Callable = advance_sample,
+    ) -> "RolloutSession":
+        """Rebuild a session from a persisted ``snapshot_state`` — the
+        client-visible resume across server restarts. The restored
+        prefix counts as already streamed (``publish_step`` will not
+        re-deliver it); the next step to run is ``cursor + 1``."""
+        s = cls(
+            state["sid"],
+            state["sample"],
+            state["steps"],
+            snapshot_every=snapshot_every,
+            step_deadline_ms=step_deadline_ms,
+            rollout_deadline=rollout_deadline,
+            on_step=on_step,
+            advance=advance,
+            dt=state.get("dt", ROLLOUT_DT),
+        )
+        s.named = True  # only named sessions are ever persisted
+        with s._lock:
+            s._cursor = int(state["cursor"])
+            s._outputs = list(state["outputs"])
+            s._snapshot = {
+                "cursor": s._cursor,
+                "sample": state["sample"],
+                "outputs": list(state["outputs"]),
+            }
+            s._streamed = s._cursor
+        return s
+
     # -- migration (router threads) ----------------------------------------
 
     def restore_from_snapshot(self) -> int:
@@ -339,6 +402,117 @@ class RolloutSession:
         self.future.set_result(result)
         self.future._close_stream()
         return True
+
+
+class SessionStore:
+    """On-disk persistence for rollout-session carry snapshots — the
+    PR 13 stretch made client-visible: a drain (SIGTERM, restart,
+    scale-in of the whole deployment) persists every open session's
+    FINAL snapshot here, and a restarted server/router resumes a named
+    session from its last snapshotted step (``resume_rollout``).
+
+    One ``.npz`` per session: the carry sample's arrays, the committed
+    output prefix, and a JSON meta record (sid, steps, cursor, dt).
+    Writes are atomic (tmp + rename), so a crash mid-persist leaves the
+    previous snapshot intact rather than a torn file. Thread-safe at
+    the filesystem level (one writer per session — the draining owner).
+    """
+
+    def __init__(self, directory: str):
+        if not directory:
+            raise ValueError("SessionStore needs a directory")
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, name: str) -> str:
+        # Sanitized stem + a short digest of the RAW name: two distinct
+        # sids that sanitize identically ("run:1" vs "run_1") must not
+        # share a file — a save would silently overwrite the other
+        # client's resumable snapshot.
+        safe = re.sub(r"[^A-Za-z0-9._-]", "_", name)
+        digest = hashlib.sha1(name.encode()).hexdigest()[:8]
+        return os.path.join(
+            self.directory, f"{safe}-{digest}.session.npz"
+        )
+
+    def names(self) -> list[str]:
+        """Persisted session names — the true sids from each file's
+        meta record (filenames are sanitized + digest-suffixed, so the
+        meta is the authority; unreadable files are skipped)."""
+        out = []
+        for fn in sorted(os.listdir(self.directory)):
+            if not fn.endswith(".session.npz"):
+                continue
+            try:
+                with np.load(
+                    os.path.join(self.directory, fn), allow_pickle=False
+                ) as z:
+                    out.append(json.loads(str(z["meta"]))["sid"])
+            except (OSError, KeyError, ValueError):
+                continue
+        return out
+
+    def save(self, session: "RolloutSession") -> str:
+        """Persist the session's last snapshot. Returns the path."""
+        state = session.snapshot_state()
+        sample: MeshSample = state["sample"]
+        arrays = {
+            "coords": np.asarray(sample.coords),
+            "y": np.asarray(sample.y),
+            "theta": np.asarray(sample.theta),
+        }
+        for i, f in enumerate(sample.funcs):
+            arrays[f"func_{i}"] = np.asarray(f)
+        for i, o in enumerate(state["outputs"]):
+            arrays[f"out_{i}"] = np.asarray(o)
+        meta = {
+            "sid": state["sid"],
+            "steps": state["steps"],
+            "cursor": state["cursor"],
+            "dt": state["dt"],
+            "n_funcs": len(sample.funcs),
+            "n_outputs": len(state["outputs"]),
+        }
+        path = self._path(state["sid"])
+        tmp = f"{path}.tmp"
+        with open(tmp, "wb") as fh:
+            np.savez(fh, meta=json.dumps(meta), **arrays)
+        os.replace(tmp, path)
+        return path
+
+    def load(self, name: str) -> dict | None:
+        """The persisted ``snapshot_state`` for ``name`` (None when no
+        snapshot exists) — feed to ``RolloutSession.from_state``."""
+        path = self._path(name)
+        if not os.path.exists(path):
+            return None
+        with np.load(path, allow_pickle=False) as z:
+            meta = json.loads(str(z["meta"]))
+            sample = MeshSample(
+                coords=z["coords"],
+                y=z["y"],
+                theta=z["theta"],
+                funcs=tuple(
+                    z[f"func_{i}"] for i in range(meta["n_funcs"])
+                ),
+            )
+            outputs = [z[f"out_{i}"] for i in range(meta["n_outputs"])]
+        return {
+            "sid": meta["sid"],
+            "steps": meta["steps"],
+            "cursor": meta["cursor"],
+            "dt": meta["dt"],
+            "sample": sample,
+            "outputs": outputs,
+        }
+
+    def delete(self, name: str) -> None:
+        """Drop a persisted snapshot (a resumed-and-completed session's
+        snapshot is stale — the resume path cleans up after itself)."""
+        try:
+            os.remove(self._path(name))
+        except FileNotFoundError:
+            pass
 
 
 def parity_check(

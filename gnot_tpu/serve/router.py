@@ -48,6 +48,18 @@ engine per device or mesh slice) and decides placement per request:
    off or the budget spent the future resolves with the failure,
    counted ``lost`` in the sessions rollup.
 
+5. **Elastic membership** (``add_replica`` / ``remove_replica``): the
+   pool grows live (a warmed replica joins routing at the next
+   placement) and shrinks via DRAIN-then-remove — the leaving replica
+   goes ``retiring`` (no new placement), hands its resident rollout
+   sessions to siblings at a step boundary (``session_migrate`` with
+   reason ``scale_in``; zero replay, no failure-budget spend), flushes
+   its queue, and retires with its latency history RETAINED in the
+   pool rollup (a membership change never drops served requests from
+   the final percentiles). ``serve/autoscaler.py`` drives both ends
+   from live SLO pressure. Persisted rollout sessions
+   (``session_store``) resume across restarts via ``resume_rollout``.
+
 Every placement is observable: one ``route`` event per submitted
 request (replica, bucket, policy, decision reason, target depth), and
 ``drain()`` emits a pool-level ``serve_summary`` whose ``per_replica``
@@ -119,6 +131,7 @@ class ReplicaRouter:
         session_migration: bool = True,
         max_session_migrations: int = 3,
         metrics=None,
+        session_store=None,
     ):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
@@ -165,7 +178,12 @@ class ReplicaRouter:
             pack_plan=pack_plan,
             session_snapshot_every=session_snapshot_every,
             metrics=metrics,
+            session_store=session_store,
         )
+        # On-disk rollout-session persistence (rollout.SessionStore):
+        # each per-replica server persists drained sessions' final
+        # snapshots; the router resumes them (resume_rollout).
+        self._session_store = session_store
         # Live metrics plane (obs/metrics.py): the ONE registry every
         # per-replica server records into (replica-labeled series merge
         # losslessly into the pool view the publisher snapshots), plus
@@ -234,6 +252,15 @@ class ReplicaRouter:
         # progress"; one replica warms at a time by construction.
         self._reload_lock = threading.Lock()
         self._drained = threading.Event()
+        # Retired-replica history (remove_replica): the pool rollup
+        # must keep every replica that EVER served — percentiles merge
+        # the retired histograms, counters include the retired
+        # summaries — or a scale-in would silently drop its requests
+        # from the final serve_summary (the membership-change history
+        # bug this ledger fixes).
+        self._retired: dict[int, dict] = {}  #: guarded_by _lock
+        self._retired_hist = LogHistogram()
+        self._retired_step_hist = LogHistogram()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -247,6 +274,18 @@ class ReplicaRouter:
         while submit/reload/drain threads iterate."""
         with self._lock:
             return list(self.replicas)
+
+    def pool(self) -> list[EngineReplica]:
+        """Public snapshot of the live pool (the autoscale controller's
+        read of current membership)."""
+        return self._pool()
+
+    def assess(self, replica: EngineReplica):
+        """Public health verdict for one pooled replica (emits the
+        ``replica_health`` edge exactly like a placement would) — the
+        autoscale controller's self-healing scan reads this instead of
+        re-deriving health from raw signals."""
+        return self._assess(replica, self._clock())
 
     def prewarm_from(self, manifest: dict) -> dict:
         """Hydrate EVERY pool replica from the deploy manifest's
@@ -276,13 +315,20 @@ class ReplicaRouter:
         t0 = self._clock()
         # Duplicate guard FIRST: attaching/starting before it would
         # clobber the pooled replica's live server (stranding its
-        # queued futures) and leak a running worker thread.
+        # queued futures) and leak a running worker thread. Retired ids
+        # are reserved too — re-using one would collide with its
+        # retained history in the pool rollup.
         with self._lock:
             if any(
                 r.replica_id == replica.replica_id for r in self.replicas
             ):
                 raise ValueError(
                     f"replica {replica.replica_id} is already in the pool"
+                )
+            if replica.replica_id in self._retired:
+                raise ValueError(
+                    f"replica id {replica.replica_id} was retired from "
+                    "this pool; scale-out replicas need fresh ids"
                 )
         replica.attach_server(
             InferenceServer(
@@ -350,6 +396,142 @@ class ReplicaRouter:
                         "programs": stats["programs"],
                     },
                 )
+
+    def remove_replica(
+        self,
+        replica_id: int,
+        *,
+        timeout_s: float = 30.0,
+        reason: str = "scale_in",
+    ) -> dict:
+        """Scale-in / self-healing removal: DRAIN-then-remove, never
+        remove-then-shed.
+
+        1. The replica goes ``retiring`` (a ``replica_health`` edge):
+           new placement flows to siblings while it keeps serving what
+           it already holds.
+        2. Resident rollout sessions hand over to siblings at their
+           next step boundary (``session_migrate`` events, reason
+           ``scale_in``; the owner snapshots at the current cursor
+           first, so the handover replays nothing). A dead replica's
+           sessions already migrated through the failure path.
+        3. Its server drains: queued work completes (deadline shedding
+           still applies — drain never invents a new failure mode) and
+           the per-replica ``serve_summary`` is emitted.
+        4. The replica leaves the pool, but its history does not: its
+           latency/step histograms and summary counters are retained
+           and merged into the final pool rollup (``drain``), so the
+           pool percentiles keep every request the retired replica
+           ever served.
+
+        Returns the retired replica's serve summary. Refuses to remove
+        the last replica (the pool must keep serving). The handover
+        wait is bounded by wall time, not the injected clock — a fake
+        clock must not spin it forever."""
+        with self._lock:
+            target = next(
+                (r for r in self.replicas if r.replica_id == replica_id),
+                None,
+            )
+            if target is None:
+                raise ValueError(f"replica {replica_id} is not in the pool")
+            if len(self.replicas) == 1:
+                raise ValueError(
+                    "cannot remove the last replica; the pool must "
+                    "keep serving (scale out first)"
+                )
+        target.set_retiring(True)
+        # The retiring edge lands in the event stream NOW, not at the
+        # next unrelated placement.
+        self._assess(target, self._clock())
+        srv = target.server
+        deadline = time.monotonic() + timeout_s
+        if srv.worker_alive():
+            srv.begin_eviction(self._evict_session)
+            while (
+                srv.resident_sessions()
+                and srv.worker_alive()
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.002)
+        summary = srv.drain(max(0.0, deadline - time.monotonic()))
+        with self._lock:
+            self._retired[replica_id] = {
+                "summary": summary,
+                "warm_stats": target.warm_stats,
+            }
+            # Histograms merge UNDER the same lock as the ledger
+            # insertion: a concurrent drain() snapshots _retired and
+            # excludes ledgered replicas from its live merge, so the
+            # ledger entry and its histograms must appear atomically —
+            # or the racing drain drops this replica's latencies from
+            # the pool percentiles. (Histogram locks are leaves; no
+            # ordering hazard.)
+            self._retired_hist.merge(srv.latency_histogram())
+            self._retired_step_hist.merge(srv.step_latency_histogram())
+            self.replicas = [
+                r for r in self.replicas if r.replica_id != replica_id
+            ]
+            self._health_seen.pop(replica_id, None)
+            pool_n = len(self.replicas)
+        self._wedge_gauges.pop(replica_id, None)
+        if self._metrics is not None:
+            # Drop the replica's CALLBACK gauges (depth/breaker/
+            # sessions/wedge): their closures would otherwise pin the
+            # drained server — and its engine's device-resident weights
+            # — alive forever under autoscale churn. Counters and
+            # histograms stay: the live plane's cumulative pool rollup
+            # must keep the retired replica's history, exactly like the
+            # drain-time summary does.
+            self._metrics.unregister_gauges(replica=replica_id)
+        self._event(
+            events.REPLICA_REMOVE,
+            replica=replica_id,
+            reason=reason,
+            requests=summary.get("requests", 0),
+            completed=summary.get("completed", 0),
+            pool=pool_n,
+            drain_timeout_s=timeout_s,
+        )
+        return summary
+
+    def _evict_session(self, session, from_replica: int | None) -> bool:
+        """Re-place one resident session from a retiring replica onto a
+        sibling (called by the retiring owner's worker at a step
+        boundary; the owner snapshotted at the current cursor, so
+        nothing replays). Returns False when no sibling can take it —
+        the owner keeps it and the removal's drain resolves it
+        honestly. Planned handovers do not consume the session's
+        failure-migration budget."""
+        now = self._clock()
+        candidates = [
+            r
+            for r in self._pool()
+            if r.replica_id != from_replica and not r.retiring
+        ]
+        healthy = [r for r in candidates if self._assess(r, now).healthy]
+        pool = healthy or [
+            r for r in candidates if r.server.worker_alive()
+        ]
+        if not pool:
+            return False
+        with self._lock:
+            target = min(pool, key=self._load)
+            self._sessions_migrated += 1
+        if self._metrics is not None:
+            self._metrics.counter("router_migrations_total").inc()
+        at_step = session.cursor
+        self._event(
+            events.SESSION_MIGRATE,
+            session=session.sid,
+            from_replica=from_replica,
+            to_replica=target.replica_id,
+            at_step=at_step,
+            replay_from=at_step,
+            reason="scale_in",
+        )
+        target.server.submit_rollout(session=session)
+        return True
 
     # -- placement ---------------------------------------------------------
 
@@ -482,6 +664,9 @@ class ReplicaRouter:
             # dispatches, and allow() — the only open->half_open
             # transition — runs only at dispatch).
             breaker_trial_due=r.server.breaker.trial_due(),
+            # Mid-removal (remove_replica): drained for NEW placement
+            # while it finishes what it holds.
+            retiring=r.retiring,
         )
         if self._metrics is not None:
             # The SLO evaluator's `wedged` objective reads this level:
@@ -520,6 +705,7 @@ class ReplicaRouter:
         deadline_ms: float | None = None,
         rollout_deadline_ms: float | None = None,
         on_step=None,
+        name: str | None = None,
     ) -> RolloutFuture:
         """Place one autoregressive rollout session. The FIRST step
         routes like any request (health gate + affinity/policy — one
@@ -539,9 +725,18 @@ class ReplicaRouter:
             if deadline_ms is not None
             else sc["default_deadline_ms"]
         )
+        if name is not None and any(
+            r.server.has_session(name) for r in self._pool()
+        ):
+            # Two live sessions under one sid would shadow each other
+            # in a residence table and fight over one store snapshot.
+            raise ValueError(
+                f"a session named {name!r} is already resident in the "
+                "pool"
+            )
         with self._lock:
             self._sessions_started += 1
-            sid = f"r{self._sessions_started:05d}"
+            sid = name or f"r{self._sessions_started:05d}"
         session = RolloutSession(
             sid,
             sample,
@@ -555,7 +750,69 @@ class ReplicaRouter:
             ),
             on_step=on_step,
         )
+        session.named = name is not None
         session.migrate_cb = self._session_failed
+        self._place_session(session, sample)
+        return session.future
+
+    def resume_rollout(
+        self,
+        name: str,
+        *,
+        deadline_ms: float | None = None,
+        rollout_deadline_ms: float | None = None,
+        on_step=None,
+    ) -> RolloutFuture:
+        """Client-visible resume across restarts: load the named
+        session's persisted final carry snapshot (written by the
+        previous deployment's drain), rebuild it at its last
+        snapshotted step, and place it like a fresh rollout — the
+        remaining steps run on this pool, the restored prefix is in the
+        result but not re-streamed. Raises ``KeyError`` when nothing is
+        persisted under ``name``; a session already complete at its
+        snapshot resolves immediately."""
+        if self._session_store is None:
+            raise RuntimeError("no session store configured")
+        if any(r.server.has_session(name) for r in self._pool()):
+            # A retry racing a live resume would run the trajectory
+            # twice under one sid (same guard as submit_rollout).
+            raise ValueError(
+                f"a session named {name!r} is already resident in the "
+                "pool"
+            )
+        state = self._session_store.load(name)
+        if state is None:
+            raise KeyError(f"no persisted session {name!r}")
+        sc = self._server_kwargs
+        ms = (
+            deadline_ms
+            if deadline_ms is not None
+            else sc["default_deadline_ms"]
+        )
+        session = RolloutSession.from_state(
+            state,
+            snapshot_every=sc["session_snapshot_every"],
+            step_deadline_ms=ms or None,
+            rollout_deadline=(
+                self._clock() + rollout_deadline_ms / 1e3
+                if rollout_deadline_ms
+                else None
+            ),
+            on_step=on_step,
+        )
+        if session.finished:
+            session.resolve(True, "ok")
+            return session.future
+        with self._lock:
+            self._sessions_started += 1
+        session.migrate_cb = self._session_failed
+        self._place_session(session, session.sample)
+        return session.future
+
+    def _place_session(self, session: RolloutSession, sample) -> None:
+        """First-step placement shared by submit_rollout and
+        resume_rollout: health + affinity pick the owner, one ``route``
+        event tagged with the session id, residence taken there."""
         key, label = self._bucket_of(sample)
         replica, reason = self._place(key)
         with self._lock:
@@ -573,10 +830,9 @@ class ReplicaRouter:
             reason=reason,
             depth=replica.server.depth(),
             dtype=self._dtype,
-            session=sid,
+            session=session.sid,
         )
         replica.server.submit_rollout(session=session)
-        return session.future
 
     def _session_failed(
         self, session: RolloutSession, reason: str, detail: str,
@@ -614,8 +870,17 @@ class ReplicaRouter:
             # dead sibling would swallow the re-placed step into a
             # queue nobody drains and the session future would hang —
             # resolving as lost is the honest answer when the pool is
-            # out of alive replicas.
-            alive = [r for r in replicas if r.server.worker_alive()]
+            # out of alive replicas. A retiring sibling is a LAST
+            # resort (its drain still resolves honestly) behind any
+            # non-retiring live worker.
+            alive = [
+                r
+                for r in replicas
+                if r.server.worker_alive() and not r.retiring
+            ]
+            alive = alive or [
+                r for r in replicas if r.server.worker_alive()
+            ]
             pool = healthy or alive
             if pool:
                 with self._lock:
@@ -724,9 +989,28 @@ class ReplicaRouter:
         # histograms (obs/metrics.py) — bucket counts add exactly, so
         # the pool p50/p99 carry the same estimate-error bound as each
         # replica's own (per-replica percentiles can never be averaged
-        # into pool ones; merged populations can).
+        # into pool ones; merged populations can). Replicas retired by
+        # remove_replica BEFORE this drain merge in from the retained
+        # ledger — a membership change must not drop served history.
+        with self._lock:
+            # Ledger AND its histograms in one atomic snapshot
+            # (remove_replica updates them under this same lock): a
+            # half-visible removal would either drop the leaving
+            # replica's latencies or count them twice.
+            retired = dict(self._retired)
+            retired_hist = self._retired_hist.copy()
+            retired_step_hist = self._retired_step_hist.copy()
+        retired_ids = set(retired)
+        for rid, ret in retired.items():
+            per[rid] = ret["summary"]
+        # A remove_replica racing this drain can finish AFTER the pool
+        # snapshot above was taken: the leaving replica is then in BOTH
+        # the snapshot and the retired ledger — merge it from the
+        # ledger only, or its histogram counts twice.
+        live = [r for r in pool if r.replica_id not in retired_ids]
         pool_hist = LogHistogram()
-        for r in pool:
+        pool_hist.merge(retired_hist)
+        for r in live:
             pool_hist.merge(r.server.latency_histogram())
         shed: dict[str, int] = {}
         for s in per.values():
@@ -753,13 +1037,17 @@ class ReplicaRouter:
                 1.0 - st["real_tokens"] / cap if cap else None
             )
         warm_by_id = {r.replica_id: r.warm_stats for r in pool}
+        warm_by_id.update(
+            {rid: ret["warm_stats"] for rid, ret in retired.items()}
+        )
         # Pool-level rollout-session rollup: outcome counters are
         # router-truth (started/migrated/lost) plus the summed
         # per-replica terminals; the per-step latency percentiles merge
         # the per-replica step histograms, exactly like the request
         # ones.
         step_hist = LogHistogram()
-        for r in pool:
+        step_hist.merge(retired_step_hist)
+        for r in live:
             step_hist.merge(r.server.step_latency_histogram())
         with self._lock:
             routed = dict(self._routed)
@@ -806,12 +1094,16 @@ class ReplicaRouter:
                     # became serve-ready — cold compiles vs snapshot
                     # hydration, with the cache hit/miss breakdown.
                     "warmup_cache": warm_by_id.get(rid),
+                    # Removed before this drain (scale-in / heal); its
+                    # numbers are final as of its retirement.
+                    **({"retired": True} if rid in retired_ids else {}),
                 }
                 for rid, s in sorted(per.items())
             },
             "routing": {
                 "policy": self.route_policy,
                 "replicas": len(pool),
+                "removed": len(retired_ids),
                 # Router-level submit count: equals the sum of the
                 # per-replica `requests` unless callers also submitted
                 # to replica servers directly.

@@ -198,6 +198,7 @@ class InferenceServer:
         replica: int | None = None,
         session_snapshot_every: int = 1,
         metrics=None,
+        session_store=None,
     ):
         self.engine = engine
         self.sink = sink
@@ -372,6 +373,15 @@ class InferenceServer:
         # as dead IMMEDIATELY — migration callbacks run on the dying
         # thread itself, before it has actually exited.
         self._dead = False  #: guarded_by _lock
+        # On-disk session persistence (serve/rollout.py::SessionStore):
+        # a drained session's final snapshot is written here so a
+        # restarted server/router can resume it (resume_rollout).
+        self._session_store = session_store
+        # Scale-in eviction hook (router.remove_replica): when set, a
+        # committed step hands its unfinished session to the callback
+        # (re-placed on a sibling at a step boundary) instead of
+        # chaining the next step here.
+        self._evict_cb = None  #: guarded_by _lock
 
     # -- client side -------------------------------------------------------
 
@@ -476,6 +486,7 @@ class InferenceServer:
         rollout_deadline_ms: float | None = None,
         on_step: Callable | None = None,
         session: RolloutSession | None = None,
+        name: str | None = None,
     ) -> RolloutFuture:
         """Admit one autoregressive rollout: ``steps`` chained
         dispatches whose carry stays resident on THIS server between
@@ -489,6 +500,8 @@ class InferenceServer:
         committed steps (the returned ``RolloutFuture.iter_steps()`` is
         the pull-style twin). ``session`` re-places an existing session
         (router placement / migration) and ignores the other arguments.
+        ``name`` gives the session a client-chosen id — the handle a
+        later ``resume_rollout`` resumes it under after a restart.
 
         The future ALWAYS resolves with a ``RolloutResult``: completed,
         partial-with-``drained_at_step``, or shed-with-reason."""
@@ -496,6 +509,13 @@ class InferenceServer:
             if sample is None or steps is None:
                 raise ValueError(
                     "submit_rollout needs (sample, steps) or a session"
+                )
+            if name is not None and self.has_session(name):
+                # Two live sessions under one sid would shadow each
+                # other in the residence table (and fight over the
+                # same persisted snapshot).
+                raise ValueError(
+                    f"a session named {name!r} is already resident"
                 )
             with self._lock:
                 self._sessions_started += 1
@@ -508,7 +528,7 @@ class InferenceServer:
                 else self.default_deadline_ms
             )
             session = RolloutSession(
-                f"{prefix}{n:04d}",
+                name or f"{prefix}{n:04d}",
                 sample,
                 steps,
                 snapshot_every=self.session_snapshot_every,
@@ -520,6 +540,7 @@ class InferenceServer:
                 ),
                 on_step=on_step,
             )
+            session.named = name is not None
         else:
             # A router placement or a migrated arrival: the session
             # carries its own budgets/cursor; it just takes residence
@@ -532,6 +553,53 @@ class InferenceServer:
             self._sessions[session.sid] = session
         self._submit_step(session)
         return session.future
+
+    def resume_rollout(
+        self,
+        name: str,
+        *,
+        deadline_ms: float | None = None,
+        rollout_deadline_ms: float | None = None,
+        on_step: Callable | None = None,
+    ) -> RolloutFuture:
+        """Resume a persisted session from the session store: load the
+        final carry snapshot a previous server's drain wrote, rebuild
+        the session at its last snapshotted step, and run the remaining
+        steps here. Raises ``KeyError`` when no snapshot exists. A
+        session already complete at its snapshot resolves immediately.
+        The restored prefix is NOT re-streamed (the client already got
+        it); only new steps deliver."""
+        if self._session_store is None:
+            raise RuntimeError("no session store configured")
+        if self.has_session(name):
+            # A retry racing a live resume would run the trajectory
+            # twice under one sid (same guard as submit_rollout).
+            raise ValueError(
+                f"a session named {name!r} is already resident"
+            )
+        state = self._session_store.load(name)
+        if state is None:
+            raise KeyError(f"no persisted session {name!r}")
+        ms = (
+            deadline_ms
+            if deadline_ms is not None
+            else self.default_deadline_ms
+        )
+        session = RolloutSession.from_state(
+            state,
+            snapshot_every=self.session_snapshot_every,
+            step_deadline_ms=ms or None,
+            rollout_deadline=(
+                self._clock() + rollout_deadline_ms / 1e3
+                if rollout_deadline_ms
+                else None
+            ),
+            on_step=on_step,
+        )
+        if session.finished:
+            session.resolve(True, "ok")
+            return session.future
+        return self.submit_rollout(session=session)
 
     # -- rollout-session internals (serve/rollout.py) ----------------------
 
@@ -630,7 +698,33 @@ class InferenceServer:
                         self._sessions_completed += 1
                     self._note_session("completed")
                 self._drop_session(session)
+                # A completed NAMED session's persisted snapshot is
+                # stale — a later resume must not replay a finished
+                # trajectory. (Unnamed sessions never persist: their
+                # auto ids restart per process, so touching the store
+                # under one could clobber another run's snapshot.)
+                if self._session_store is not None and session.named:
+                    self._session_store.delete(session.sid)
             else:
+                with self._lock:
+                    evict = self._evict_cb
+                if evict is not None:
+                    # Scale-in eviction (router.remove_replica): hand
+                    # the session to a sibling at THIS step boundary.
+                    # Snapshot first so the handover replays nothing
+                    # (cursor == snapshot cursor on arrival).
+                    self._event(
+                        events.SESSION_SNAPSHOT,
+                        session=session.sid,
+                        step=session.take_snapshot(),
+                    )
+                    self._drop_session(session)
+                    if evict(session, self.replica):
+                        return
+                    # No sibling could take it: keep it resident and
+                    # let the removal's drain resolve it honestly.
+                    with self._lock:
+                        self._sessions[session.sid] = session
                 self._submit_step(session)
             return
         reason = result.reason
@@ -670,6 +764,21 @@ class InferenceServer:
         session id, drop the residence entry."""
         step = session.take_snapshot()
         drained = kind == "drained"
+        # Persist the final snapshot BEFORE resolving (the restart
+        # contract: once the client sees `drained`, the store holds the
+        # state resume_rollout continues from). A failed write must not
+        # block the drain — the in-memory resolution is still honest.
+        persisted = False
+        if (
+            drained
+            and session.named
+            and self._session_store is not None
+        ):
+            try:
+                self._session_store.save(session)
+                persisted = True
+            except OSError:
+                pass
         resolved = session.resolve(
             False,
             reason,
@@ -685,10 +794,25 @@ class InferenceServer:
             else:
                 self._sessions_shed += 1
         self._note_session("drained" if drained else "shed")
-        self._event(events.SESSION_SNAPSHOT, session=session.sid, step=step)
+        self._event(
+            events.SESSION_SNAPSHOT,
+            session=session.sid,
+            step=step,
+            **({"persisted": True} if persisted else {}),
+        )
         self._event(
             events.SHED, reason=reason, session=session.sid, step=step
         )
+
+    def begin_eviction(self, evict_cb: Callable) -> None:
+        """Arm scale-in eviction (router.remove_replica): from the next
+        committed step on, every unfinished resident session is handed
+        to ``evict_cb(session, replica_id) -> bool`` at a step boundary
+        (snapshot already taken at the current cursor — zero replay).
+        A False return keeps the session here (no sibling available);
+        the removal's drain then resolves it."""
+        with self._lock:
+            self._evict_cb = evict_cb
 
     def _drop_session(self, session: RolloutSession) -> None:
         with self._lock:
@@ -1295,6 +1419,12 @@ class InferenceServer:
         queued behind every new placement and must not read as idle."""
         with self._lock:
             return len(self._sessions)
+
+    def has_session(self, sid: str) -> bool:
+        """Is a session with this id resident here? (The router's
+        duplicate-name guard scans the pool with it.)"""
+        with self._lock:
+            return sid in self._sessions
 
     def step_latencies_ms(self) -> list[float]:
         """BOUNDED snapshot of committed rollout-step latencies (ms) —

@@ -278,6 +278,60 @@ def test_rollout_ab_artifact_schema():
     assert summary["max_abs_diff"] <= summary["bar_numeric"] == 1e-5
 
 
+def test_autoscale_ab_artifact_schema():
+    """The committed autoscaling A/B (tools/autoscale_ab.py): one
+    seeded diurnal+bursty open-loop trace through a static max-size
+    pool vs the controller-scaled pool — the ISSUE 15 acceptance bars:
+    p99 within the stated noise factor of the static pool, STRICTLY
+    fewer replica-seconds, zero shed on the up-ramp; and the chaos arm
+    (scale-in with the retiring replica killed mid-handover) loses
+    zero sessions and zero requests with exact trajectory parity."""
+    path = os.path.join(ARTIFACT_DIR, "autoscale_ab.jsonl")
+    with open(path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    arms = {r["arm"]: r for r in recs if "arm" in r}
+    assert set(arms) == {"static", "autoscaled"}
+    for r in arms.values():
+        # Every submitted request resolved, one way or the other.
+        assert r["submitted"] > 0
+        assert r["completed"] + r["shed_total"] == r["submitted"]
+        assert r["p50_ms"] <= r["p99_ms"]
+        assert r["replica_seconds"] > 0
+    static, auto = arms["static"], arms["autoscaled"]
+    assert static["autoscale"] is False and static["removed"] == 0
+    assert static["replicas_founding"] == static["replicas_max"]
+    assert auto["autoscale"] is True
+    assert auto["replicas_founding"] < auto["replicas_max"]
+    # The controller genuinely acted (both directions).
+    assert auto["autoscale_stats"]["scale_ups"] >= 1
+    assert auto["autoscale_stats"]["scale_downs"] >= 1
+    assert auto["removed"] == auto["autoscale_stats"]["scale_downs"]
+    # The chaos arm: drain-then-remove under a kill loses nothing.
+    (chaos,) = [r for r in recs if r.get("probe") == "chaos_scale_in"]
+    assert chaos["lost_sessions"] == 0 == chaos["bar_lost"]
+    assert chaos["lost_requests"] == 0
+    assert chaos["completed"] == chaos["sessions"]
+    assert chaos["migrated"] >= 1
+    assert chaos["kill_at_step"] >= 1
+    assert chaos["max_abs_diff"] <= chaos["bar_numeric"] == 1e-5
+    (summary,) = [r for r in recs if r.get("summary") == "autoscale_ab"]
+    assert summary["quick"] is False
+    assert summary["trace"] == "diurnal_bursty"
+    # The acceptance bars.
+    assert summary["p99_ratio"] == pytest.approx(
+        summary["p99_autoscaled_ms"] / summary["p99_static_ms"], rel=1e-2
+    )
+    assert summary["p99_ratio"] <= summary["bar_p99_ratio"] == 1.5
+    assert (
+        summary["replica_seconds_autoscaled"]
+        < summary["replica_seconds_static"]
+    )
+    assert summary["replica_seconds_saved_frac"] > 0
+    assert summary["shed_up_ramp"] == 0 == summary["bar_shed_up_ramp"]
+    assert summary["chaos_lost_sessions"] == 0
+    assert summary["chaos_lost_requests"] == 0
+
+
 def test_serve_trace_example_is_complete_chrome_trace():
     """The committed example trace (docs/observability.md "Reading a
     trace"): a real serve-smoke run whose completed requests each carry

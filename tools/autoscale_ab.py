@@ -1,0 +1,552 @@
+"""Autoscaling A/B: a static pool vs the self-scaling pool, plus a
+scale-in chaos arm.
+
+The ISSUE 15 acceptance artifact. One seeded diurnal+bursty open-loop
+trace (tools/loadgen.py — the rate ramps from base to a mid-window
+peak with square bursts riding it) is driven through two pools built
+from the SAME engines:
+
+* ``static`` — a fixed ``--max_replicas``-wide pool (the conservative
+  deployment: provisioned for the peak, idle at the edges).
+* ``autoscaled`` — a pool founded at ``--min_replicas`` with the
+  ``AutoscaleController`` closing the loop from the live metrics
+  registry + SLO evaluator to capacity: prewarm-snapshotted
+  scale-out under pressure, drain-then-remove scale-in after calm.
+
+Bars (pinned by tests/test_artifacts.py::
+test_autoscale_ab_artifact_schema):
+
+* **equal p99** — the autoscaled arm's p99 within the noise factor of
+  the static arm's (``bar_p99_ratio``);
+* **strictly fewer replica-seconds** — the controller's pool-size
+  integral under the static arm's ``max * duration``;
+* **zero shed on the up-ramp** — the first half of the diurnal window
+  (where the pool must GROW before it sheds) completes every request.
+
+The **chaos arm** re-runs the scale-in path under fire: a storm of
+K-step rollout sessions over 3 replicas, ``remove_replica`` of a
+session-holding replica mid-storm, with the retiring replica KILLED
+(``replica_kill``) while it is still handing sessions over — the bars:
+zero lost sessions, zero lost requests, every session completes.
+
+Usage::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/autoscale_ab.py --out docs/artifacts/autoscale_ab.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BAR_P99_RATIO = 1.5  # "equal p99 within noise" on a CPU-proxy timeline
+
+
+def _ensure_xla_flags(n: int) -> None:
+    import sys as _sys
+
+    if "jax" in _sys.modules:
+        print("autoscale_ab: note — jax already imported; flags unchanged")
+        return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        flags += f" --xla_force_host_platform_device_count={max(8, n)}"
+    if "xla_cpu_multi_thread_eigen" not in flags:
+        flags += (
+            " --xla_cpu_multi_thread_eigen=false"
+            " intra_op_parallelism_threads=1"
+        )
+    os.environ["XLA_FLAGS"] = flags.strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _build_model(max_batch: int):
+    """A mid-size GNOT on the Darcy operator schema (the serve_bench
+    sizing): dispatches are COMPUTE-heavy — tens of ms inside XLA with
+    the GIL released — so replica workers genuinely run concurrently on
+    CPU and the capacity estimate means what it says."""
+    from gnot_tpu.config import ModelConfig
+    from gnot_tpu.data import datasets
+    from gnot_tpu.data.batch import collate
+    from gnot_tpu.models.gnot import GNOT
+    from gnot_tpu.train.trainer import init_params
+
+    samples = datasets.synth_darcy2d(max_batch, seed=0, grid_n=8)
+    mc = ModelConfig(
+        n_attn_layers=2, n_attn_hidden_dim=96, n_mlp_num_layers=2,
+        n_mlp_hidden_dim=96, n_input_hidden_dim=96, n_expert=2, n_head=2,
+        **datasets.infer_model_dims(samples),
+    )
+    model = GNOT(mc)
+    return model, init_params(model, collate(samples), 0)
+
+
+def _build_pool(model, params, n, *, max_batch, manifest, devices):
+    """n manifest-hydrated single-device replicas (prewarm-before-join
+    applied to the founding pool too — arm setup pays snapshot loads,
+    not compiles)."""
+    from gnot_tpu.serve import build_replica
+
+    replicas = [
+        build_replica(
+            model, params, i, devices[i : i + 1], batch_size=max_batch
+        )
+        for i in range(n)
+    ]
+    for r in replicas:
+        stats = r.prewarm_from(manifest)
+        assert stats["source"] == "snapshot", (
+            f"replica {r.replica_id} failed to hydrate: {stats}"
+        )
+    return replicas
+
+
+def _arm(
+    name,
+    model,
+    params,
+    traffic,
+    times,
+    *,
+    manifest,
+    n_replicas,
+    max_batch,
+    autoscale,
+    max_replicas,
+    duration_s,
+    cooldown_s,
+    up_load,
+    down_load,
+):
+    """One open-loop run of the shared trace through a fresh pool.
+    Returns the arm record (+ the controller's ledger when elastic)."""
+    import jax
+    import loadgen
+
+    from gnot_tpu.obs.metrics import (
+        MetricsPublisher,
+        MetricsRegistry,
+        SLOEvaluator,
+        SLOObjective,
+    )
+    from gnot_tpu.serve import AutoscaleController, ReplicaRouter, build_replica
+
+    devices = list(jax.devices())
+    registry = MetricsRegistry()
+    replicas = _build_pool(
+        model, params, n_replicas, max_batch=max_batch,
+        manifest=manifest, devices=devices,
+    )
+    router = ReplicaRouter(
+        replicas,
+        max_batch=max_batch,
+        max_wait_ms=4.0,
+        queue_limit=512,
+        metrics=registry,
+    ).start()
+    controller = None
+    publisher = None
+    if autoscale:
+        evaluator = SLOEvaluator(
+            [
+                SLOObjective(
+                    "queue_saturation", "queue_depth", 64.0,
+                    fast_window_s=0.5, slow_window_s=1.5,
+                ),
+            ]
+        )
+        publisher = MetricsPublisher(
+            registry, interval_s=0.25, evaluator=evaluator
+        ).start()
+
+        def factory(rid, slot):
+            return build_replica(
+                model, params, rid,
+                devices[slot : slot + 1], batch_size=max_batch,
+            )
+
+        controller = AutoscaleController(
+            router,
+            replica_factory=factory,
+            min_replicas=n_replicas,
+            max_replicas=max_replicas,
+            interval_s=0.1,
+            cooldown_s=cooldown_s,
+            up_load=up_load,
+            down_load=down_load,
+            down_ticks=15,
+            registry=registry,
+            evaluator=evaluator,
+            # Prewarm-before-join: a scale-out replica hydrates its
+            # slot's AOT snapshot (0.x s) instead of paying cold XLA
+            # compiles mid-ramp.
+            prewarm_manifest=manifest,
+        ).start()
+    t0 = time.perf_counter()
+    submit_at: list[float] = []
+
+    def submit(i):
+        submit_at.append(time.perf_counter() - t0)
+        return router.submit(traffic[i % len(traffic)])
+
+    futures = loadgen.replay(submit, times)
+    results = [f.result(timeout=300) for f in futures]
+    elapsed = time.perf_counter() - t0
+    if controller is not None:
+        controller.close()
+    if publisher is not None:
+        publisher.close()
+    summary = router.drain()
+    ramp_n = loadgen.ramp_split(times, duration_s)
+    shed_up_ramp = sum(1 for r in results[:ramp_n] if not r.ok)
+    completed = sum(r.ok for r in results)
+    rs = (
+        controller.replica_seconds()
+        if controller is not None
+        else n_replicas * elapsed
+    )
+    rec = {
+        "arm": name,
+        "replicas_founding": n_replicas,
+        "replicas_max": max_replicas,
+        "autoscale": autoscale,
+        "submitted": len(futures),
+        "completed": completed,
+        "shed": summary["shed"],
+        "shed_total": len(futures) - completed,
+        "shed_up_ramp": shed_up_ramp,
+        "ramp_requests": ramp_n,
+        "p50_ms": summary["latency_p50_ms"],
+        "p99_ms": summary["latency_p99_ms"],
+        "achieved_rps": round(completed / elapsed, 2),
+        "replica_seconds": round(rs, 2),
+        "duration_s": round(elapsed, 2),
+        "removed": summary["routing"]["removed"],
+    }
+    if controller is not None:
+        rec["autoscale_stats"] = controller.stats()
+    return rec
+
+
+def _chaos_scale_in(
+    engine, manifest, *, max_batch, sessions, steps, traffic, quick
+):
+    """Scale-in under fire: rollout sessions resident on the retiring
+    replica, which is KILLED while still handing them over. Bars: zero
+    lost sessions, zero lost requests, every session completes and
+    matches the offline trajectory."""
+    import jax
+
+    from gnot_tpu.resilience.faults import FaultInjector
+    from gnot_tpu.serve import ReplicaRouter, rollout
+    from gnot_tpu.serve.rollout import offline_rollout
+
+    devices = list(jax.devices())
+    traffic = traffic[:sessions]
+    reference = [
+        offline_rollout(engine, s, steps, rows=max_batch) for s in traffic
+    ]
+    replicas = _build_pool(
+        engine.model, engine.params, 3, max_batch=max_batch,
+        manifest=manifest, devices=devices,
+    )
+    # The kill lands on replica 0 AFTER the removal starts: armed by
+    # rollout-step ordinal, sized so eviction is mid-flight.
+    kill_at = max(4, sessions // 2)
+    router = ReplicaRouter(
+        replicas,
+        max_batch=max_batch,
+        max_wait_ms=2.0,
+        session_snapshot_every=2,
+        faults={0: FaultInjector.from_spec(f"replica_kill@{kill_at}")},
+    ).start()
+    futures = [router.submit_rollout(s, steps) for s in traffic]
+    # Let the storm take residence everywhere, then retire replica 0
+    # while it still holds sessions — the kill fires during the drain.
+    time.sleep(0.05)
+    t0 = time.perf_counter()
+    router.remove_replica(0, timeout_s=60.0, reason="scale_in")
+    remove_s = time.perf_counter() - t0
+    results = [f.result(timeout=300) for f in futures]
+    summary = router.drain()
+    lost_sessions = sum(1 for r in results if not r.ok)
+    worst = 0.0
+    for r, ref in zip(results, reference):
+        if r.ok:
+            worst = max(worst, rollout.parity_check(r.outputs, ref))
+    sess = summary.get("sessions") or {}
+    return {
+        "probe": "chaos_scale_in",
+        "quick": quick,
+        "sessions": sessions,
+        "steps": steps,
+        "removed_replica": 0,
+        "kill_at_step": kill_at,
+        "remove_s": round(remove_s, 3),
+        "completed": sum(1 for r in results if r.ok),
+        "lost_sessions": lost_sessions,
+        "lost_requests": sum(
+            n
+            for reason, n in summary["shed"].items()
+            if reason not in ("error_replica_dead",)
+        ),
+        "dead_request_failures_replayed": summary["shed"].get(
+            "error_replica_dead", 0
+        ),
+        "migrated": sess.get("migrated", 0),
+        "max_abs_diff": worst,
+        "bar_lost": 0,
+        "bar_numeric": 1e-5,
+    }
+
+
+def run(argv=None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", type=str, required=True)
+    p.add_argument("--min_replicas", type=int, default=2)
+    p.add_argument("--max_replicas", type=int, default=4)
+    p.add_argument("--duration_s", type=float, default=32.0)
+    p.add_argument("--base_mult", type=float, default=0.5,
+                   help="base offered load as a multiple of one "
+                        "replica's measured capacity")
+    p.add_argument("--peak_mult", type=float, default=5.0,
+                   help="diurnal peak rate as a multiple of base")
+    p.add_argument("--max_batch", type=int, default=4)
+    p.add_argument("--sessions", type=int, default=10,
+                   help="chaos arm: concurrent rollout sessions")
+    p.add_argument("--steps", type=int, default=6)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--quick", action="store_true",
+                   help="short window + small storm (CI smoke, not the "
+                        "committed artifact)")
+    args = p.parse_args(argv)
+    if args.quick:
+        args.duration_s = min(args.duration_s, 8.0)
+        args.sessions, args.steps = 6, 4
+
+    _ensure_xla_flags(args.max_replicas)
+
+    import tempfile
+
+    import jax
+    import loadgen
+    import serve_smoke
+
+    from gnot_tpu.serve import InferenceEngine, aot, build_replica
+    from gnot_tpu.utils.cache import enable_compile_cache
+
+    enable_compile_cache()
+    model, params = _build_model(args.max_batch)
+    traffic = serve_smoke.mixed_traffic(
+        16, seed=args.seed, mesh_lo=600, mesh_hi=1000
+    )
+    engine = InferenceEngine(model, params, batch_size=args.max_batch)
+    engine.warmup(traffic, rows=args.max_batch)
+
+    # Deploy-time AOT pass for the MAX topology: every arm (and every
+    # scale-out) hydrates warm snapshots instead of compiling — the
+    # prewarm-before-join contract the controller enforces.
+    devices = list(jax.devices())
+    deploy = [
+        build_replica(
+            model, params, i, devices[i : i + 1],
+            batch_size=args.max_batch,
+        )
+        for i in range(args.max_replicas)
+    ]
+    t0 = time.perf_counter()
+    manifest = aot.prewarm_deployment(
+        [(r.replica_id, r.engine) for r in deploy],
+        traffic,
+        rows=args.max_batch,
+        snapshot_dir=tempfile.mkdtemp(prefix="autoscale_ab_snap_"),
+    )
+    print(
+        f"autoscale_ab: deploy AOT pass for {args.max_replicas} slots "
+        f"in {time.perf_counter() - t0:.1f}s"
+    )
+
+    # Capacity probe: one replica's dispatch rate sets the trace scale
+    # (the diurnal peak must genuinely overload a min-size pool).
+    keys = [engine.bucket_key(s) for s in traffic]
+    t0 = time.perf_counter()
+    for s, k in zip(traffic[:8], keys[:8]):
+        engine.infer([s], pad_nodes=k[0], pad_funcs=k[1],
+                     rows=args.max_batch)
+    dispatch_s = (time.perf_counter() - t0) / 8
+    cap1 = args.max_batch / dispatch_s
+    base_rps = args.base_mult * cap1
+    print(
+        f"autoscale_ab: dispatch {dispatch_s * 1e3:.1f} ms -> 1-replica "
+        f"capacity ~{cap1:.0f}/s; trace base {base_rps:.0f}/s, peak "
+        f"~{base_rps * args.peak_mult:.0f}/s over {args.duration_s}s"
+    )
+    times = loadgen.trace_times(
+        "diurnal_bursty",
+        base_rps=base_rps,
+        duration_s=args.duration_s,
+        seed=args.seed,
+        peak_mult=args.peak_mult,
+        bursts=2,
+        burst_mult=2.0,
+        burst_frac=0.06,
+    )
+    print(f"autoscale_ab: {len(times)} arrivals on the shared trace")
+
+    # Controller thresholds in per-replica in-system requests: grow
+    # well before the queue saturates, shrink near-idle.
+    up_load = 1.0 * args.max_batch
+    down_load = 0.5 * args.max_batch
+    common = dict(
+        max_batch=args.max_batch,
+        max_replicas=args.max_replicas,
+        duration_s=args.duration_s,
+        cooldown_s=0.5,
+        up_load=up_load,
+        down_load=down_load,
+    )
+    records: list[dict] = []
+    failures: list[str] = []
+
+    def check(ok, msg):
+        if not ok:
+            failures.append(msg)
+
+    static = _arm(
+        "static", model, params, traffic, times, manifest=manifest,
+        n_replicas=args.max_replicas, autoscale=False, **common,
+    )
+    records.append(static)
+    print(
+        f"  static     p99={static['p99_ms']:.1f}ms shed="
+        f"{static['shed_total']} replica_s={static['replica_seconds']}"
+    )
+    auto = _arm(
+        "autoscaled", model, params, traffic, times, manifest=manifest,
+        n_replicas=args.min_replicas, autoscale=True, **common,
+    )
+    records.append(auto)
+    print(
+        f"  autoscaled p99={auto['p99_ms']:.1f}ms shed="
+        f"{auto['shed_total']} shed_up_ramp={auto['shed_up_ramp']} "
+        f"replica_s={auto['replica_seconds']} "
+        f"(ups={auto['autoscale_stats']['scale_ups']} "
+        f"downs={auto['autoscale_stats']['scale_downs']})"
+    )
+
+    chaos = _chaos_scale_in(
+        engine, manifest, max_batch=args.max_batch,
+        sessions=args.sessions, steps=args.steps, traffic=traffic,
+        quick=args.quick,
+    )
+    records.append(chaos)
+    print(
+        f"  chaos      lost_sessions={chaos['lost_sessions']} "
+        f"lost_requests={chaos['lost_requests']} "
+        f"migrated={chaos['migrated']} parity={chaos['max_abs_diff']:.2e}"
+    )
+
+    p99_ratio = (
+        auto["p99_ms"] / static["p99_ms"] if static["p99_ms"] else None
+    )
+    summary = {
+        "summary": "autoscale_ab",
+        "quick": bool(args.quick),
+        "trace": "diurnal_bursty",
+        "duration_s": args.duration_s,
+        "base_rps": round(base_rps, 1),
+        "peak_mult": args.peak_mult,
+        "arrivals": len(times),
+        "min_replicas": args.min_replicas,
+        "max_replicas": args.max_replicas,
+        "up_load": up_load,
+        "down_load": down_load,
+        "p99_static_ms": static["p99_ms"],
+        "p99_autoscaled_ms": auto["p99_ms"],
+        "p99_ratio": round(p99_ratio, 3) if p99_ratio else None,
+        "bar_p99_ratio": BAR_P99_RATIO,
+        "replica_seconds_static": static["replica_seconds"],
+        "replica_seconds_autoscaled": auto["replica_seconds"],
+        "replica_seconds_saved_frac": round(
+            1.0 - auto["replica_seconds"] / static["replica_seconds"], 3
+        ),
+        "shed_up_ramp": auto["shed_up_ramp"],
+        "bar_shed_up_ramp": 0,
+        "scale_ups": auto["autoscale_stats"]["scale_ups"],
+        "scale_downs": auto["autoscale_stats"]["scale_downs"],
+        "chaos_lost_sessions": chaos["lost_sessions"],
+        "chaos_lost_requests": chaos["lost_requests"],
+        "chaos_migrated": chaos["migrated"],
+        "chaos_max_abs_diff": chaos["max_abs_diff"],
+    }
+    records.append(summary)
+
+    if not args.quick:
+        # The timing bars hold on the committed (full-window) trace;
+        # --quick compresses the diurnal ramp faster than any reactive
+        # controller can track, so the CI smoke checks wiring + the
+        # chaos/efficiency invariants only.
+        check(
+            p99_ratio is not None and p99_ratio <= BAR_P99_RATIO,
+            f"autoscaled p99 {auto['p99_ms']} vs static "
+            f"{static['p99_ms']} (ratio {p99_ratio}) beyond the "
+            f"{BAR_P99_RATIO} noise bar",
+        )
+        check(
+            auto["shed_up_ramp"] == 0,
+            f"autoscaled arm shed {auto['shed_up_ramp']} requests on "
+            "the up-ramp (must grow before it sheds)",
+        )
+    check(
+        auto["replica_seconds"] < static["replica_seconds"],
+        "autoscaled pool did not save replica-seconds "
+        f"({auto['replica_seconds']} vs {static['replica_seconds']})",
+    )
+    check(
+        auto["autoscale_stats"]["scale_ups"] >= 1,
+        "controller never scaled out — the trace was vacuous",
+    )
+    check(
+        chaos["lost_sessions"] == 0,
+        f"chaos arm lost {chaos['lost_sessions']} sessions",
+    )
+    check(
+        chaos["lost_requests"] == 0,
+        f"chaos arm lost {chaos['lost_requests']} requests",
+    )
+    check(
+        chaos["max_abs_diff"] <= chaos["bar_numeric"],
+        f"chaos-arm parity {chaos['max_abs_diff']} over the bar",
+    )
+
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+    with open(args.out, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    print(
+        f"autoscale_ab: p99 {auto['p99_ms']:.1f} vs {static['p99_ms']:.1f}"
+        f"ms (ratio {p99_ratio:.2f}), replica-seconds "
+        f"{auto['replica_seconds']:.0f} vs {static['replica_seconds']:.0f}"
+        f" (saved {summary['replica_seconds_saved_frac']:.0%}), "
+        f"up-ramp shed {auto['shed_up_ramp']}; wrote {args.out}"
+    )
+    for msg in failures:
+        print(f"FAIL: {msg}")
+    summary = dict(summary)
+    summary["failures"] = failures
+    return summary
+
+
+def main(argv=None) -> int:
+    return 1 if run(argv)["failures"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

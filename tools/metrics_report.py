@@ -9,6 +9,10 @@ tool is its ``trace_report``-style reader:
   p99 / rate (observations per second) over time, computed from the
   cumulative bucket deltas between consecutive rows; for counters: the
   per-interval rate; for gauges: the level.
+* **pool size over time** — the ``pool_replicas`` gauge's trajectory
+  (transitions + endpoints) and its step-integral in replica-seconds:
+  the autoscaler's membership changes and the capacity actually paid
+  for, read straight off the time series.
 * **SLO breach intervals** — the fire->clear windows reconstructed
   from the ``slo_alert`` edges in the event stream (``--metrics`` JSONL
   from the same run), asserted to alternate (edge discipline: a second
@@ -83,6 +87,36 @@ def series_history(rows: list[dict]) -> dict[str, list[dict]]:
             hist.append(entry)
         prev = row
     return out
+
+
+def pool_size_series(rows: list[dict]) -> list[dict]:
+    """Pool-size-over-time from the ``pool_replicas`` gauge the router
+    registers: one ``{"seq", "t", "replicas"}`` entry per snapshot that
+    carries the gauge (empty when the run never registered it — a
+    single-server tier has no pool gauge)."""
+    out: list[dict] = []
+    for row in rows:
+        for st in row["series"].values():
+            if st["type"] == "gauge" and st["name"] == "pool_replicas":
+                out.append(
+                    {
+                        "seq": row["seq"],
+                        "t": row["t"],
+                        "replicas": int(st["value"]),
+                    }
+                )
+                break
+    return out
+
+
+def replica_seconds(series: list[dict]) -> float:
+    """Step-integral of the pool size over the snapshot timeline — the
+    capacity actually paid for (the autoscale A/B's efficiency axis,
+    as reconstructable from the time series alone)."""
+    total = 0.0
+    for a, b in zip(series, series[1:]):
+        total += (b["t"] - a["t"]) * a["replicas"]
+    return total
 
 
 def breach_intervals(events: list[dict]) -> tuple[list[dict], list[str]]:
@@ -179,6 +213,25 @@ def run(argv=None) -> int:
                 )
             else:
                 print(f"    seq {e['seq']:>4}  value={e['value']}")
+
+    pool = pool_size_series(rows)
+    if pool:
+        sizes = [p["replicas"] for p in pool]
+        print(
+            f"\nPool size over time ({len(pool)} snapshots, "
+            f"min={min(sizes)} max={max(sizes)}, "
+            f"{replica_seconds(pool):.1f} replica-seconds):"
+        )
+        # Print the transitions (and the endpoints), not every row —
+        # a long steady stretch is one line, not a page.
+        last = None
+        for i, p in enumerate(pool):
+            if p["replicas"] != last or i in (0, len(pool) - 1):
+                print(
+                    f"  seq {p['seq']:>4}  t={p['t']:.3f}  "
+                    f"replicas={p['replicas']}"
+                )
+                last = p["replicas"]
 
     if args.metrics:
         events = load_rows(args.metrics)

@@ -51,6 +51,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
+import loadgen
 import serve_smoke
 
 
@@ -136,24 +137,18 @@ def fresh_router(replicas, *, max_batch, queue_limit=256, max_wait_ms=4.0,
 
 def run_arm(router, traffic, *, offered_rps, duration_s, seed) -> dict:
     """One open-loop run: Poisson arrivals at ``offered_rps`` for
-    ``duration_s``, submitted on schedule (never throttled by
-    responses), then wait for every Future and drain."""
-    rng = np.random.default_rng(seed)
+    ``duration_s`` (tools/loadgen.py ``steady`` trace — the shared
+    seeded generator, so arms replay identical schedules), submitted on
+    schedule (never throttled by responses), then wait for every Future
+    and drain."""
+    times = loadgen.trace_times(
+        "steady", base_rps=offered_rps, duration_s=duration_s, seed=seed
+    )
     router.start()
-    futures = []
     t0 = time.perf_counter()
-    deadline = t0 + duration_s
-    next_at = t0 + float(rng.exponential(1.0 / offered_rps))
-    i = 0
-    while next_at < deadline:
-        now = time.perf_counter()
-        if now < next_at:
-            time.sleep(next_at - now)
-        # Behind schedule? Submit immediately — open loop never waits
-        # for the pool; the backlog is the point.
-        futures.append(router.submit(traffic[i % len(traffic)]))
-        i += 1
-        next_at += float(rng.exponential(1.0 / offered_rps))
+    futures = loadgen.replay(
+        lambda i: router.submit(traffic[i % len(traffic)]), times
+    )
     results = [f.result(timeout=300) for f in futures]
     last_done = time.perf_counter()
     summary = router.drain()
