@@ -1,0 +1,117 @@
+"""XLA program cost extraction — the device-side sensor of the catalog.
+
+A compiled XLA executable knows what it costs: ``cost_analysis()``
+reports the HLO-level flop/byte/transcendental counts and
+``memory_analysis()`` the argument/output/temp/generated-code buffer
+sizes. The serving tier compiles (or AOT-hydrates) every program it
+will ever dispatch, so those numbers are available exactly once per
+dtype-keyed program signature — this module turns them into one plain
+dict the program catalog (serve/catalog.py) stores and the capacity
+model joins with live traffic.
+
+Extraction is DUCK-TYPED and total: jaxlib's surface here has shifted
+across releases (list-of-dicts vs dict from ``cost_analysis``, missing
+methods on some backends, partial keys on others), and a serving tier
+must never fail a dispatch because a cost probe came back thin. Every
+field the catalog schema names is always present — a number when the
+backend reported it, ``None`` when it did not — and any absence is
+EXPLICIT via the ``unavailable`` field (the list of missing fields)
+rather than silently zero: a zero-flop program and a program whose
+backend would not say are different facts.
+
+Stdlib-only (no jax import): the extractor sees only the compiled
+object handed to it, so tests exercise degradation with plain stub
+objects and the obs layer stays importable anywhere.
+"""
+
+from __future__ import annotations
+
+#: Every cost field a catalog entry carries, in schema order. The first
+#: three come from ``cost_analysis()`` (HLO op counts), the rest from
+#: ``memory_analysis()`` (buffer-size breakdown of one execution).
+COST_FIELDS = (
+    "flops",
+    "bytes_accessed",
+    "transcendentals",
+    "argument_bytes",
+    "output_bytes",
+    "temp_bytes",
+    "generated_code_bytes",
+)
+
+# jaxlib's cost_analysis keys (spaces and all) -> catalog field names.
+_COST_ANALYSIS_KEYS = {
+    "flops": "flops",
+    "bytes accessed": "bytes_accessed",
+    "transcendentals": "transcendentals",
+}
+
+# CompiledMemoryStats attributes -> catalog field names.
+_MEMORY_ATTRS = {
+    "argument_size_in_bytes": "argument_bytes",
+    "output_size_in_bytes": "output_bytes",
+    "temp_size_in_bytes": "temp_bytes",
+    "generated_code_size_in_bytes": "generated_code_bytes",
+}
+
+
+def _as_number(value):
+    """A plain JSON-safe number, or None for anything else (backends
+    have returned numpy scalars, floats-as-strings and sentinels like
+    -1 here; a negative count is a sentinel, not a cost)."""
+    try:
+        num = float(value)
+    except (TypeError, ValueError):
+        return None
+    if num != num or num < 0:  # NaN or sentinel
+        return None
+    return int(num) if num == int(num) else num
+
+
+def extract_costs(compiled) -> dict:
+    """Cost dict for one compiled executable, total and JSON-safe.
+
+    Returns every :data:`COST_FIELDS` key (number or None); when any
+    field is missing the dict also carries ``unavailable`` — the sorted
+    list of absent field names — so downstream consumers (and the
+    committed artifact's acceptance check) can tell "measured zero"
+    from "backend would not say".
+    """
+    out: dict = {f: None for f in COST_FIELDS}
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        ca = None
+    # Some jaxlib versions return one dict; others a per-partition
+    # list of dicts (partition 0 carries the whole-program counts for
+    # the single-program executables the serving tier compiles).
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if isinstance(ca, dict):
+        for src, dst in _COST_ANALYSIS_KEYS.items():
+            if src in ca:
+                out[dst] = _as_number(ca[src])
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        ma = None
+    if ma is not None:
+        for attr, dst in _MEMORY_ATTRS.items():
+            if hasattr(ma, attr):
+                out[dst] = _as_number(getattr(ma, attr))
+    missing = sorted(f for f in COST_FIELDS if out[f] is None)
+    if missing:
+        out["unavailable"] = missing
+    return out
+
+
+def unavailable_costs(reason: str) -> dict:
+    """The all-``None`` cost dict for a program whose executable could
+    not be probed at all (capture raised, snapshot predates the costs
+    field, ...). ``unavailable`` names every field and
+    ``unavailable_reason`` says why — the explicit marker the artifact
+    acceptance bar accepts in place of nonzero costs."""
+    out: dict = {f: None for f in COST_FIELDS}
+    out["unavailable"] = sorted(COST_FIELDS)
+    out["unavailable_reason"] = reason
+    return out

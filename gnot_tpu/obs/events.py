@@ -60,6 +60,8 @@ SCALE_UP = "scale_up"
 SCALE_DOWN = "scale_down"
 REPLICA_REMOVE = "replica_remove"
 REPLICA_REPLACE = "replica_replace"
+PROGRAM_CATALOG = "program_catalog"
+CAPACITY_SNAPSHOT = "capacity_snapshot"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -365,6 +367,31 @@ EVENTS: dict[str, EventSpec] = {
         fields=("path", "spans", "dropped"),
         module="gnot_tpu/obs/tracing.py",
         doc="the span tracer wrote its Chrome trace-event JSON file",
+    ),
+    "program_catalog": EventSpec(
+        fields=("key", "source"),
+        module="gnot_tpu/serve/catalog.py",
+        doc="a compiled program entered the catalog (serve/catalog.py): "
+        "`key` is the dtype-keyed program signature (the AOT table's "
+        "own name), `source` its provenance ('compile' = captured at "
+        "first jit compile, 'hydrate' = live cost probe of a "
+        "deserialized AOT executable, 'manifest' = costs carried in "
+        "the prewarm manifest), and `costs` the XLA "
+        "cost_analysis/memory_analysis dict (obs/costs.py; absent "
+        "fields listed under `unavailable` — partial data degrades "
+        "explicitly, never silently)",
+        optional=("costs", "replica"),
+    ),
+    "capacity_snapshot": EventSpec(
+        fields=("programs", "pool"),
+        module="gnot_tpu/serve/catalog.py",
+        doc="drain-time capacity model: per-program cost x traffic "
+        "rates (device-time per token, achieved FLOPs/s, useful-token "
+        "fraction) and the pool rollup of sustainable tokens/s and "
+        "requests/s per replica (x / device_s — the 100%-device-duty "
+        "bound tools/capacity_report.py compares offered load "
+        "against); retired replicas' traffic is merged in",
+        optional=("replica",),
     ),
 }
 

@@ -63,6 +63,7 @@ from typing import Callable, Iterable, Iterator
 from gnot_tpu.obs import events
 
 #: Serve-side span names, in request-lifecycle order (docs/serving.md).
+#: Every completed request gets exactly this chain under one trace_id.
 SERVE_SPANS = (
     "admission",
     "queue_wait",
@@ -72,6 +73,12 @@ SERVE_SPANS = (
     "unpad",
     "resolve",
 )
+
+#: Optional serve-side spans a request chain MAY additionally carry:
+#: ``compile`` marks a fresh-signature jit dispatch that paid its XLA
+#: compile inside the device window (AOT and warm-jit dispatches never
+#: emit it) — the cold-path attribution for trace critical paths.
+SERVE_OPTIONAL_SPANS = ("compile",)
 
 #: Train-side span names (docs/observability.md "Tracing").
 TRAIN_SPANS = (

@@ -260,6 +260,8 @@ def aot_compile(
     snapshot file/bytes) plus the aggregated cache stats."""
     from jax.experimental import serialize_executable
 
+    from gnot_tpu.obs.costs import extract_costs
+
     compiled: dict[str, object] = {}
 
     def thunk(spec):
@@ -276,6 +278,13 @@ def aot_compile(
         entry = {
             **dataclasses.asdict(spec),
             "compile_s": by_key[spec.key],
+            # XLA cost/memory analysis of the compiled executable
+            # (obs/costs.py) — recorded AT COMPILE TIME so the program
+            # catalog of a hydrating deployment has cost entries even
+            # when the deserialized snapshot's own probes come back
+            # thin. Fields the backend would not report are None with
+            # an explicit `unavailable` list, never zero.
+            "costs": extract_costs(compiled[spec.key]),
             "snapshot": None,
             "snapshot_bytes": None,
         }
@@ -423,6 +432,24 @@ def hydrate(
         engine.install_program(signature, loaded)
         installed += 1
         keys.append(spec.key)
+        cat = getattr(engine, "catalog", None)
+        if cat is not None:
+            # Pre-record this program's costs at hydrate time so a
+            # prewarmed tier's catalog is complete BEFORE traffic (the
+            # engine's lazy capture would otherwise re-lower on first
+            # dispatch — breaking prewarm's zero-compile contract).
+            # Probe the deserialized executable; when its analysis
+            # comes back thinner than the compile-time record shipped
+            # in the manifest, prefer the manifest's.
+            from gnot_tpu.obs.costs import extract_costs
+
+            costs, source = extract_costs(loaded), "hydrate"
+            mc = entry.get("costs")
+            if mc is not None and len(mc.get("unavailable", ())) < len(
+                costs.get("unavailable", ())
+            ):
+                costs, source = dict(mc), "manifest"
+            cat.record(spec.key, costs, source=source)
     return {
         "installed": installed,
         "skipped": skipped,

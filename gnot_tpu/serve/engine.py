@@ -45,6 +45,7 @@ from gnot_tpu.data.batch import (
     validate_samples,
 )
 from gnot_tpu.models import precision
+from gnot_tpu.serve.catalog import bucket_program_key, packed_program_key
 from gnot_tpu.utils import sanitizer
 
 
@@ -175,6 +176,13 @@ class InferenceEngine:
         # installed snapshot vs fell back to the jitted forward.
         self._aot_calls = 0  #: guarded_by _lock
         self._jit_calls = 0  #: guarded_by _lock
+        # Program catalog (serve/catalog.py): when attached, the first
+        # dispatch of each program also captures the executable's XLA
+        # cost/memory analysis (one extra AOT-style compile, at warmup
+        # in practice) under the AOT table's program key. Hydration
+        # (serve/aot.py) pre-records every snapshot program's entry, so
+        # a prewarmed engine never compiles for a cost probe.
+        self._catalog = None
 
     # -- params ------------------------------------------------------------
 
@@ -293,9 +301,12 @@ class InferenceEngine:
         with self._lock:
             return {"aot": self._aot_calls, "jit": self._jit_calls}
 
-    def _run_forward(self, params, placed):
+    def _run_forward(self, params, placed, timings: dict | None = None):
         """One forward execution: the installed AOT executable when this
-        signature was hydrated, the jitted forward otherwise."""
+        signature was hydrated, the jitted forward otherwise. A
+        ``timings`` dict riding along gets ``timings["path"]`` — the
+        dispatch provenance ("aot"/"jit") the server's jit-fallback
+        counter and compile-span attribution read."""
         sig = self.signature_of(placed)
         with self._lock:
             loaded = self._aot.get(sig)
@@ -303,7 +314,44 @@ class InferenceEngine:
                 self._aot_calls += 1
             else:
                 self._jit_calls += 1
+        if timings is not None:
+            timings["path"] = "aot" if loaded is not None else "jit"
         return (loaded or self._forward)(params, placed)
+
+    # -- program catalog (serve/catalog.py) --------------------------------
+
+    def attach_catalog(self, catalog) -> None:
+        """Wire (or detach, with None) the shared program catalog:
+        dispatches then capture first-seen program costs and stamp
+        their program key into ``timings`` for server attribution."""
+        self._catalog = catalog
+
+    @property
+    def catalog(self):
+        return self._catalog
+
+    def _capture_costs(self, program: str, placed) -> None:
+        """Record one program's XLA cost/memory analysis into the
+        attached catalog, once per program key. The probe compiles via
+        ``lower().compile()`` (the AOT pipeline's own path — the jit
+        call's executable is not reachable from here), so it runs at
+        most once per program; an entry pre-recorded by hydration or a
+        manifest makes this a no-op. A failed probe records the
+        explicit ``unavailable`` marker — never raises into a dispatch."""
+        cat = self._catalog
+        if cat is None or cat.has(program):
+            return
+        from gnot_tpu.obs.costs import extract_costs, unavailable_costs
+
+        try:
+            costs = extract_costs(
+                self._forward.lower(self.params, placed).compile()
+            )
+        except Exception as e:  # a cost probe must never fail serving
+            costs = unavailable_costs(
+                f"capture failed: {type(e).__name__}"
+            )
+        cat.record(program, costs, source="compile")
 
     # -- the serving hot path ----------------------------------------------
 
@@ -351,16 +399,24 @@ class InferenceEngine:
             pad_funcs=pad_funcs,
             dtype=self.dtype,
         )
-        self._note_shape(batch)
+        fresh = self._note_shape(batch)
+        program = None
+        if timings is not None or self._catalog is not None:
+            program = bucket_program_key(
+                pad_nodes, pad_funcs, rows, self.dtype
+            )
         params = self.params  # one consistent weight set per dispatch
         if timings is not None:
             t1 = tick()
             timings["batch_assembly"] = (t0, t1)
+            timings["program"] = program
+            timings["fresh_signature"] = fresh
+        placed = self._device_put(batch)
         # host_fetch: np.asarray in off mode (byte-identical), a
         # defensive copy / registered view under GNOT_ALIAS_GUARD
         # (utils/sanitizer.py) — the engine-side sanitizer seam.
         out = sanitizer.host_fetch(
-            self._run_forward(params, self._device_put(batch))
+            self._run_forward(params, placed, timings)
         )
         if timings is not None:
             t2 = tick()
@@ -373,6 +429,8 @@ class InferenceEngine:
         )
         if timings is not None:
             timings["unpad"] = (t2, tick())
+        if self._catalog is not None:
+            self._capture_costs(program, placed)
         return outs
 
     def infer_packed(
@@ -419,16 +477,22 @@ class InferenceEngine:
             pad_funcs=plan.pad_funcs,
             dtype=self.dtype,
         )
-        self._note_shape(batch)
+        fresh = self._note_shape(batch)
+        program = None
+        if timings is not None or self._catalog is not None:
+            program = packed_program_key(plan, self.dtype)
         params = self.params  # one consistent weight set per dispatch
         if timings is not None:
             t1 = tick()
             timings["batch_assembly"] = (t0, t1)
+            timings["program"] = program
+            timings["fresh_signature"] = fresh
+        placed = self._device_put(batch)
         # host_fetch: np.asarray in off mode (byte-identical), a
         # defensive copy / registered view under GNOT_ALIAS_GUARD
         # (utils/sanitizer.py) — the engine-side sanitizer seam.
         out = sanitizer.host_fetch(
-            self._run_forward(params, self._device_put(batch))
+            self._run_forward(params, placed, timings)
         )
         if timings is not None:
             t2 = tick()
@@ -444,6 +508,8 @@ class InferenceEngine:
         )
         if timings is not None:
             timings["unpad"] = (t2, tick())
+        if self._catalog is not None:
+            self._capture_costs(program, placed)
         return outs
 
     def warmup_packed(
@@ -458,10 +524,15 @@ class InferenceEngine:
         self.infer_packed(fits[:1], plan)
         return 1
 
-    def _note_shape(self, batch) -> None:
+    def _note_shape(self, batch) -> bool:
+        """Log one dispatch signature. True iff it was NEW — on the jit
+        path that dispatch is the one paying the program's XLA compile,
+        which is what the server's compile-span attribution keys on."""
         key = self.signature_of(batch)
         with self._lock:
+            fresh = key not in self._shapes
             self._shapes.add(key)
+        return fresh
 
     def warmup(
         self, samples: Sequence[MeshSample], *, rows: int | None = None

@@ -132,6 +132,7 @@ class ReplicaRouter:
         max_session_migrations: int = 3,
         metrics=None,
         session_store=None,
+        catalog=None,
     ):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
@@ -179,7 +180,14 @@ class ReplicaRouter:
             session_snapshot_every=session_snapshot_every,
             metrics=metrics,
             session_store=session_store,
+            catalog=catalog,
         )
+        # Shared program catalog (serve/catalog.py): every replica's
+        # server attributes its dispatches into the ONE catalog (keys
+        # are dtype-scoped program signatures; traffic rows carry the
+        # replica id), and drain() joins it with the XLA cost entries
+        # into the pool capacity model.
+        self._catalog = catalog
         # On-disk rollout-session persistence (rollout.SessionStore):
         # each per-replica server persists drained sessions' final
         # snapshots; the router resumes them (resume_rollout).
@@ -1133,6 +1141,16 @@ class ReplicaRouter:
                 "step_latency_p50_ms": step_hist.percentile(0.50),
                 "step_latency_p99_ms": step_hist.percentile(0.99),
             }
+        if self._catalog is not None:
+            # Pool capacity model: the catalog's cost entries joined
+            # with every replica's attributed traffic (retired replicas
+            # included — traffic rows are never deleted). Emits the
+            # capacity_snapshot event exactly once across repeated
+            # drains (emit_snapshot is idempotent).
+            model = self._catalog.emit_snapshot()
+            summary["capacity_model"] = (
+                model if model is not None else self._catalog.capacity_model()
+            )
         if not self._drained.is_set():
             self._drained.set()
             self._event(events.SERVE_SUMMARY, **summary)

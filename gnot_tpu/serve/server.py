@@ -199,6 +199,7 @@ class InferenceServer:
         session_snapshot_every: int = 1,
         metrics=None,
         session_store=None,
+        catalog=None,
     ):
         self.engine = engine
         self.sink = sink
@@ -320,6 +321,35 @@ class InferenceServer:
             self._c_steps = None
         self._lat_res = Reservoir()
         self._step_res = Reservoir()
+        # Program catalog (serve/catalog.py): every executed dispatch is
+        # attributed to its compiled program (requests/tokens/device
+        # seconds) — the cost x traffic join behind the capacity model.
+        # Attaching here also wires the ENGINE's compile-time cost
+        # capture when nothing else did, so one ``catalog=`` knob arms
+        # the whole plane per server (the router passes it per replica).
+        self._catalog = catalog
+        if (
+            catalog is not None
+            and getattr(engine, "catalog", None) is None
+            and hasattr(engine, "attach_catalog")
+        ):
+            engine.attach_catalog(catalog)
+        # Jit-fallback visibility (ISSUE 16): dispatches that ran the
+        # jitted forward instead of an installed AOT executable were
+        # invisible — now a per-replica counter (+ registry series) and,
+        # for a FRESH signature, a dedicated `compile` trace span.
+        self._jit_fallbacks = 0  #: guarded_by _lock
+        self._c_jit_fallback = (
+            metrics.counter("serve_jit_fallback_total", **lbl)
+            if metrics is not None
+            else None
+        )
+        # Pad-waste unification (ISSUE 16): with a live registry the
+        # per-bucket token counters ARE the accounting — _summary reads
+        # them back, so serve_summary.pad_waste_by_bucket and the
+        # registry series cannot diverge (one ledger, two views). The
+        # cache mirrors _bucket_hists (get-or-create off the hot path).
+        self._pack_counters: dict = {}
         # Hot-path series caches: registry get-or-create is a string
         # build + lock per call — fine at shed/alert cadence, not per
         # completed request. Benign races (two threads missing the
@@ -1209,7 +1239,17 @@ class InferenceServer:
             capacity_tokens=capacity_tokens,
             **({"trace_ids": member_ids} if member_ids else {}),
         )
-        timings: dict | None = {} if member_ids else None
+        # Timing stamps ride whenever ANY consumer wants them: a traced
+        # member (phase spans), the program catalog (device-time
+        # attribution + program key), or a live registry (jit-fallback
+        # counter reads the dispatch provenance stamp).
+        timings: dict | None = (
+            {}
+            if member_ids
+            or self._catalog is not None
+            or self._metrics is not None
+            else None
+        )
         try:
             if plan is not None:
                 outs = self.engine.infer_packed(
@@ -1247,8 +1287,23 @@ class InferenceServer:
             )
             return
         # The program ran: its pad waste is real whatever the outputs
-        # hold, so the packing rollup counts it here.
+        # hold, so the packing rollup counts it here — and the catalog
+        # attributes the dispatch to its compiled program (the cost x
+        # traffic join; rollout steps ride the same path).
         self._note_pack(bucket, real_tokens, capacity_tokens)
+        if timings is not None:
+            if timings.get("path") == "jit":
+                self._note_jit_fallback()
+            if self._catalog is not None:
+                dev = timings.get("device")
+                self._catalog.note_dispatch(
+                    timings.get("program") or bucket,
+                    requests=len(live),
+                    real_tokens=real_tokens,
+                    capacity_tokens=capacity_tokens,
+                    device_s=(dev[1] - dev[0]) if dev else None,
+                    replica=self.replica,
+                )
         if self.faults is not None and self.faults.maybe_nan_output(dispatch):
             outs = [np.full_like(o, np.nan) for o in outs]
         if self.faults is not None and [
@@ -1318,6 +1373,17 @@ class InferenceServer:
         if "device" in timings:
             t0, t1 = timings["device"]
             device_ms = (t1 - t0) * 1e3
+        # A fresh-signature jit dispatch paid its XLA compile inside
+        # the device window: record a dedicated `compile` span over it
+        # so the trace critical path attributes cold-path compiles
+        # instead of lumping them into an unattributed gap. (An AOT
+        # dispatch never compiles; a warm jit signature already has its
+        # executable cached.)
+        compile_span = (
+            timings.get("path") == "jit"
+            and timings.get("fresh_signature")
+            and "device" in timings
+        )
         for r in live:
             if r.trace is None:
                 continue
@@ -1326,6 +1392,12 @@ class InferenceServer:
                 if phase in timings:
                     t0, t1 = timings[phase]
                     self._trace_span(r.trace, phase, t0, t1, **link)
+            if compile_span:
+                t0, t1 = timings["device"]
+                self._trace_span(
+                    r.trace, "compile", t0, t1,
+                    program=timings.get("program"), **link,
+                )
             self._note_bucket(
                 bucket,
                 queue_ms=[(start - r.submitted) * 1e3],
@@ -1336,7 +1408,31 @@ class InferenceServer:
         self, bucket: str, real_tokens: int, capacity_tokens: int
     ) -> None:
         """One executed dispatch's contribution to the per-bucket
-        packing-efficiency rollup (serve_summary.pad_waste_by_bucket)."""
+        packing-efficiency rollup (serve_summary.pad_waste_by_bucket).
+        With a live registry the per-bucket counters are the ONLY
+        ledger (_summary reads their values back), so the summary and
+        the registry series cannot diverge; without one, the plain
+        dict accounting stands as before."""
+        if self._metrics is not None:
+            cs = self._pack_counters.get(bucket)
+            if cs is None:
+                lbl = {"bucket": bucket, **self._metric_labels}
+                cs = {
+                    "dispatches": self._metrics.counter(
+                        "serve_bucket_dispatches_total", **lbl
+                    ),
+                    "real_tokens": self._metrics.counter(
+                        "serve_bucket_real_tokens_total", **lbl
+                    ),
+                    "capacity_tokens": self._metrics.counter(
+                        "serve_bucket_capacity_tokens_total", **lbl
+                    ),
+                }
+                self._pack_counters[bucket] = cs
+            cs["dispatches"].inc()
+            cs["real_tokens"].inc(real_tokens)
+            cs["capacity_tokens"].inc(capacity_tokens)
+            return
         with self._lock:
             st = self._pack_stats.setdefault(
                 bucket,
@@ -1345,6 +1441,17 @@ class InferenceServer:
             st["dispatches"] += 1
             st["real_tokens"] += real_tokens
             st["capacity_tokens"] += capacity_tokens
+
+    def _note_jit_fallback(self) -> None:
+        """One dispatch ran the JITTED forward (its signature missing
+        from the AOT table) — the cold path a prewarmed tier must never
+        take. Previously invisible; now a per-replica count in
+        serve_summary and, with a live registry, the
+        ``serve_jit_fallback_total`` series an operator can alert on."""
+        with self._lock:
+            self._jit_fallbacks += 1
+        if self._c_jit_fallback is not None:
+            self._c_jit_fallback.inc()
 
     def _note_bucket(self, bucket: str, queue_ms=(), device_ms=()) -> None:
         """One traced request's contribution to the per-bucket
@@ -1561,6 +1668,7 @@ class InferenceServer:
                 for k, v in self._bucket_stats.items()
             }
             pack_stats = {k: dict(v) for k, v in self._pack_stats.items()}
+            jit_fallbacks = self._jit_fallbacks
             if self._sessions_started:
                 # Rollout-session rollup (serve/rollout.py): sessions
                 # ACCEPTED here (migrated arrivals included) and how
@@ -1576,6 +1684,16 @@ class InferenceServer:
                     "step_latency_p50_ms": self._step_hist.percentile(0.50),
                     "step_latency_p99_ms": self._step_hist.percentile(0.99),
                 }
+        if self._metrics is not None:
+            # With a live registry the per-bucket counters ARE the
+            # ledger (_note_pack): read their values back so the
+            # summary's pad_waste_by_bucket and the registry series are
+            # one accounting, not two that can drift.
+            pack_stats = {
+                k: {kk: c.value for kk, c in cs.items()}
+                for k, cs in dict(self._pack_counters).items()
+            }
+        summary["jit_fallbacks"] = jit_fallbacks
         if pack_stats:
             # Per-bucket pad-waste / packing efficiency over every
             # executed dispatch: fill = real/capacity node tokens,
@@ -1630,6 +1748,16 @@ class InferenceServer:
             latency_p50_ms=self._lat_hist.percentile(0.50),
             latency_p99_ms=self._lat_hist.percentile(0.99),
         )
+        if self._catalog is not None and self.replica is None:
+            # Standalone server (router-owned replicas carry integer
+            # ids, 0 included, and the router's drain builds the pool
+            # rollup instead): join the catalog's cost entries with the
+            # traffic this server attributed to them. emit=True also
+            # publishes the capacity_snapshot event exactly once.
+            model = self._catalog.emit_snapshot() if emit else None
+            summary["capacity_model"] = (
+                model if model is not None else self._catalog.capacity_model()
+            )
         if emit:
             self._event(events.SERVE_SUMMARY, **summary)
             if self.sink is not None:

@@ -427,3 +427,76 @@ def test_lowprec_ab_artifact_schema():
         "bf16 serving regressed beyond the measured device slowdown — "
         "the host path added a loss of its own"
     )
+
+
+def test_capacity_snapshot_artifact_schema():
+    """The committed capacity snapshot (tools/capacity_report.py): the
+    cost x traffic join from a real storm — every dispatched program
+    carries a catalog entry (nonzero XLA costs or the explicit
+    ``unavailable`` marker), the capacity model agrees with
+    serve_summary number-for-number, and the PackPlan recommendation's
+    projected pad waste beats the committed pack_ab packed arm on the
+    same traffic (the ISSUE 16 acceptance bar)."""
+    path = os.path.join(ARTIFACT_DIR, "capacity_snapshot.jsonl")
+    with open(path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    progs = [r for r in recs if r.get("record") == "program"]
+    assert progs, "no per-program cost x traffic rows"
+    for r in progs:
+        assert r["program"].startswith(("bucket:", "packed:"))
+        costs = r["costs"]
+        assert costs["flops"] or costs.get("unavailable"), r
+        if r["dispatches"]:
+            assert r["source"] is not None  # dispatched => catalogued
+            assert r["real_tokens"] <= r["capacity_tokens"]
+    (cap,) = [r for r in recs if r.get("record") == "capacity"]
+    assert cap["agreement"]["problems"] == []
+    assert cap["dispatches"] == sum(r["dispatches"] for r in progs)
+    assert cap["sustainable_tokens_per_s"] > 0
+    assert cap["headroom_tokens"] is not None
+    (rec,) = [r for r in recs if r.get("record") == "pack_recommendation"]
+    assert rec["plan"]["row_len"] % rec["plan"]["chunk"] == 0
+    assert rec["candidates_searched"] >= 1
+    (summary,) = [r for r in recs if r.get("summary") == "capacity_report"]
+    assert summary["agreement_problems"] == []
+    assert summary["projected_pad_waste"] == rec["projected_pad_waste"]
+    # The bar: the recommendation beats the committed packed arm's pad
+    # waste (docs/artifacts/pack_ab.jsonl) on the same traffic shape.
+    pack_path = os.path.join(ARTIFACT_DIR, "pack_ab.jsonl")
+    with open(pack_path) as f:
+        pack = [json.loads(l) for l in f if l.strip()]
+    (pack_summary,) = [r for r in pack if r.get("summary") == "pack_ab"]
+    baseline = pack_summary["serve_pad_waste_packed"]
+    assert summary["baseline_packed_pad_waste"] == baseline
+    assert summary["projected_pad_waste"] <= baseline
+    assert summary["beats_baseline"] is True
+
+
+def test_capacity_ab_artifact_schema():
+    """The committed catalog-attribution overhead A/B
+    (tools/capacity_ab.py): interleaved serve-storm arms with the
+    program catalog + per-dispatch attribution off vs on — both over
+    the full live metrics plane — plus a summary whose overhead_frac
+    meets the <=2% bar with attribution demonstrably live."""
+    path = os.path.join(ARTIFACT_DIR, "capacity_overhead_ab.jsonl")
+    with open(path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    arms = {r["arm"]: r for r in recs if "arm" in r}
+    assert set(arms) == {"capacity_off", "capacity_on"}
+    for r in arms.values():
+        assert r["requests_per_s"] > 0 and r["requests"] >= 1000
+        assert r["repeats"] >= 3  # interleaved best-of, not one sample
+    on = arms["capacity_on"]
+    assert on["snapshots"] >= 1  # the publisher RAN in the timed arm
+    assert on["attributed_dispatches"] > 0  # the catalog saw the storm
+    assert on["programs"] >= 1
+    (summary,) = [
+        r for r in recs if r.get("summary") == "capacity_overhead"
+    ]
+    assert isinstance(summary["overhead_frac"], float)
+    assert summary["overhead_frac"] <= 0.02
+    assert summary["attributed_dispatches"] == on["attributed_dispatches"]
+    assert summary["overhead_frac"] == pytest.approx(
+        1.0 - summary["requests_per_s_on"] / summary["requests_per_s_off"],
+        abs=1e-3,
+    )

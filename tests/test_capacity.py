@@ -1,0 +1,515 @@
+"""Program catalog & capacity plane (gnot_tpu/serve/catalog.py,
+gnot_tpu/obs/costs.py, docs/observability.md "Program costs &
+capacity"): XLA cost extraction and its graceful degradation, catalog
+population at compile and AOT-hydrate time, per-program dispatch
+attribution under a mixed padded+packed storm, the pad-waste
+registry/summary unification, the jit-fallback counter + compile
+span, and the capacity model's rate math and report agreement."""
+
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from gnot_tpu.config import ModelConfig
+from gnot_tpu.data import datasets
+from gnot_tpu.data.batch import MeshSample, PackPlan, bucket_length, collate
+from gnot_tpu.models.gnot import GNOT
+from gnot_tpu.obs import events
+from gnot_tpu.obs.costs import COST_FIELDS, extract_costs, unavailable_costs
+from gnot_tpu.obs.metrics import MetricsRegistry
+from gnot_tpu.serve import InferenceEngine, InferenceServer, aot
+from gnot_tpu.serve.catalog import (
+    ProgramCatalog,
+    bucket_program_key,
+    packed_program_key,
+)
+from gnot_tpu.train.trainer import init_params
+from gnot_tpu.utils.metrics import MetricsSink
+
+sys.path.insert(
+    0,
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tools"
+    ),
+)
+
+MAX_BATCH = 2
+
+
+@pytest.fixture(scope="module")
+def setup():
+    samples = datasets.synth_darcy2d(8, seed=0, grid_n=8)
+    mc = ModelConfig(
+        n_attn_layers=1, n_attn_hidden_dim=16, n_mlp_num_layers=1,
+        n_mlp_hidden_dim=16, n_input_hidden_dim=16, n_expert=2, n_head=2,
+        **datasets.infer_model_dims(samples),
+    )
+    model = GNOT(mc)
+    params = init_params(model, collate(samples[:2]), 0)
+    return model, params, samples
+
+
+def fresh_engine(setup):
+    model, params, _ = setup
+    return InferenceEngine(model, params, batch_size=MAX_BATCH)
+
+
+def _ragged(setup, sizes, seed=0):
+    _, _, samples = setup
+    rng = np.random.default_rng(seed)
+    f_dim = samples[0].funcs[0].shape[-1]
+    return [
+        MeshSample(
+            coords=rng.uniform(0, 1, size=(m, 2)).astype(np.float32),
+            y=np.zeros((m, 1), np.float32),
+            theta=samples[0].theta,
+            funcs=(
+                rng.uniform(0, 1, size=(max(4, m // 4), f_dim)).astype(
+                    np.float32
+                ),
+            ),
+        )
+        for m in sizes
+    ]
+
+
+def read_events(path):
+    return [
+        r for r in (json.loads(l) for l in open(path)) if r.get("event")
+    ]
+
+
+# --- cost extraction ------------------------------------------------------
+
+
+def test_extract_costs_from_real_executable():
+    """A genuinely compiled XLA executable yields the full cost dict:
+    nonzero flops and bytes from cost_analysis, buffer sizes from
+    memory_analysis, no unavailable marker for the numeric fields."""
+    compiled = (
+        jax.jit(lambda a, b: a @ b)
+        .lower(np.ones((16, 16), np.float32), np.ones((16, 16), np.float32))
+        .compile()
+    )
+    costs = extract_costs(compiled)
+    assert set(COST_FIELDS) <= set(costs)
+    assert costs["flops"] and costs["flops"] > 0
+    assert costs["bytes_accessed"] and costs["bytes_accessed"] > 0
+    assert costs["argument_bytes"] == 2 * 16 * 16 * 4
+    assert costs["output_bytes"] == 16 * 16 * 4
+    assert "flops" not in costs.get("unavailable", ())
+
+
+class _Stub:
+    """Duck-typed compiled-executable stub for degradation tests."""
+
+    def __init__(self, ca=None, ma=None, raise_ca=False, raise_ma=False):
+        self._ca, self._ma = ca, ma
+        self._raise_ca, self._raise_ma = raise_ca, raise_ma
+
+    def cost_analysis(self):
+        if self._raise_ca:
+            raise RuntimeError("no cost analysis on this backend")
+        return self._ca
+
+    def memory_analysis(self):
+        if self._raise_ma:
+            raise RuntimeError("no memory analysis on this backend")
+        return self._ma
+
+
+class _MemStats:
+    argument_size_in_bytes = 128
+    output_size_in_bytes = 64
+    temp_size_in_bytes = 0
+    generated_code_size_in_bytes = 4096
+
+
+def test_extract_costs_degrades_gracefully():
+    """Partial or absent analyses degrade to explicit ``unavailable``
+    markers, never zeros and never exceptions — including jaxlib's
+    list-of-dicts cost_analysis shape and sentinel values."""
+    # Both probes raise: everything unavailable, nothing invented.
+    c = extract_costs(_Stub(raise_ca=True, raise_ma=True))
+    assert all(c[f] is None for f in COST_FIELDS)
+    assert c["unavailable"] == sorted(COST_FIELDS)
+    # Partial cost_analysis (flops only, as a list-of-dicts) + memory:
+    # the known fields are numbers, the missing ones are named.
+    c = extract_costs(_Stub(ca=[{"flops": 123.0}], ma=_MemStats()))
+    assert c["flops"] == 123 and c["argument_bytes"] == 128
+    assert c["bytes_accessed"] is None
+    assert "bytes_accessed" in c["unavailable"]
+    assert "transcendentals" in c["unavailable"]
+    # Sentinels: -1 and NaN are "would not say", not costs.
+    c = extract_costs(
+        _Stub(ca={"flops": -1.0, "bytes accessed": float("nan")})
+    )
+    assert c["flops"] is None and c["bytes_accessed"] is None
+    # An object with no probe methods at all.
+    c = extract_costs(object())
+    assert c["unavailable"] == sorted(COST_FIELDS)
+
+
+def test_unavailable_costs_marker():
+    c = unavailable_costs("snapshot predates costs")
+    assert all(c[f] is None for f in COST_FIELDS)
+    assert c["unavailable"] == sorted(COST_FIELDS)
+    assert c["unavailable_reason"] == "snapshot predates costs"
+    json.dumps(c)  # artifact-safe
+
+
+# --- the catalog ----------------------------------------------------------
+
+
+def test_catalog_record_upgrade_and_event(tmp_path):
+    """First sight wins and emits ONE program_catalog event; a thinner
+    re-recording is refused; a strictly fuller one upgrades in place
+    without a second event."""
+    path = str(tmp_path / "ev.jsonl")
+    with MetricsSink(path) as sink:
+        cat = ProgramCatalog(sink=sink)
+        thin = unavailable_costs("manifest predates costs")
+        assert cat.record("bucket:64x64@2@f32", thin, source="manifest")
+        assert not cat.record(
+            "bucket:64x64@2@f32", thin, source="manifest"
+        )
+        full = {f: 1 for f in COST_FIELDS}
+        assert cat.record("bucket:64x64@2@f32", full, source="compile")
+        assert cat.get("bucket:64x64@2@f32")["source"] == "compile"
+        # Downgrade refused: the full entry stays.
+        assert not cat.record(
+            "bucket:64x64@2@f32", thin, source="hydrate"
+        )
+    recs = [
+        e for e in read_events(path) if e["event"] == events.PROGRAM_CATALOG
+    ]
+    assert len(recs) == 1
+    assert events.validate_record(recs[0]) == []
+
+
+def test_catalog_attach_outputs_replays_backlog(tmp_path):
+    """Entries recorded before a sink attaches replay into it — wiring
+    order (engines hydrate before the harness opens its sink) cannot
+    lose program_catalog events."""
+    cat = ProgramCatalog()
+    cat.record("bucket:64x64@2@f32", {f: 1 for f in COST_FIELDS},
+               source="hydrate")
+    path = str(tmp_path / "ev.jsonl")
+    with MetricsSink(path) as sink:
+        cat.attach_outputs(sink=sink)
+    recs = [
+        e for e in read_events(path) if e["event"] == events.PROGRAM_CATALOG
+    ]
+    assert [r["key"] for r in recs] == ["bucket:64x64@2@f32"]
+
+
+def test_catalog_population_on_compile(setup):
+    """An engine with an attached catalog records every program it
+    compiles, keyed exactly like the AOT manifest, with live XLA
+    costs (source "compile") — and only once per program."""
+    _, _, samples = setup
+    engine = fresh_engine(setup)
+    cat = ProgramCatalog()
+    engine.attach_catalog(cat)
+    engine.warmup(samples[:1], rows=MAX_BATCH)
+    pn, pf = engine.bucket_key(samples[0])
+    key = bucket_program_key(pn, pf, MAX_BATCH, engine.dtype)
+    entry = cat.get(key)
+    assert entry is not None and entry["source"] == "compile"
+    assert entry["costs"]["flops"] > 0
+    assert entry["costs"]["bytes_accessed"] > 0
+    # A second dispatch of the same program records nothing new.
+    engine.infer([samples[1]], pad_nodes=pn, pad_funcs=pf, rows=MAX_BATCH)
+    assert len(cat.entries()) == 1
+
+
+def test_aot_manifest_carries_costs_and_hydrate_records(setup, tmp_path):
+    """aot_compile stamps each manifest entry with compile-time costs;
+    hydrating a fresh twin records them into the twin's catalog BEFORE
+    any traffic — and a storm over the hydrated tier then runs with
+    zero jit fallbacks and a fully-costed capacity model."""
+    _, _, samples = setup
+    deploy = fresh_engine(setup)
+    specs = aot.enumerate_programs(deploy, samples[:1], rows=MAX_BATCH)
+    block = aot.aot_compile(
+        deploy, specs, replica_id=0, snapshot_dir=str(tmp_path / "snap")
+    )
+    for entry in block["programs"]:
+        assert entry["costs"]["flops"] > 0, entry
+    twin = fresh_engine(setup)
+    cat = ProgramCatalog()
+    twin.attach_catalog(cat)
+    res = aot.hydrate(
+        twin, block["programs"], str(tmp_path / "snap"),
+        params_sig=block["params_sig"],
+    )
+    assert res["installed"] == len(specs) and not res["skipped"]
+    for spec in specs:
+        entry = cat.get(spec.key)
+        assert entry is not None, f"hydrate did not record {spec.key}"
+        assert entry["source"] in ("hydrate", "manifest")
+        assert entry["costs"]["flops"] > 0
+    # Storm the hydrated twin: pure AOT dispatches, zero fallbacks,
+    # and the standalone server's summary carries the capacity model.
+    registry = MetricsRegistry()
+    path = str(tmp_path / "serve.jsonl")
+    with MetricsSink(path) as sink:
+        cat.attach_outputs(metrics=registry, sink=sink)
+        server = InferenceServer(
+            engine=twin, max_batch=MAX_BATCH, max_wait_ms=5.0,
+            sink=sink, metrics=registry, catalog=cat,
+        ).start()
+        futures = [server.submit(s) for s in samples[:4]]
+        assert all(f.result(timeout=60).ok for f in futures)
+        summary = server.drain()
+    assert summary["jit_fallbacks"] == 0
+    assert twin.dispatch_counts["jit"] == 0
+    model = summary["capacity_model"]
+    for key, prog in model["programs"].items():
+        if prog["dispatches"]:
+            assert prog["costs"]["flops"] > 0, (key, prog)
+    assert model["pool"]["dispatches"] == summary["dispatches"] > 0
+
+
+def test_mixed_storm_attribution_and_registry_crosscheck(setup, tmp_path):
+    """A mixed padded+packed storm attributes every dispatch to its
+    dtype-keyed program — packed rides the plan's program, the
+    oversize fallback its padded bucket — and the summary's
+    pad_waste_by_bucket is read back from the SAME registry counters
+    it publishes (the one-accounting unification)."""
+    _, _, samples = setup
+    engine = fresh_engine(setup)
+    cat = ProgramCatalog()
+    engine.attach_catalog(cat)
+    small = _ragged(setup, [16, 40, 24, 64, 8, 32])
+    plan = PackPlan.from_samples(small, chunk=8, batch_size=MAX_BATCH)
+    oversize = _ragged(setup, [plan.row_len + 8], seed=5)[0]
+    engine.warmup(small + [oversize], rows=MAX_BATCH)
+    engine.warmup_packed(small, plan)
+    registry = MetricsRegistry()
+    path = str(tmp_path / "serve.jsonl")
+    with MetricsSink(path) as sink:
+        cat.attach_outputs(metrics=registry, sink=sink)
+        server = InferenceServer(
+            engine=engine, max_batch=MAX_BATCH, max_wait_ms=5.0,
+            sink=sink, metrics=registry, pack_plan=plan, catalog=cat,
+        ).start()
+        futures = [server.submit(s) for s in small + [oversize]]
+        assert all(f.result(timeout=60).ok for f in futures)
+        summary = server.drain()
+    model = summary["capacity_model"]
+    pkey = packed_program_key(plan, engine.dtype)
+    opn, opf = engine.bucket_key(oversize)
+    okey = bucket_program_key(opn, opf, MAX_BATCH, engine.dtype)
+    assert model["programs"][pkey]["dispatches"] > 0
+    assert model["programs"][okey]["dispatches"] > 0
+    assert model["programs"][pkey]["requests"] == len(small)
+    assert model["programs"][pkey]["real_tokens"] == sum(
+        s.coords.shape[0] for s in small
+    )
+    # Every dispatched program carries live costs (captured at warmup).
+    for key, prog in model["programs"].items():
+        if prog["dispatches"]:
+            assert prog["costs"]["flops"] > 0, (key, prog)
+            assert prog["device_s"] > 0
+            assert prog["tokens_per_device_s"] > 0
+    assert model["pool"]["dispatches"] == summary["dispatches"]
+    # The unification cross-check: summary pad-waste numbers ARE the
+    # registry's serve_bucket_* counter values, bucket for bucket.
+    snap = registry.snapshot()
+    by_bucket: dict = {}
+    for row in snap.values():
+        name = row["name"]
+        if not name.startswith("serve_bucket_") or not name.endswith(
+            "_total"
+        ):
+            continue
+        b = row["labels"]["bucket"]
+        field = name[len("serve_bucket_"):-len("_total")]
+        by_bucket.setdefault(b, {})[field] = row["value"]
+    pw = summary["pad_waste_by_bucket"]
+    assert set(by_bucket) == set(pw)
+    for b, st in pw.items():
+        for field in ("dispatches", "real_tokens", "capacity_tokens"):
+            assert st[field] == by_bucket[b][field], (b, field)
+    # Per-program registry series exist with the program label.
+    prog_series = [
+        row for row in snap.values()
+        if row["name"] == "program_dispatches_total"
+    ]
+    assert {row["labels"]["program"] for row in prog_series} >= {
+        pkey, okey,
+    }
+    # program_catalog events validated; one per recorded program.
+    recs = [
+        e for e in read_events(path)
+        if e["event"] == events.PROGRAM_CATALOG
+    ]
+    assert {e["key"] for e in recs} == set(cat.entries())
+    for e in recs:
+        assert events.validate_record(e) == []
+    snap_ev = [
+        e for e in read_events(path)
+        if e["event"] == events.CAPACITY_SNAPSHOT
+    ]
+    assert len(snap_ev) == 1
+    assert events.validate_record(snap_ev[0]) == []
+
+
+def test_jit_fallback_counter_and_compile_span(setup, tmp_path):
+    """Jit-path dispatches are visible: the per-replica counter and
+    summary field count them, and a COLD (fresh-signature) jit
+    dispatch gets a dedicated compile span carrying its program key."""
+    from gnot_tpu.obs.tracing import Tracer
+
+    engine = fresh_engine(setup)  # deliberately unwarmed: cold jit
+    _, _, samples = setup
+    registry = MetricsRegistry()
+    trace_path = str(tmp_path / "trace.json")
+    tracer = Tracer(path=trace_path, sample_rate=1.0)
+    path = str(tmp_path / "serve.jsonl")
+    with MetricsSink(path) as sink:
+        server = InferenceServer(
+            engine=engine, max_batch=MAX_BATCH, max_wait_ms=5.0,
+            sink=sink, metrics=registry, tracer=tracer,
+            default_deadline_ms=60_000,
+        ).start()
+        futures = [server.submit(s) for s in samples[:2]]
+        assert all(f.result(timeout=120).ok for f in futures)
+        summary = server.drain()
+        tracer.flush()
+    assert summary["jit_fallbacks"] == engine.dispatch_counts["jit"] > 0
+    counter = [
+        row for row in registry.snapshot().values()
+        if row["name"] == "serve_jit_fallback_total"
+    ]
+    assert counter and counter[0]["value"] == summary["jit_fallbacks"]
+    with open(trace_path) as f:
+        spans = [
+            ev for ev in json.load(f)["traceEvents"]
+            if ev.get("name") == "compile"
+        ]
+    assert spans, "cold jit dispatch produced no compile span"
+    pn, pf = engine.bucket_key(samples[0])
+    want = bucket_program_key(pn, pf, MAX_BATCH, engine.dtype)
+    assert any(
+        s.get("args", {}).get("program") == want for s in spans
+    )
+
+
+# --- the capacity model and report ----------------------------------------
+
+
+def test_capacity_model_rates_and_retired_replica_merge():
+    """Pure model math: flops/s = flops x dispatches / device_s,
+    sustainable pool rates are additive over replicas, and a retired
+    replica's traffic stays in the rollup (rows are never deleted)."""
+    cat = ProgramCatalog()
+    costs = {f: None for f in COST_FIELDS}
+    costs["flops"] = 1000
+    cat.record("bucket:64x64@2@f32", costs, source="compile")
+    cat.note_dispatch(
+        "bucket:64x64@2@f32", requests=2, real_tokens=100,
+        capacity_tokens=128, device_s=0.5, replica=0,
+    )
+    cat.note_dispatch(
+        "bucket:64x64@2@f32", requests=2, real_tokens=100,
+        capacity_tokens=128, device_s=0.25, replica=1,
+    )
+    model = cat.capacity_model()
+    prog = model["programs"]["bucket:64x64@2@f32"]
+    assert prog["dispatches"] == 2 and prog["requests"] == 4
+    assert prog["flops_per_s"] == pytest.approx(2 * 1000 / 0.75)
+    assert prog["useful_token_frac"] == pytest.approx(200 / 256)
+    assert set(prog["per_replica"]) == {"0", "1"}
+    pool = model["pool"]
+    assert pool["replicas"] == 2
+    # Additive over replicas: 2/0.5 + 2/0.25 requests per device-sec.
+    assert pool["sustainable_requests_per_s"] == pytest.approx(4 + 8)
+    assert pool["sustainable_tokens_per_s"] == pytest.approx(
+        100 / 0.5 + 100 / 0.25
+    )
+    # A dispatched-but-never-recorded program surfaces the explicit
+    # marker instead of dropping its traffic.
+    cat.note_dispatch(
+        "bucket:999x64@2@f32", requests=1, real_tokens=10,
+        capacity_tokens=64, device_s=None, replica=0,
+    )
+    model = cat.capacity_model()
+    ghost = model["programs"]["bucket:999x64@2@f32"]
+    assert ghost["source"] is None
+    assert ghost["costs"]["unavailable_reason"] == "never recorded"
+    assert ghost["tokens_per_device_s"] is None  # unknown, not infinite
+
+
+def test_capacity_report_recommendation_and_agreement():
+    """tools/capacity_report.py pure parts on a synthetic model: the
+    reconstruction preserves exact token totals, the searched plan's
+    projection beats the observed padded waste, and the agreement
+    check flags drift."""
+    import capacity_report
+
+    def prog(dispatches, requests, real, cap):
+        return {
+            "source": "compile", "costs": {},
+            "dispatches": dispatches, "requests": requests,
+            "real_tokens": real, "capacity_tokens": cap,
+            "device_s": 0.01, "per_replica": {},
+            "useful_token_frac": real / cap,
+            "tokens_per_device_s": real / 0.01,
+            "requests_per_device_s": requests / 0.01,
+            "device_us_per_token": 1e4 / real, "flops_per_s": None,
+        }
+
+    model = {
+        "programs": {
+            "bucket:64x64@4@f32": prog(5, 17, 1080, 1280),
+            "bucket:192x64@4@f32": prog(2, 5, 810, 1536),
+        },
+        "pool": {
+            "replicas": 1, "programs": 2, "dispatches": 7,
+            "requests": 22, "real_tokens": 1890,
+            "capacity_tokens": 2816, "device_s": 0.02,
+            "sustainable_requests_per_s": 1100.0,
+            "sustainable_tokens_per_s": 94500.0,
+            "useful_token_frac": 1890 / 2816, "per_replica": {},
+        },
+    }
+    sizes, buckets = capacity_report.reconstruct_sizes(model, 64)
+    assert sum(sizes) == 1890 and len(sizes) == 22
+    assert {b["bucket"] for b in buckets} == {64, 192}
+    rec = capacity_report.pack_recommendation(model, 64, 4, baseline=None)
+    assert rec["real_tokens"] == 1890
+    assert rec["projected_pad_waste"] < rec["observed_pad_waste"]
+    assert rec["plan"]["row_len"] % 64 == 0
+    summary = {
+        "dispatches": 7,
+        "pad_waste_by_bucket": {
+            "64x64": {"dispatches": 5, "real_tokens": 1080,
+                      "capacity_tokens": 1280},
+            "192x64": {"dispatches": 2, "real_tokens": 810,
+                       "capacity_tokens": 1536},
+        },
+    }
+    assert capacity_report.agreement(summary, model)["problems"] == []
+    summary["dispatches"] = 8  # drift must be flagged, not smoothed
+    assert capacity_report.agreement(summary, model)["problems"]
+
+
+def test_serve_smoke_capacity_flag(tmp_path):
+    """The smoke's own --capacity assertions hold end to end (the
+    tier-1 twin of the capacity_report storm)."""
+    import serve_smoke
+
+    summary = serve_smoke.run([
+        "--n", "6", "--mesh_lo", "80", "--mesh_hi", "200",
+        "--inject_fault", "none", "--deadline_ms", "10000",
+        "--capacity",
+        "--metrics_path", str(tmp_path / "smoke.jsonl"),
+    ])
+    assert summary["failures"] == []
+    assert summary["capacity_model"]["pool"]["dispatches"] > 0

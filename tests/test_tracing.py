@@ -12,7 +12,12 @@ import numpy as np
 import pytest
 
 from gnot_tpu.data import datasets
-from gnot_tpu.obs.tracing import SERVE_SPANS, Tracer, percentiles
+from gnot_tpu.obs.tracing import (
+    SERVE_OPTIONAL_SPANS,
+    SERVE_SPANS,
+    Tracer,
+    percentiles,
+)
 from gnot_tpu.serve import InferenceEngine, InferenceServer
 from gnot_tpu.utils.metrics import MetricsSink
 
@@ -223,7 +228,12 @@ def test_serve_request_chain_and_queue_wait_arithmetic(tmp_path):
         by_trace.setdefault(s.trace_id, {})[s.name] = s
     assert len(by_trace) == len(samples)
     for t, chain in by_trace.items():
-        assert set(chain) == set(SERVE_SPANS), (t, sorted(chain))
+        # The guaranteed chain is exactly SERVE_SPANS; a fresh-signature
+        # jit dispatch may additionally carry the optional `compile`
+        # span (SERVE_OPTIONAL_SPANS) over its device window.
+        assert set(SERVE_SPANS) <= set(chain), (t, sorted(chain))
+        extra = set(chain) - set(SERVE_SPANS)
+        assert extra <= set(SERVE_OPTIONAL_SPANS), (t, sorted(extra))
         qw, disp = chain["queue_wait"], chain["dispatch"]
         assert chain["admission"].start == qw.start  # both from submit
         assert qw.end == disp.start  # dispatch pop closes the queue
@@ -335,7 +345,8 @@ def test_serve_thread_safety_under_client_storm(tmp_path):
         by_trace.setdefault(s.trace_id, []).append(s.name)
     assert len(by_trace) == 32
     for names in by_trace.values():
-        assert set(names) == set(SERVE_SPANS)
+        assert set(SERVE_SPANS) <= set(names)
+        assert set(names) - set(SERVE_SPANS) <= set(SERVE_OPTIONAL_SPANS)
 
 
 # --- Chrome trace-event JSON schema ----------------------------------------

@@ -214,6 +214,18 @@ def run(argv=None) -> dict:
              "requests and zero jit-fallback dispatches per replica "
              "during hydration + storm"
     )
+    p.add_argument(
+        "--capacity", action="store_true",
+        help="program catalog & capacity plane (serve/catalog.py, "
+             "docs/observability.md 'Program costs & capacity'): share "
+             "ONE ProgramCatalog across the tier — XLA cost/memory "
+             "analysis recorded per compiled program, every dispatch "
+             "attributed to its program key — and assert the capacity "
+             "contract: every dispatched program has a catalog entry "
+             "(nonzero costs or an explicit unavailable marker), "
+             "serve_summary carries the capacity model, and the model's "
+             "traffic totals agree with the summary's own counters"
+    )
     args = p.parse_args(argv)
     if args.inject_fault == "none":
         args.inject_fault = ""
@@ -292,6 +304,17 @@ def run(argv=None) -> dict:
 
     from gnot_tpu.utils.cache import compile_cache_probe
 
+    # One catalog shared by every engine/server/router of the tier —
+    # attached BEFORE warmup/hydration so program entries are captured
+    # at startup (warmup compiles, snapshot hydration) and never on the
+    # storm's hot path. Registry and sink late-bind below.
+    catalog = None
+    if args.capacity:
+        from gnot_tpu.serve.catalog import ProgramCatalog
+
+        catalog = ProgramCatalog()
+        engine.attach_catalog(catalog)
+
     # Under --prewarm the probe spans replica build + hydration + the
     # whole storm: the assertion below is "the serving tier compiled
     # NOTHING", not just "warmup was warm".
@@ -305,6 +328,9 @@ def run(argv=None) -> dict:
                 engine.model, engine.params, args.replicas,
                 batch_size=args.max_batch,
             )
+            if catalog is not None:
+                for r in replicas:
+                    r.engine.attach_catalog(catalog)
             if manifest is None:
                 for r in replicas:
                     r.warm(traffic, rows=args.max_batch, pack_plan=pack_plan)
@@ -328,6 +354,11 @@ def run(argv=None) -> dict:
 
             registry = MetricsRegistry()
         with MetricsSink(metrics_path) as sink:
+            if catalog is not None:
+                # Entries recorded before this point (warmup captures,
+                # snapshot hydration) replay their program_catalog
+                # events into the now-open sink.
+                catalog.attach_outputs(metrics=registry, sink=sink)
             common = dict(
                 max_batch=args.max_batch,
                 max_wait_ms=args.max_wait_ms,
@@ -339,6 +370,7 @@ def run(argv=None) -> dict:
                 pack_plan=pack_plan,
                 session_snapshot_every=args.session_snapshot_every,
                 metrics=registry,
+                catalog=catalog,
             )
             if registry is not None:
                 w = dict(
@@ -546,6 +578,72 @@ def run(argv=None) -> dict:
         any(e.get("event") == "serve_summary" for e in events),
         "no serve_summary event in the sink",
     )
+    if args.capacity:
+        # The capacity contract (docs/observability.md "Program costs
+        # & capacity"): the catalog saw every dispatched program, and
+        # the cost x traffic join agrees with the summary's own
+        # counters number-for-number.
+        model = summary.get("capacity_model")
+        check(
+            bool(model),
+            "capacity mode on but serve_summary carries no capacity_model",
+        )
+        if model:
+            progs = model["programs"]
+            check(bool(progs), "capacity model recorded no programs")
+            missing = [
+                k for k, pr in progs.items() if pr["source"] is None
+            ]
+            check(
+                not missing,
+                f"dispatched programs missing catalog entries: {missing}",
+            )
+            for key, pr in progs.items():
+                c = pr["costs"]
+                check(
+                    any(c.get(f) for f in ("flops", "bytes_accessed"))
+                    or bool(c.get("unavailable")),
+                    f"program {key}: neither nonzero costs nor an "
+                    f"explicit unavailable marker: {c}",
+                )
+            check(
+                model["pool"]["dispatches"] == len(dispatches),
+                f"capacity model counted {model['pool']['dispatches']} "
+                f"dispatches != {len(dispatches)} dispatch events",
+            )
+            pw = summary.get("pad_waste_by_bucket") or {}
+            check(
+                model["pool"]["real_tokens"]
+                == sum(st["real_tokens"] for st in pw.values())
+                and model["pool"]["capacity_tokens"]
+                == sum(st["capacity_tokens"] for st in pw.values()),
+                "capacity model token totals disagree with "
+                "pad_waste_by_bucket",
+            )
+            cat_events = {
+                e["key"]
+                for e in events
+                if e.get("event") == "program_catalog"
+            }
+            check(
+                set(progs) <= cat_events,
+                f"programs without a program_catalog event: "
+                f"{sorted(set(progs) - cat_events)}",
+            )
+            snap_events = [
+                e for e in events if e.get("event") == "capacity_snapshot"
+            ]
+            check(
+                len(snap_events) == 1,
+                f"{len(snap_events)} capacity_snapshot events != 1",
+            )
+            print(
+                f"serve_smoke: capacity model {len(progs)} programs, "
+                f"pool sustainable "
+                f"{model['pool']['sustainable_tokens_per_s'] and round(model['pool']['sustainable_tokens_per_s'])} tok/s, "
+                f"useful_token_frac="
+                f"{model['pool']['useful_token_frac'] and round(model['pool']['useful_token_frac'], 4)}"
+            )
     if args.rollout:
         # The session contract (docs/serving.md "Rollout serving").
         migrated = {
