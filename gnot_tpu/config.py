@@ -12,6 +12,29 @@ import dataclasses
 from typing import Any
 
 
+def parse_tenant_spec(spec: str, *, what: str = "value") -> dict[str, str]:
+    """Parse a ``tenant:value,tenant:value`` spec string — the shared
+    grammar of ``--tenant_weights`` / ``--tenant_quotas`` /
+    ``--tenant_priorities`` (docs/serving.md "Multi-tenant isolation")
+    — into an ordered ``{tenant: raw value}`` dict. Empty string parses
+    to an empty dict; malformed entries and duplicate tenants raise.
+    Lives here (not serve/policies.py) so ``ServeConfig`` can validate
+    specs without importing the serving package."""
+    out: dict[str, str] = {}
+    for entry in filter(None, (e.strip() for e in spec.split(","))):
+        name, sep, value = entry.partition(":")
+        name, value = name.strip(), value.strip()
+        if not sep or not name or not value:
+            raise ValueError(
+                f"malformed tenant {what} entry {entry!r}; expected "
+                "'tenant:value,tenant:value'"
+            )
+        if name in out:
+            raise ValueError(f"duplicate tenant {name!r} in {what} spec")
+        out[name] = value
+    return out
+
+
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:
     """GNOT architecture hyperparameters (reference main.py:16-22)."""
@@ -442,6 +465,21 @@ class ServeConfig:
     # ids restart per process, so persisting them would let one run's
     # snapshots clobber another's. "" = off.
     session_dir: str = ""
+    # Multi-tenant isolation plane (serve/policies.py::TenantPolicy,
+    # docs/serving.md "Multi-tenant isolation"). Each knob is a
+    # ``tenant:value,...`` spec; any non-empty spec activates tenant
+    # mode (per-tenant WFQ sub-queues, quotas, priority tiers, tenant_*
+    # metrics/SLOs). All three empty = the historical single-tenant
+    # path, byte-for-byte. Weights are the per-tenant deficit-round-
+    # robin shares within a priority tier (integers >= 1; unlisted
+    # tenants weigh 1); quotas bound a tenant's in-system request count
+    # (fast-fail "shed_tenant_quota" beyond it; unlisted = unlimited);
+    # priorities assign "interactive" or "batch" (unlisted tenants are
+    # interactive — except one literally named "batch", so
+    # `--tenant_weights interactive:3,batch:1` does what it reads).
+    tenant_weights: str = ""
+    tenant_quotas: str = ""
+    tenant_priorities: str = ""
     # Deploy-time AOT prewarm manifest (tools/aot_prewarm.py,
     # docs/serving.md "Deploy-time prewarm"): when set, serving
     # hydrates each engine's executables from the manifest's
@@ -549,6 +587,30 @@ class ServeConfig:
                 "autoscale_heal_after_s must be > 0, got "
                 f"{self.autoscale_heal_after_s}"
             )
+        for t, w in parse_tenant_spec(
+            self.tenant_weights, what="weight"
+        ).items():
+            if not w.isdigit() or int(w) < 1:
+                raise ValueError(
+                    f"tenant weight for {t!r} must be an integer >= 1, "
+                    f"got {w!r}"
+                )
+        for t, q in parse_tenant_spec(
+            self.tenant_quotas, what="quota"
+        ).items():
+            if not q.isdigit() or int(q) < 1:
+                raise ValueError(
+                    f"tenant quota for {t!r} must be an integer >= 1, "
+                    f"got {q!r}"
+                )
+        for t, p in parse_tenant_spec(
+            self.tenant_priorities, what="priority"
+        ).items():
+            if p not in ("interactive", "batch"):
+                raise ValueError(
+                    f"tenant priority for {t!r} must be 'interactive' or "
+                    f"'batch', got {p!r}"
+                )
         from gnot_tpu.models.precision import SERVE_DTYPES
 
         if self.dtype not in SERVE_DTYPES:

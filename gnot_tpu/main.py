@@ -321,6 +321,32 @@ def build_parser() -> argparse.ArgumentParser:
         help="serving SLO: tolerated windowed shed fraction before "
              "the live metrics plane fires an slo_alert; 0 = off"
     )
+    p.add_argument(
+        "--tenant_weights", type=str, default="",
+        help="serving multi-tenant isolation (docs/serving.md): "
+             "per-tenant WFQ weights as tenant:weight pairs, e.g. "
+             "'interactive:3,batch:1' — the batcher drains each "
+             "bucket's per-tenant sub-queues deficit-round-robin by "
+             "these shares, so a flooding tenant cannot starve "
+             "siblings; empty (with the other tenant specs empty) = "
+             "tenant mode off, byte-identical single-tenant behavior"
+    )
+    p.add_argument(
+        "--tenant_quotas", type=str, default="",
+        help="serving multi-tenant isolation: per-tenant admission "
+             "quotas as tenant:limit pairs — a tenant at its pool-wide "
+             "in-system limit fast-fails new work in O(1) with reason "
+             "shed_tenant_quota (tenant_quota_shed event); unlisted "
+             "tenants are never quota-limited"
+    )
+    p.add_argument(
+        "--tenant_priorities", type=str, default="",
+        help="serving multi-tenant isolation: per-tenant priority "
+             "classes as tenant:class pairs (class 'interactive' or "
+             "'batch'); under contention batch-class work is deferred "
+             "first — brownout before blackout; unlisted tenants are "
+             "interactive (except one literally named 'batch')"
+    )
     p.add_argument("--checkpoint_every", type=int, default=0)
     p.add_argument(
         "--stop_after_epoch", type=int, default=0,
@@ -501,6 +527,9 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "serve.metrics_interval_s": args.metrics_interval_s,
             "serve.slo_p99_ms": args.slo_p99_ms,
             "serve.slo_shed_frac": args.slo_shed_frac,
+            "serve.tenant_weights": args.tenant_weights,
+            "serve.tenant_quotas": args.tenant_quotas,
+            "serve.tenant_priorities": args.tenant_priorities,
             "mesh.data": args.mesh_data,
             "mesh.seq": args.mesh_seq,
             "mesh.model": args.mesh_model,
@@ -1111,6 +1140,18 @@ def _run_serve(
                 f"serves {sc.dtype!r} — re-run tools/aot_prewarm.py "
                 "with the matching --serve_dtype"
             )
+    # Multi-tenant isolation plane (serve/policies.py, docs/serving.md
+    # "Multi-tenant isolation"): ONE TenantPolicy shared by every
+    # replica server — per-tenant WFQ weights at the batcher, pool-wide
+    # admission quotas, priority classes — or None (all three specs
+    # empty): tenant mode off, the byte-identical single-tenant path.
+    from gnot_tpu.serve import TenantPolicy
+
+    tenants = TenantPolicy.from_specs(
+        weights=sc.tenant_weights,
+        quotas=sc.tenant_quotas,
+        priorities=sc.tenant_priorities,
+    )
     # Live metrics plane (obs/metrics.py): one registry shared by the
     # whole serving tier (per-replica servers record replica-labeled
     # series that merge losslessly into the pool view), a publisher
@@ -1141,6 +1182,14 @@ def _run_serve(
             exposition_path=f"{stem}.prom",
             evaluator=metrics_lib.SLOEvaluator(
                 metrics_lib.default_objectives(sc)
+                # Per-tenant latency/shed objectives beside the pool
+                # ones: their slo_alert edges carry the tenant, the
+                # autoscaler's attribution signal.
+                + (
+                    metrics_lib.tenant_objectives(sc, tenants.tenants)
+                    if tenants is not None
+                    else []
+                )
             ),
         )
     session_store = None
@@ -1165,6 +1214,7 @@ def _run_serve(
             session_snapshot_every=sc.session_snapshot_every,
             metrics=registry,
             session_store=session_store,
+            tenants=tenants,
         )
         if replicas is not None:
             server = ReplicaRouter(
@@ -1266,6 +1316,7 @@ def _run_serve(
                 pack_plan=pack_plan,
                 prewarm_manifest=prewarm,
                 sink=sink,
+                tenants=tenants,
             ).start()
         rollout_k = sc.rollout_steps
         try:

@@ -62,6 +62,7 @@ REPLICA_REMOVE = "replica_remove"
 REPLICA_REPLACE = "replica_replace"
 PROGRAM_CATALOG = "program_catalog"
 CAPACITY_SNAPSHOT = "capacity_snapshot"
+TENANT_QUOTA_SHED = "tenant_quota_shed"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -162,8 +163,23 @@ EVENTS: dict[str, EventSpec] = {
         fields=("reason",),
         module="gnot_tpu/serve/server.py",
         doc="a request was shed/rejected (reason + per-reason detail; "
-        "a shed rollout SESSION carries its `session` id)",
-        optional=("trace_id", "trace_ids", "replica", "session", "step"),
+        "a shed rollout SESSION carries its `session` id; under a "
+        "tenant policy the submitter's `tenant` tags the record)",
+        optional=(
+            "trace_id", "trace_ids", "replica", "session", "step",
+            "tenant",
+        ),
+    ),
+    "tenant_quota_shed": EventSpec(
+        fields=("tenant", "quota", "in_system"),
+        module="gnot_tpu/serve/server.py",
+        doc="a request (or rollout step) fast-failed at the PER-TENANT "
+        "admission quota (serve/policies.py TenantPolicy): the tenant's "
+        "pool-wide in-system count was at its configured quota — shed "
+        "at the door with reason `shed_tenant_quota`, sibling tenants "
+        "unaffected; a quota-shed rollout step carries its `session` "
+        "and is terminal (never migrated — the policy is pool-shared)",
+        optional=("trace_id", "replica", "session"),
     ),
     "breaker_open": EventSpec(
         fields=("state", "reason", "detail", "trips"),
@@ -201,7 +217,7 @@ EVENTS: dict[str, EventSpec] = {
         "names the serving compute dtype the numbers were measured at",
         optional=(
             "queue_device_by_bucket", "pad_waste_by_bucket", "replica",
-            "per_replica", "routing", "dtype", "sessions",
+            "per_replica", "routing", "dtype", "sessions", "tenants",
         ),
     ),
     "route": EventSpec(
@@ -309,8 +325,10 @@ EVENTS: dict[str, EventSpec] = {
         doc="an SLO objective crossed a burn-rate EDGE: `state` is "
         "'fire' (burn >= 1 in BOTH the fast and slow windows) or "
         "'clear' (the fast window recovered) — never level-triggered "
-        "spam; `value` carries the observed quantity",
-        optional=("value", "fast_window_s", "slow_window_s"),
+        "spam; `value` carries the observed quantity; a tenant-scoped "
+        "objective (`latency_p99:<tenant>`) carries the `tenant` "
+        "burning the budget — the autoscaler's attribution signal",
+        optional=("value", "fast_window_s", "slow_window_s", "tenant"),
     ),
     "autoscale_decision": EventSpec(
         fields=("action", "reason", "pool", "min", "max"),
@@ -319,7 +337,9 @@ EVENTS: dict[str, EventSpec] = {
         "stability guard): `action` is 'scale_up' | 'scale_down' | "
         "'replace' | 'hold'; a 'hold' names the guard that vetoed a "
         "wanted move (cooldown_up | cooldown_down | cooldown_heal | "
-        "at_max | flap_suppressed | last_replica) and is emitted on "
+        "at_max | flap_suppressed | last_replica | batch_deferral — "
+        "pressure owned entirely by batch-class tenants is answered "
+        "by deferral, not replicas) and is emitted on "
         "EDGES only (a steady veto stays silent); `load` is the "
         "per-replica in-system load the decision read, `alerts` the "
         "active SLO objectives",

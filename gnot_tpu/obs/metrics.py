@@ -476,12 +476,19 @@ def snap_gauge(snap: dict, name: str) -> float:
     )
 
 
-def snap_histogram(snap: dict, name: str) -> LogHistogram:
-    """Merged histogram of every series named ``name`` in one row."""
+def snap_histogram(snap: dict, name: str, label: str | None = None,
+                   value: str | None = None) -> LogHistogram:
+    """Merged histogram of every series named ``name`` in one row,
+    optionally filtered to ``labels[label] == value`` (the per-tenant
+    latency read: replica-labeled sub-series of one tenant merge
+    losslessly into that tenant's pool view)."""
     out = LogHistogram()
     for row in snap.values():
-        if row.get("type") == "histogram" and row.get("name") == name:
-            out.merge(LogHistogram.from_state(row))
+        if row.get("type") != "histogram" or row.get("name") != name:
+            continue
+        if label is not None and str(row["labels"].get(label)) != str(value):
+            continue
+        out.merge(LogHistogram.from_state(row))
     return out
 
 
@@ -580,11 +587,18 @@ class SLOObjective:
     """One declared objective: a ``kind`` (how to read the snapshot
     history), a ``threshold`` (burn = observed / threshold), and the
     fast/slow burn windows. ``clear_frac`` is the hysteresis: an active
-    alert clears when the FAST burn drops below it."""
+    alert clears when the FAST burn drops below it.
+
+    ``tenant`` scopes the objective to ONE tenant's series
+    (``tenant_latency_ms`` / ``tenant_shed_total`` filtered by the
+    tenant label) instead of the pool aggregates — the attribution the
+    autoscaler needs to tell "interactive is burning budget" from
+    "batch is flooding" (only the latency/shed kinds are per-tenant;
+    breaker/wedge/depth are pool properties)."""
 
     __slots__ = (
         "name", "kind", "threshold", "fast_window_s", "slow_window_s",
-        "clear_frac",
+        "clear_frac", "tenant",
     )
 
     def __init__(
@@ -596,6 +610,7 @@ class SLOObjective:
         fast_window_s: float = 5.0,
         slow_window_s: float = 30.0,
         clear_frac: float = 1.0,
+        tenant: str | None = None,
     ):
         if kind not in SLO_KINDS:
             raise ValueError(f"unknown SLO kind {kind!r}; one of {SLO_KINDS}")
@@ -606,12 +621,20 @@ class SLOObjective:
                 "need 0 < fast_window_s <= slow_window_s, got "
                 f"{fast_window_s}/{slow_window_s}"
             )
+        if tenant is not None and kind not in (
+            "p99_latency_ms", "shed_frac"
+        ):
+            raise ValueError(
+                f"SLO kind {kind!r} cannot be tenant-scoped (pool "
+                "property); only p99_latency_ms/shed_frac can"
+            )
         self.name = name
         self.kind = kind
         self.threshold = float(threshold)
         self.fast_window_s = float(fast_window_s)
         self.slow_window_s = float(slow_window_s)
         self.clear_frac = float(clear_frac)
+        self.tenant = tenant
 
 
 def default_objectives(sc) -> list[SLOObjective]:
@@ -641,6 +664,34 @@ def default_objectives(sc) -> list[SLOObjective]:
         )
     )
     out.append(SLOObjective("session_loss", "session_loss", 1.0, **w))
+    return out
+
+
+def tenant_objectives(sc, tenants: Iterable[str]) -> list[SLOObjective]:
+    """Per-tenant latency/shed objectives beside the pool ones: for
+    each tenant the policy names, ``latency_p99:<tenant>`` (when
+    ``slo_p99_ms`` is set) and ``shed_fraction:<tenant>`` (when
+    ``slo_shed_frac`` is set), each reading ONLY that tenant's series.
+    Their ``slo_alert`` edges carry the tenant — the attributed
+    pressure signal the autoscaler's batch-deferral veto reads."""
+    fast, slow = sc.slo_fast_window_s, sc.slo_slow_window_s
+    w = dict(fast_window_s=fast, slow_window_s=slow)
+    out = []
+    for t in tenants:
+        if sc.slo_p99_ms > 0:
+            out.append(
+                SLOObjective(
+                    f"latency_p99:{t}", "p99_latency_ms", sc.slo_p99_ms,
+                    tenant=t, **w,
+                )
+            )
+        if sc.slo_shed_frac > 0:
+            out.append(
+                SLOObjective(
+                    f"shed_fraction:{t}", "shed_frac", sc.slo_shed_frac,
+                    tenant=t, **w,
+                )
+            )
     return out
 
 
@@ -684,9 +735,18 @@ class SLOEvaluator:
         base = self._window_base(now, window_s)
         kind = obj.kind
         if kind == "p99_latency_ms":
-            now_h = snap_histogram(snap, "serve_request_latency_ms").state()
+            # Tenant-scoped objectives read the tenant-labeled series;
+            # pool objectives read the pool aggregate, exactly as
+            # before.
+            if obj.tenant is not None:
+                name, flt = "tenant_latency_ms", {
+                    "label": "tenant", "value": obj.tenant,
+                }
+            else:
+                name, flt = "serve_request_latency_ms", {}
+            now_h = snap_histogram(snap, name, **flt).state()
             base_h = (
-                snap_histogram(base, "serve_request_latency_ms").state()
+                snap_histogram(base, name, **flt).state()
                 if base is not None
                 else None
             )
@@ -695,11 +755,21 @@ class SLOEvaluator:
                 return 0.0, None
             return p99 / obj.threshold, p99
         if kind == "shed_frac":
-            shed = snap_counter(snap, "serve_shed_total")
-            reqs = snap_counter(snap, "serve_requests_total")
+            if obj.tenant is not None:
+                shed_name, req_name = (
+                    "tenant_shed_total", "tenant_requests_total",
+                )
+                flt = {"label": "tenant", "value": obj.tenant}
+            else:
+                shed_name, req_name = (
+                    "serve_shed_total", "serve_requests_total",
+                )
+                flt = {}
+            shed = snap_counter(snap, shed_name, **flt)
+            reqs = snap_counter(snap, req_name, **flt)
             if base is not None:
-                shed -= snap_counter(base, "serve_shed_total")
-                reqs -= snap_counter(base, "serve_requests_total")
+                shed -= snap_counter(base, shed_name, **flt)
+                reqs -= snap_counter(base, req_name, **flt)
             # Sheds resolve LATER than their submissions, so a window
             # can hold sheds with few (or zero) new requests — the
             # denominator is everything that MOVED in the window, never
@@ -776,6 +846,9 @@ class SLOEvaluator:
             "value": value,
             "fast_window_s": obj.fast_window_s,
             "slow_window_s": obj.slow_window_s,
+            # Tenant-scoped objectives attribute their edges: the
+            # autoscaler's deferral-vs-scale decision reads this.
+            **({"tenant": obj.tenant} if obj.tenant is not None else {}),
         }
 
     def active(self) -> dict[str, bool]:

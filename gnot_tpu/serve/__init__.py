@@ -61,6 +61,16 @@ from the live metrics plane to pool membership:
   drained session resumes across server restarts
   (``resume_rollout``) from its last snapshotted step.
 
+Multi-tenant isolation (docs/serving.md "Multi-tenant isolation"):
+``policies.TenantPolicy`` composes per-tenant WFQ weights (the batcher
+drains per-tenant sub-queues deficit-round-robin within priority
+tiers), pool-wide admission quotas (O(1) ``shed_tenant_quota``
+fast-fail), and interactive/batch priority classes; per-tenant SLO
+objectives attribute autoscale pressure to the tenant burning budget,
+and batch-only pressure is answered by deferral instead of replicas.
+With no tenant specs configured the plane is entirely absent — the
+single-tenant path is byte-for-byte unchanged.
+
 Chaos-tested on CPU via the serve-side fault kinds in
 ``resilience.faults`` (``slow_request@N``, ``nan_output@N``,
 ``reload_corrupt@N``) — tests/test_serve.py, tests/test_autoscale.py.
@@ -72,11 +82,14 @@ from gnot_tpu.serve import rollout  # noqa: F401
 from gnot_tpu.serve.batcher import Batcher  # noqa: F401
 from gnot_tpu.serve.engine import InferenceEngine  # noqa: F401
 from gnot_tpu.serve.policies import (  # noqa: F401
+    DEFAULT_TENANT,
+    PRIORITY_CLASSES,
     ROUTE_POLICIES,
     AdmissionController,
     CircuitBreaker,
     Deadline,
     ReplicaHealthPolicy,
+    TenantPolicy,
 )
 from gnot_tpu.serve.replica import (  # noqa: F401
     EngineReplica,

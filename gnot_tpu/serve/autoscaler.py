@@ -111,6 +111,7 @@ class AutoscaleController:
         prewarm_manifest: dict | None = None,
         sink=None,
         clock: Callable[[], float] = time.monotonic,
+        tenants=None,
     ):
         if not 1 <= min_replicas <= max_replicas:
             raise ValueError(
@@ -162,6 +163,13 @@ class AutoscaleController:
         self.pack_plan = pack_plan
         self.prewarm_manifest = prewarm_manifest
         self.sink = sink
+        # TenantPolicy (serve/policies.py), when the pool is multi-
+        # tenant: per-tenant slo_alert edges (latency_p99:<tenant>)
+        # ATTRIBUTE scale-out to the tenant burning budget, and
+        # pressure owned entirely by batch-class tenants is answered by
+        # deferral (WFQ/priority already shields interactive), not
+        # replicas — the batch-deferral veto.
+        self.tenants = tenants
         self._clock = clock
         pool = router.pool()
         # Slot ledger: founding replicas occupy the first slots in pool
@@ -254,7 +262,14 @@ class AutoscaleController:
         n = len(pool)
         load = self.observed_load()
         alerts = self._active_alerts()
-        pressure = [a for a in alerts if a in self.pressure_objectives]
+        # Tenant-scoped alerts are named ``<objective>:<tenant>``
+        # (metrics.tenant_objectives); their BASE name decides whether
+        # they are capacity pressure, and their suffix attributes it.
+        pressure = [
+            a
+            for a in alerts
+            if a.split(":", 1)[0] in self.pressure_objectives
+        ]
         want_up = load >= self.up_load or bool(pressure)
         calm = load <= self.down_load and not alerts
         with self._lock:
@@ -270,8 +285,32 @@ class AutoscaleController:
                 return self._hold(now, n, "cooldown_up", load, alerts)
             return self._scale_up(now, n, "below_min", load, alerts)
         if want_up:
+            # Batch-deferral veto: when the ONLY pressure is SLO burn
+            # attributed entirely to batch-class tenants (raw load is
+            # below up_load), the right answer is deferral — WFQ +
+            # priority classes already push the pain onto batch work —
+            # not buying replicas for a flood the policy exists to
+            # absorb. Interactive-attributed or pool-level burn still
+            # scales out.
+            if (
+                self.tenants is not None
+                and pressure
+                and load < self.up_load
+                and all(
+                    ":" in a
+                    and self.tenants.priority(a.split(":", 1)[1])
+                    == "batch"
+                    for a in pressure
+                )
+            ):
+                return self._hold(now, n, "batch_deferral", load, alerts)
+            # Attribution: prefer a tenant-scoped alert for the reason
+            # (``slo:latency_p99:alice`` names who is burning budget).
+            attributed = [a for a in pressure if ":" in a]
             reason = (
-                f"slo:{pressure[0]}" if pressure else "load"
+                f"slo:{(attributed or pressure)[0]}"
+                if pressure
+                else "load"
             )
             if n >= self.max_replicas:
                 return self._hold(now, n, "at_max", load, alerts)

@@ -23,11 +23,62 @@ per-bucket latency is arrival-ordered — which also makes the server's
 interval the deadline shed reports as ``waited_ms``) monotone within a
 bucket: a request never overtakes an older batchmate, so a trace's
 queue-wait outlier always indicts real queueing, not reordering.
+
+Multi-tenant mode (``tenants=`` a ``policies.TenantPolicy``): each
+bucket holds per-TENANT FIFO sub-queues drained by weighted fair
+queueing — strict priority tiers first (every ``interactive``-class
+tenant before any ``batch``-class one: brownout before blackout), then
+deficit-round-robin by configured weight within a tier, FIFO within a
+tenant. A flooding tenant therefore cannot starve siblings (each
+non-empty sibling receives at least ``weight`` slots per DRR round),
+the bucket invariants above survive unchanged (sub-queues never span
+buckets), and ``pack_prefix``'s arrival-order contract holds WITHIN
+each tenant (the packed take consumes the WFQ order, which is FIFO per
+tenant). Age is per REQUEST across every sub-queue: the flush clock
+reads the oldest arrival of the whole bucket, so ``max_wait_ms`` bounds
+the queue wait of the lowest-weight tenant's head too — WFQ shapes
+ORDER under contention, never starvation. ``tenants=None`` (the
+default) leaves every path above byte-for-byte identical to the
+single-FIFO batcher.
 """
 
 from __future__ import annotations
 
 from typing import Callable, Hashable, Iterable
+
+
+class _TenantQueues:
+    """One bucket's per-tenant FIFO sub-queues plus its WFQ ring (the
+    tenant service order, rotated past the last-served tenant after
+    each cut so remainder slots do not always favor the first tenant).
+    Internal to ``Batcher``'s tenant mode."""
+
+    __slots__ = ("queues", "ring")
+
+    def __init__(self):
+        self.queues: dict[Hashable, list] = {}  # tenant -> [(req, arrival)]
+        self.ring: list = []  # tenant service order
+
+    def size(self) -> int:
+        return sum(len(q) for q in self.queues.values())
+
+    def oldest(self) -> float:
+        """Oldest arrival across ALL sub-queues (each head is its
+        queue's oldest — FIFO within tenant), i.e. the whole bucket's
+        per-request age clock."""
+        return min(q[0][1] for q in self.queues.values() if q)
+
+    def add(self, tenant, request, now: float) -> None:
+        q = self.queues.get(tenant)
+        if q is None:
+            q = self.queues[tenant] = []
+            self.ring.append(tenant)
+        q.append((request, now))
+
+    def prune(self) -> None:
+        for t in [t for t, q in self.queues.items() if not q]:
+            del self.queues[t]
+            self.ring.remove(t)
 
 
 class Batcher:
@@ -45,6 +96,8 @@ class Batcher:
         max_wait_ms: float,
         key_fn: Callable[[object], Hashable],
         take_fn: Callable[[Hashable, list], int | None] | None = None,
+        tenants=None,
+        tenant_fn: Callable[[object], Hashable] | None = None,
     ):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -60,16 +113,33 @@ class Batcher:
         # max_batch discipline. A bucket whose prefix-take is smaller
         # than its queue is FULL (one whole dispatch is ready).
         self.take_fn = take_fn
-        # Per-bucket FIFO of (request, arrival) pairs: ages are
-        # per-request, so a leftover surviving a size-based flush keeps
-        # its true arrival time and the max_wait bound holds for it too
-        # (a bucket-level "oldest" stamp would reset its clock).
-        self._pending: dict[Hashable, list] = {}
+        # Multi-tenant WFQ mode (docstring above): ``tenants`` supplies
+        # weight(t)/priority(t); ``tenant_fn(request)`` names a
+        # request's tenant (the server maps untagged requests to the
+        # default tenant). None = single-FIFO mode, byte-for-byte the
+        # pre-tenant batcher.
+        self.tenants = tenants
+        self.tenant_fn = tenant_fn or (lambda r: getattr(r, "tenant", None))
+        # Per-bucket FIFO of (request, arrival) pairs — or, in tenant
+        # mode, a ``_TenantQueues``. Ages are per-request either way,
+        # so a leftover surviving a size-based flush keeps its true
+        # arrival time and the max_wait bound holds for it too (a
+        # bucket-level "oldest" stamp would reset its clock).
+        self._pending: dict[Hashable, list | _TenantQueues] = {}
 
     def __len__(self) -> int:
+        if self.tenants is not None:
+            return sum(b.size() for b in self._pending.values())
         return sum(len(v) for v in self._pending.values())
 
     def add(self, request, now: float) -> None:
+        if self.tenants is not None:
+            key = self.key_fn(request)
+            b = self._pending.get(key)
+            if b is None:
+                b = self._pending[key] = _TenantQueues()
+            b.add(self.tenant_fn(request), request, now)
+            return
         self._pending.setdefault(self.key_fn(request), []).append(
             (request, now)
         )
@@ -98,7 +168,10 @@ class Batcher:
         FIFO prefix the packer says fits one dispatch; such a bucket is
         FULL when its prefix-take is smaller than its queue (one whole
         dispatch is ready and the next arrival already spills). An
-        overfull bucket yields several batches in arrival order."""
+        overfull bucket yields several batches in arrival order — WFQ
+        order in tenant mode (see module docstring)."""
+        if self.tenants is not None:
+            return self._pop_ready_wfq(now, flush_all)
         out: list[tuple[Hashable, list]] = []
         for key in list(self._pending):
             q = self._pending[key]
@@ -138,17 +211,110 @@ class Batcher:
                 del self._pending[key]
         return out
 
+    # -- tenant-mode (WFQ) internals --------------------------------------
+
+    def _wfq_order(self, b: _TenantQueues) -> list:
+        """The bucket's full dispatch order as ``(tenant, request)``
+        pairs WITHOUT mutating state: strict priority tiers
+        (interactive before batch), deficit-round-robin by weight
+        within a tier (quantum = weight, cost 1/request, deficit reset
+        when a tenant's queue runs dry — no banking while idle), FIFO
+        within a tenant. A cut of n commits exactly the first n of this
+        sequence, so stopping early never reorders."""
+        pol = self.tenants
+        seq: list = []
+        cursor = dict.fromkeys(b.ring, 0)
+        for tier in ("interactive", "batch"):
+            ring = [t for t in b.ring if pol.priority(t) == tier]
+            deficit = dict.fromkeys(ring, 0.0)
+            while any(cursor[t] < len(b.queues[t]) for t in ring):
+                for t in ring:
+                    q = b.queues[t]
+                    if cursor[t] >= len(q):
+                        deficit[t] = 0.0
+                        continue
+                    deficit[t] += pol.weight(t)
+                    while cursor[t] < len(q) and deficit[t] >= 1.0:
+                        seq.append((t, q[cursor[t]][0]))
+                        cursor[t] += 1
+                        deficit[t] -= 1.0
+        return seq
+
+    def _cut(self, b: _TenantQueues, seq: list, n: int) -> list:
+        """Commit the first ``n`` emissions of ``seq``: pop each
+        tenant's head in order (the sequence is FIFO per tenant, so the
+        heads ARE the emitted requests), rotate the ring past the
+        last-served tenant, prune emptied sub-queues."""
+        batch = []
+        for t, _ in seq[:n]:
+            batch.append(b.queues[t].pop(0)[0])
+        if n and len(b.ring) > 1:
+            i = b.ring.index(seq[n - 1][0])
+            b.ring = b.ring[i + 1:] + b.ring[: i + 1]
+        b.prune()
+        return batch
+
+    def _pop_ready_wfq(
+        self, now: float, flush_all: bool
+    ) -> list[tuple[Hashable, list]]:
+        out: list[tuple[Hashable, list]] = []
+        for key in list(self._pending):
+            b = self._pending[key]
+            while b.size():
+                seq = self._wfq_order(b)
+                take = None
+                if self.take_fn is not None:
+                    n = self.take_fn(key, [r for _, r in seq])
+                    if n is not None:
+                        take = max(1, min(n, len(seq)))
+                # Per-REQUEST age across every sub-queue: the oldest
+                # head anywhere in the bucket starts the flush clock,
+                # so max_wait_ms bounds the lowest-weight tenant's
+                # queue wait too (not just the sub-queue WFQ happens to
+                # favor).
+                aged = now - b.oldest() >= self.max_wait_s
+                if take is None:
+                    if flush_all or len(seq) >= self.max_batch:
+                        out.append(
+                            (key, self._cut(b, seq, min(self.max_batch,
+                                                        len(seq))))
+                        )
+                        continue
+                    if aged:
+                        # Aged flush of a partial bucket: take it all —
+                        # the oldest entry (whatever its tenant) has
+                        # already waited its budget.
+                        out.append((key, self._cut(b, seq, len(seq))))
+                    break
+                else:
+                    if flush_all or take < len(seq) or aged:
+                        out.append((key, self._cut(b, seq, take)))
+                        continue
+                    break
+            if not b.size():
+                self._pending.pop(key, None)
+        return out
+
     def next_flush_in(self, now: float) -> float | None:
         """Seconds until the next age-based flush (0 when one is
         already due), or None when empty — the worker's poll timeout,
         so an idle server blocks instead of spinning."""
         if not self._pending:
             return None
-        due = min(q[0][1] for q in self._pending.values()) + self.max_wait_s
-        return max(0.0, due - now)
+        if self.tenants is not None:
+            due = min(b.oldest() for b in self._pending.values())
+        else:
+            due = min(q[0][1] for q in self._pending.values())
+        return max(0.0, due + self.max_wait_s - now)
 
     def requests(self) -> Iterable:
         """All pending requests (shed/cancel sweeps during drain)."""
+        if self.tenants is not None:
+            for b in self._pending.values():
+                for q in b.queues.values():
+                    for r, _ in q:
+                        yield r
+            return
         for q in self._pending.values():
             for r, _ in q:
                 yield r

@@ -73,6 +73,117 @@ class AdmissionController:
             self._n -= 1
 
 
+#: The implicit tenant of untagged traffic under an active
+#: ``TenantPolicy``: weight 1, interactive class, no quota — i.e. the
+#: historical single-tenant behavior. (With NO policy configured,
+#: requests carry no tenant at all and every path stays byte-for-byte
+#: pre-tenant.)
+DEFAULT_TENANT = "default"
+
+#: Priority classes, highest first: under overload, ``batch`` work is
+#: deferred/shed FIRST — brownout before blackout (docs/serving.md
+#: "Multi-tenant isolation").
+PRIORITY_CLASSES = ("interactive", "batch")
+
+
+class TenantPolicy:
+    """Per-tenant isolation policy — WFQ weights, admission quotas, and
+    priority classes — parsed from the config's three spec strings
+    (``--tenant_weights interactive:3,batch:1``) and composed by the
+    batcher/server/autoscaler (docs/serving.md "Multi-tenant
+    isolation"):
+
+    * ``weight(t)`` — the tenant's deficit-round-robin share within its
+      priority tier (unlisted tenants weigh 1);
+    * ``priority(t)`` — ``"interactive"`` or ``"batch"``: strict drain
+      order under contention (batch defers first). Unlisted tenants are
+      interactive — except one literally NAMED "batch", so the README's
+      two-tenant example reads the way it behaves;
+    * ``try_admit(t)`` / ``release(t)`` — per-tenant bounded in-system
+      count: one ``AdmissionController`` per quota'd tenant, O(1)
+      fast-fail (``shed_tenant_quota``); tenants without a quota are
+      never quota-limited.
+
+    One policy object can be SHARED across a replica pool (the router
+    passes it to every replica): the admission controllers are
+    internally locked, so a tenant's quota bounds its pool-wide
+    in-system count. Weights/priorities are frozen at construction.
+    """
+
+    def __init__(self, *, weights=None, quotas=None, priorities=None):
+        self.weights = {t: int(w) for t, w in dict(weights or {}).items()}
+        self.quotas = {t: int(q) for t, q in dict(quotas or {}).items()}
+        self.priorities = dict(priorities or {})
+        for t, w in self.weights.items():
+            if w < 1:
+                raise ValueError(
+                    f"tenant weight for {t!r} must be >= 1, got {w}"
+                )
+        for t, p in self.priorities.items():
+            if p not in PRIORITY_CLASSES:
+                raise ValueError(
+                    f"tenant priority for {t!r} must be one of "
+                    f"{PRIORITY_CLASSES}, got {p!r}"
+                )
+        # AdmissionController validates quota >= 1.
+        self._admission = {
+            t: AdmissionController(q) for t, q in self.quotas.items()
+        }
+
+    @classmethod
+    def from_specs(
+        cls, weights: str = "", quotas: str = "", priorities: str = ""
+    ) -> "TenantPolicy | None":
+        """Build from the raw ``ServeConfig`` spec strings; all three
+        empty returns None (tenant mode off — the byte-for-byte
+        single-tenant path)."""
+        if not (weights or quotas or priorities):
+            return None
+        from gnot_tpu.config import parse_tenant_spec
+
+        return cls(
+            weights=parse_tenant_spec(weights, what="weight"),
+            quotas=parse_tenant_spec(quotas, what="quota"),
+            priorities=parse_tenant_spec(priorities, what="priority"),
+        )
+
+    @property
+    def tenants(self) -> list[str]:
+        """Every tenant any spec names (sorted; the metrics/SLO plane
+        pre-registers series for these)."""
+        return sorted(
+            set(self.weights) | set(self.quotas) | set(self.priorities)
+        )
+
+    def weight(self, tenant: str) -> int:
+        return self.weights.get(tenant, 1)
+
+    def priority(self, tenant: str) -> str:
+        p = self.priorities.get(tenant)
+        if p is None:
+            p = "batch" if tenant == "batch" else "interactive"
+        return p
+
+    def quota(self, tenant: str) -> int | None:
+        a = self._admission.get(tenant)
+        return a.limit if a is not None else None
+
+    def in_system(self, tenant: str) -> int:
+        a = self._admission.get(tenant)
+        return a.depth if a is not None else 0
+
+    def try_admit(self, tenant: str) -> bool:
+        """O(1) per-tenant quota gate; True for un-quota'd tenants."""
+        a = self._admission.get(tenant)
+        return True if a is None else a.try_admit()
+
+    def release(self, tenant: str) -> None:
+        """One of this tenant's admitted requests left the system."""
+        a = self._admission.get(tenant)
+        if a is not None:
+            a.release()
+
+
 #: Router request-placement policies (serve/router.py, docs/serving.md
 #: "Replicated serving"). ``affinity`` is the default: prefer a replica
 #: that has already compiled the request's bucket, so steady-state

@@ -195,6 +195,7 @@ class RolloutSession:
         on_step: Callable | None = None,
         advance: Callable = advance_sample,
         dt: float = ROLLOUT_DT,
+        tenant: str | None = None,
     ):
         if steps < 1:
             raise ValueError(f"rollout needs steps >= 1, got {steps}")
@@ -212,6 +213,12 @@ class RolloutSession:
         self.on_step = on_step
         self.advance = advance
         self.dt = dt
+        #: The submitter's tenant identity (docs/serving.md
+        #: "Multi-tenant isolation"), inherited by every step request
+        #: the session enqueues — and carried through snapshot_state/
+        #: from_state, so a migrated or resumed session keeps billing
+        #: the SAME tenant's quota/WFQ share. None = untagged.
+        self.tenant = tenant
         self.future = RolloutFuture()
         #: True for client-NAMED sessions (``submit_rollout(name=)``):
         #: only those persist to a ``SessionStore`` on drain — an
@@ -315,6 +322,7 @@ class RolloutSession:
                 "sample": snap["sample"],
                 "outputs": list(snap["outputs"]),
                 "dt": self.dt,
+                "tenant": self.tenant,
             }
 
     @classmethod
@@ -342,6 +350,7 @@ class RolloutSession:
             on_step=on_step,
             advance=advance,
             dt=state.get("dt", ROLLOUT_DT),
+            tenant=state.get("tenant"),
         )
         s.named = True  # only named sessions are ever persisted
         with s._lock:
@@ -470,6 +479,7 @@ class SessionStore:
             "steps": state["steps"],
             "cursor": state["cursor"],
             "dt": state["dt"],
+            "tenant": state.get("tenant"),
             "n_funcs": len(sample.funcs),
             "n_outputs": len(state["outputs"]),
         }
@@ -502,6 +512,7 @@ class SessionStore:
             "steps": meta["steps"],
             "cursor": meta["cursor"],
             "dt": meta["dt"],
+            "tenant": meta.get("tenant"),
             "sample": sample,
             "outputs": outputs,
         }

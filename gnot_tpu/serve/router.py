@@ -133,6 +133,7 @@ class ReplicaRouter:
         metrics=None,
         session_store=None,
         catalog=None,
+        tenants=None,
     ):
         if not replicas:
             raise ValueError("ReplicaRouter needs at least one replica")
@@ -181,7 +182,14 @@ class ReplicaRouter:
             metrics=metrics,
             session_store=session_store,
             catalog=catalog,
+            tenants=tenants,
         )
+        # ONE TenantPolicy shared by every replica server (scale-outs
+        # included, via _server_kwargs): the per-tenant admission
+        # controllers are internally locked, so a tenant's quota bounds
+        # its POOL-WIDE in-system count, and WFQ weights/priorities are
+        # identical at every batcher.
+        self.tenants = tenants
         # Shared program catalog (serve/catalog.py): every replica's
         # server attributes its dispatches into the ONE catalog (keys
         # are dtype-scoped program signatures; traffic rows carry the
@@ -269,6 +277,10 @@ class ReplicaRouter:
         self._retired: dict[int, dict] = {}  #: guarded_by _lock
         self._retired_hist = LogHistogram()
         self._retired_step_hist = LogHistogram()
+        # Per-tenant latency histograms of retired replicas (same
+        # retention contract as _retired_hist: a scale-in never drops a
+        # tenant's served latencies from the pool tenants rollup).
+        self._retired_tenant_hists: dict = {}  #: guarded_by _lock
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -477,6 +489,10 @@ class ReplicaRouter:
             # ordering hazard.)
             self._retired_hist.merge(srv.latency_histogram())
             self._retired_step_hist.merge(srv.step_latency_histogram())
+            for t, h in srv.tenant_rollup()["hists"].items():
+                self._retired_tenant_hists.setdefault(
+                    t, LogHistogram()
+                ).merge(h)
             self.replicas = [
                 r for r in self.replicas if r.replica_id != replica_id
             ]
@@ -544,11 +560,18 @@ class ReplicaRouter:
     # -- placement ---------------------------------------------------------
 
     def submit(
-        self, sample: MeshSample, *, deadline_ms: float | None = None
+        self,
+        sample: MeshSample,
+        *,
+        deadline_ms: float | None = None,
+        tenant: str | None = None,
     ) -> Future:
         """Route one request to a replica and submit it there. The
         returned Future resolves exactly as a single server's would —
-        the router adds placement, never a new failure mode."""
+        the router adds placement, never a new failure mode. ``tenant``
+        tags the request for the isolation plane (quota/WFQ/priority at
+        the placed replica); placement itself is tenant-blind — fairness
+        is enforced where queues live, not where routing happens."""
         key, label = self._bucket_of(sample)
         replica, reason = self._place(key)
         with self._lock:
@@ -567,7 +590,9 @@ class ReplicaRouter:
             depth=replica.server.depth(),
             dtype=self._dtype,
         )
-        return replica.server.submit(sample, deadline_ms=deadline_ms)
+        return replica.server.submit(
+            sample, deadline_ms=deadline_ms, tenant=tenant
+        )
 
     def _note_route(self, reason: str) -> None:
         """One placement decision into the live registry: the per-
@@ -714,6 +739,7 @@ class ReplicaRouter:
         rollout_deadline_ms: float | None = None,
         on_step=None,
         name: str | None = None,
+        tenant: str | None = None,
     ) -> RolloutFuture:
         """Place one autoregressive rollout session. The FIRST step
         routes like any request (health gate + affinity/policy — one
@@ -757,6 +783,7 @@ class ReplicaRouter:
                 else None
             ),
             on_step=on_step,
+            tenant=tenant,
         )
         session.named = name is not None
         session.migrate_cb = self._session_failed
@@ -1008,6 +1035,9 @@ class ReplicaRouter:
             retired = dict(self._retired)
             retired_hist = self._retired_hist.copy()
             retired_step_hist = self._retired_step_hist.copy()
+            retired_tenant_hists = {
+                t: h.copy() for t, h in self._retired_tenant_hists.items()
+            }
         retired_ids = set(retired)
         for rid, ret in retired.items():
             per[rid] = ret["summary"]
@@ -1044,6 +1074,30 @@ class ReplicaRouter:
             st["pad_waste_frac"] = (
                 1.0 - st["real_tokens"] / cap if cap else None
             )
+        # Pool-level tenant rollup: counts sum from the per-replica
+        # summaries (retired ones included — their final summaries are
+        # in `per`); percentiles merge the per-tenant histograms of the
+        # LIVE replicas plus the retired-tenant ledger, the same
+        # lossless log-bucket merge as the request latencies. Empty
+        # (and therefore absent from the pool summary) unless some
+        # request actually carried a tenant — the single-tenant path
+        # stays byte-for-byte.
+        tenants_roll: dict[str, dict] = {}
+        for s in per.values():
+            for t, st in (s.get("tenants") or {}).items():
+                agg = tenants_roll.setdefault(
+                    t, {"requests": 0, "completed": 0, "shed": {}}
+                )
+                agg["requests"] += st["requests"]
+                agg["completed"] += st["completed"]
+                for reason, n in st["shed"].items():
+                    agg["shed"][reason] = agg["shed"].get(reason, 0) + n
+        tenant_hists: dict[str, LogHistogram] = {
+            t: h.copy() for t, h in retired_tenant_hists.items()
+        }
+        for r in live:
+            for t, h in r.server.tenant_rollup()["hists"].items():
+                tenant_hists.setdefault(t, LogHistogram()).merge(h)
         warm_by_id = {r.replica_id: r.warm_stats for r in pool}
         warm_by_id.update(
             {rid: ret["warm_stats"] for rid, ret in retired.items()}
@@ -1120,6 +1174,23 @@ class ReplicaRouter:
                 "rollouts": rollouts,
             },
         }
+        if tenants_roll:
+            summary["tenants"] = {
+                t: {
+                    **agg,
+                    "latency_p50_ms": (
+                        tenant_hists[t].percentile(0.50)
+                        if t in tenant_hists
+                        else None
+                    ),
+                    "latency_p99_ms": (
+                        tenant_hists[t].percentile(0.99)
+                        if t in tenant_hists
+                        else None
+                    ),
+                }
+                for t, agg in sorted(tenants_roll.items())
+            }
         if sessions_started:
             summary["sessions"] = {
                 "started": sessions_started,

@@ -86,6 +86,7 @@ from gnot_tpu.obs.tracing import percentiles
 from gnot_tpu.serve.batcher import Batcher
 from gnot_tpu.serve.engine import InferenceEngine
 from gnot_tpu.serve.policies import (
+    DEFAULT_TENANT,
     AdmissionController,
     CircuitBreaker,
     Deadline,
@@ -104,6 +105,7 @@ REASONS = (
     "ok",
     "shed_deadline",
     "shed_queue_full",
+    "shed_tenant_quota",
     "rejected_breaker_open",
     "rejected_invalid",
     "rejected_draining",
@@ -156,6 +158,11 @@ class _Request:
     # stale_session/rollout_nan fault key).
     session: RolloutSession | None = None
     rollout_ordinal: int = 0
+    # Tenant identity (docs/serving.md "Multi-tenant isolation"): the
+    # submitter's tenant name, or None for untagged traffic — session
+    # steps inherit their session's tenant. None everywhere when no
+    # tenant config is given (the byte-for-byte default path).
+    tenant: str | None = None
 
 
 class _ReplicaKilled(Exception):
@@ -200,6 +207,7 @@ class InferenceServer:
         metrics=None,
         session_store=None,
         catalog=None,
+        tenants=None,
     ):
         self.engine = engine
         self.sink = sink
@@ -236,6 +244,14 @@ class InferenceServer:
         # requests fall back to the ordinary per-bucket padded path, so
         # packing never rejects traffic the padded server accepted.
         self.pack_plan = pack_plan
+        # Multi-tenant isolation plane (policies.TenantPolicy, None =
+        # off — every path byte-for-byte the single-tenant tier): the
+        # policy gates per-tenant quotas at submit (fast-fail
+        # "shed_tenant_quota" BEFORE the global admission gate) and
+        # drives the batcher's per-tenant WFQ sub-queues. One policy
+        # object is shared pool-wide under the router, so a tenant's
+        # quota bounds its in-system count across replicas.
+        self.tenants = tenants
 
         def key_fn(r):
             if pack_plan is not None and pack_plan.packable(r.sample):
@@ -256,6 +272,12 @@ class InferenceServer:
             max_wait_ms=max_wait_ms,
             key_fn=key_fn,
             take_fn=take_fn if pack_plan is not None else None,
+            tenants=tenants,
+            # Untagged traffic under an active policy rides the default
+            # tenant's sub-queue (weight 1, interactive, no quota).
+            tenant_fn=lambda r: (
+                r.tenant if r.tenant is not None else DEFAULT_TENANT
+            ),
         )
         self._inbound: queue.Queue = queue.Queue()
         self._lock = threading.Lock()  # counters + admission ordinal
@@ -356,6 +378,16 @@ class InferenceServer:
         # cache together) resolve to the SAME registry object.
         self._bucket_hists: dict[str, LogHistogram] = {}
         self._shed_counters: dict = {}
+        # Per-tenant accounting (docs/serving.md "Multi-tenant
+        # isolation"): counts for the serve_summary `tenants` rollup
+        # plus — with a live registry — the tenant_* series the
+        # per-tenant SLO objectives burn against. Populated ONLY for
+        # tagged requests, so the untagged default path adds no keys,
+        # no series, no summary block. Histograms/counters are
+        # internally locked; the plain dicts ride _lock.
+        self._tenant_stats: dict[str, dict] = {}  #: guarded_by _lock
+        self._tenant_hists: dict[str, LogHistogram] = {}
+        self._tenant_counters: dict = {}
         # Span-derived per-bucket timing for serve_summary: bucket key
         # -> {"queue_ms": one wait per TRACED request (shed included),
         # "device_ms": the dispatch's device time once per traced
@@ -425,13 +457,22 @@ class InferenceServer:
         return self
 
     def submit(
-        self, sample: MeshSample, *, deadline_ms: float | None = None
+        self,
+        sample: MeshSample,
+        *,
+        deadline_ms: float | None = None,
+        tenant: str | None = None,
     ) -> Future:
         """Admit one request. Fast-fails (resolved Future, degraded
         reason) on: draining, full queue (load shedding at the door),
-        or invalid input (non-finite / oversize — validated HERE so a
-        poison sample is rejected with its index named instead of
-        NaN-ing a whole batch of innocent neighbors)."""
+        exhausted tenant quota (``shed_tenant_quota`` — checked BEFORE
+        the global gate, so a flooding tenant fails at ITS door without
+        consuming shared admission), or invalid input (non-finite /
+        oversize — validated HERE so a poison sample is rejected with
+        its index named instead of NaN-ing a whole batch of innocent
+        neighbors). ``tenant`` names the submitter (None = untagged;
+        with no TenantPolicy configured the tag is carried for
+        per-tenant accounting only)."""
         fut: Future = Future()
         now = self._clock()
         # trace_id assignment happens AT SUBMIT (head sampling decides
@@ -444,9 +485,12 @@ class InferenceServer:
             self._submitted += 1
         if self._c_requests is not None:
             self._c_requests.inc()
+        self._note_tenant_request(tenant)
         if self._draining.is_set():
             self._trace_span(trace, "admission", now, reason="rejected_draining")
-            return self._resolve_now(fut, "rejected_draining", now)
+            return self._resolve_now(
+                fut, "rejected_draining", now, tenant=tenant
+            )
         try:
             self.engine.validate([sample])
         except ValueError as err:
@@ -456,15 +500,45 @@ class InferenceServer:
             )
             self._trace_span(trace, "admission", now, reason="rejected_invalid")
             return self._resolve_now(
-                fut, "rejected_invalid", now, detail=str(err)
+                fut, "rejected_invalid", now, detail=str(err), tenant=tenant
             )
+        if self.tenants is not None:
+            # Per-tenant quota gate FIRST (docs/serving.md "Multi-tenant
+            # isolation"): a tenant over its bounded in-system count
+            # fast-fails at its OWN door — O(1), tenant-tagged, and
+            # without consuming shared admission, so a flooding tenant
+            # cannot exhaust the pool-wide queue_limit siblings use.
+            tname = tenant if tenant is not None else DEFAULT_TENANT
+            if not self.tenants.try_admit(tname):
+                self._count_shed("shed_tenant_quota")
+                self._note_tenant_shed(tname, "shed_tenant_quota")
+                self._event(
+                    events.TENANT_QUOTA_SHED,
+                    tenant=tname,
+                    quota=self.tenants.quota(tname),
+                    in_system=self.tenants.in_system(tname),
+                    **({"trace_id": trace} if trace else {}),
+                )
+                self._trace_span(
+                    trace, "admission", now, reason="shed_tenant_quota"
+                )
+                fut.set_result(
+                    ServeResult(ok=False, reason="shed_tenant_quota")
+                )
+                return fut
         if not self.admission.try_admit():
+            if self.tenants is not None:
+                self.tenants.release(
+                    tenant if tenant is not None else DEFAULT_TENANT
+                )
             self._count_shed("shed_queue_full")
+            self._note_tenant_shed(tenant, "shed_queue_full")
             self._event(
                 events.SHED,
                 reason="shed_queue_full",
                 depth=self.admission.depth,
                 limit=self.admission.limit,
+                **({"tenant": tenant} if tenant is not None else {}),
                 **({"trace_id": trace} if trace else {}),
             )
             self._trace_span(trace, "admission", now, reason="shed_queue_full")
@@ -496,12 +570,16 @@ class InferenceServer:
                         Deadline(now + ms / 1e3) if ms is not None else None
                     ),
                     trace=trace,
+                    tenant=tenant,
                 )
                 self._inbound.put(req)
         if raced_shutdown:
             self.admission.release()
+            self._release_tenant(tenant)
             self._trace_span(trace, "admission", now, reason="rejected_draining")
-            return self._resolve_now(fut, "rejected_draining", now)
+            return self._resolve_now(
+                fut, "rejected_draining", now, tenant=tenant
+            )
         # Admission closed; queue_wait opens here (recorded at dispatch,
         # when its end is known — spans cross the client/worker threads).
         self._trace_span(trace, "admission", now, reason="admitted")
@@ -517,6 +595,7 @@ class InferenceServer:
         on_step: Callable | None = None,
         session: RolloutSession | None = None,
         name: str | None = None,
+        tenant: str | None = None,
     ) -> RolloutFuture:
         """Admit one autoregressive rollout: ``steps`` chained
         dispatches whose carry stays resident on THIS server between
@@ -569,6 +648,7 @@ class InferenceServer:
                     else None
                 ),
                 on_step=on_step,
+                tenant=tenant,
             )
             session.named = name is not None
         else:
@@ -660,7 +740,38 @@ class InferenceServer:
                 detail=str(err),
             )
             return
+        if self.tenants is not None:
+            # Per-step tenant quota gate (a session's K chained steps
+            # each hold one in-system slot, so a tenant's quota bounds
+            # its request AND rollout pressure with one number). The
+            # shed is terminal, not migratable — quota exhaustion is
+            # the tenant's own doing, and bouncing the session to a
+            # sibling sharing the same pool-wide policy would re-fail.
+            tname = (
+                session.tenant
+                if session.tenant is not None
+                else DEFAULT_TENANT
+            )
+            if not self.tenants.try_admit(tname):
+                self._count_shed("shed_tenant_quota")
+                self._note_tenant_shed(tname, "shed_tenant_quota")
+                self._event(
+                    events.TENANT_QUOTA_SHED,
+                    tenant=tname,
+                    quota=self.tenants.quota(tname),
+                    in_system=self.tenants.in_system(tname),
+                    session=session.sid,
+                )
+                self._end_session(
+                    session,
+                    reason="shed_tenant_quota",
+                    kind="shed",
+                    detail=f"tenant quota exhausted at step "
+                    f"{session.cursor + 1}",
+                )
+                return
         if not self.admission.try_admit():
+            self._release_tenant(session.tenant)
             self._end_session(
                 session,
                 reason="shed_queue_full",
@@ -688,16 +799,19 @@ class InferenceServer:
                     deadline=Deadline(at) if at is not None else None,
                     session=session,
                     rollout_ordinal=self._rollout_steps,
+                    tenant=session.tenant,
                 )
                 self._inbound.put(req)
         if raced_shutdown:
             self.admission.release()
+            self._release_tenant(session.tenant)
             self._end_session(session, reason="drained", kind="drained")
             return
         if self._c_requests is not None:
             self._c_requests.inc()
         if self._c_steps is not None:
             self._c_steps.inc()
+        self._note_tenant_request(session.tenant)
 
     def _session_step_done(self, req: _Request, result: ServeResult) -> None:
         """One session step left the system: commit + chain the next
@@ -869,12 +983,14 @@ class InferenceServer:
         n = 0
         for r in pending:
             self._finish(r, dead)
+            self._note_tenant_shed(r.tenant, "error_replica_dead")
             n += 1
         try:
             while True:
                 item = self._inbound.get_nowait()
                 if item is not None:
                     self._finish(item, dead)
+                    self._note_tenant_shed(item.tenant, "error_replica_dead")
                     n += 1
         except queue.Empty:
             pass
@@ -883,6 +999,7 @@ class InferenceServer:
         for _, rs in self.batcher.pop_ready(self._clock(), flush_all=True):
             for r in rs:
                 self._finish(r, dead)
+                self._note_tenant_shed(r.tenant, "error_replica_dead")
                 n += 1
         if n:
             self._count_shed("error_replica_dead", n=n)
@@ -967,6 +1084,7 @@ class InferenceServer:
                         item, ServeResult(ok=False, reason="rejected_draining")
                     )
                     self._count_shed("rejected_draining")
+                    self._note_tenant_shed(item.tenant, "rejected_draining")
                     # Terminal span so the trace chain ends at its shed
                     # point with the reason (the propagation contract,
                     # docs/observability.md). No bucket arg: the rollup
@@ -983,6 +1101,7 @@ class InferenceServer:
                 r, ServeResult(ok=False, reason="rejected_draining")
             )
             self._count_shed("rejected_draining")
+            self._note_tenant_shed(r.tenant, "rejected_draining")
             self._trace_span(
                 r.trace, "queue_wait", r.submitted,
                 reason="rejected_draining",
@@ -1125,6 +1244,7 @@ class InferenceServer:
             if r.deadline is not None and r.deadline.expired(now):
                 self._finish(r, ServeResult(ok=False, reason="shed_deadline"))
                 self._count_shed("shed_deadline")
+                self._note_tenant_shed(r.tenant, "shed_deadline")
                 if r.trace is not None:
                     self._trace_span(
                         r.trace, "queue_wait", r.submitted, now,
@@ -1136,6 +1256,7 @@ class InferenceServer:
                 self._event(
                     events.SHED, reason="shed_deadline", ordinal=r.ordinal,
                     waited_ms=(now - r.submitted) * 1e3,
+                    **({"tenant": r.tenant} if r.tenant is not None else {}),
                     **({"trace_id": r.trace} if r.trace else {}),
                 )
             else:
@@ -1161,6 +1282,8 @@ class InferenceServer:
                         bucket, queue_ms=[(now - r.submitted) * 1e3]
                     )
             self._count_shed("rejected_breaker_open", n=len(live))
+            for r in live:
+                self._note_tenant_shed(r.tenant, "rejected_breaker_open")
             rejected_ids = [r.trace for r in live if r.trace is not None]
             self._event(
                 events.SHED, reason="rejected_breaker_open", n=len(live),
@@ -1347,6 +1470,7 @@ class InferenceServer:
             with self._lock:
                 self._completed += 1
             self._note_latency(lat, bucket)
+            self._note_tenant_done(r.tenant, lat)
             self._finish(
                 r,
                 ServeResult(ok=True, reason="ok", output=o, latency_ms=lat),
@@ -1471,6 +1595,7 @@ class InferenceServer:
         for r in reqs:
             self._finish(r, ServeResult(ok=False, reason=reason, detail=detail))
             self._trace_span(r.trace, "resolve", now, reason=reason)
+            self._note_tenant_shed(r.tenant, reason)
         self._count_shed(reason, n=len(reqs))
         if self.breaker.record_failure():
             first_trace = next(
@@ -1518,6 +1643,22 @@ class InferenceServer:
     def step_latency_histogram(self) -> LogHistogram:
         """Point-in-time copy of the rollout-step latency histogram."""
         return self._step_hist.copy()
+
+    def tenant_rollup(self) -> dict:
+        """Per-tenant counts + latency-histogram copies — the router's
+        pool-merge input (histograms merge losslessly, counts sum).
+        Empty dicts when no request ever carried a tenant tag."""
+        with self._lock:
+            counts = {
+                t: {
+                    "requests": v["requests"],
+                    "completed": v["completed"],
+                    "shed": dict(v["shed"]),
+                }
+                for t, v in self._tenant_stats.items()
+            }
+        hists = {t: h.copy() for t, h in dict(self._tenant_hists).items()}
+        return {"counts": counts, "hists": hists}
 
     def resident_sessions(self) -> int:
         """Rollout sessions currently resident on this server — the
@@ -1574,6 +1715,7 @@ class InferenceServer:
 
     def _finish(self, req: _Request, result: ServeResult) -> None:
         self.admission.release()
+        self._release_tenant(req.tenant)
         if not req.future.done():
             req.future.set_result(result)
         # A session step's result chains the session forward (commit +
@@ -1583,11 +1725,94 @@ class InferenceServer:
             self._session_step_done(req, result)
 
     def _resolve_now(
-        self, fut: Future, reason: str, now: float, *, detail: str = ""
+        self,
+        fut: Future,
+        reason: str,
+        now: float,
+        *,
+        detail: str = "",
+        tenant: str | None = None,
     ) -> Future:
         self._count_shed(reason)
+        self._note_tenant_shed(tenant, reason)
         fut.set_result(ServeResult(ok=False, reason=reason, detail=detail))
         return fut
+
+    # -- per-tenant accounting (docs/serving.md "Multi-tenant
+    # isolation"): every helper is a no-op for untagged (tenant=None)
+    # traffic, so the default single-tenant path records nothing new. --
+
+    def _release_tenant(self, tenant: str | None) -> None:
+        """The quota twin of ``admission.release()``: one in-system
+        request of this tenant left. Mirrors every path that admitted
+        through ``TenantPolicy.try_admit`` (untagged requests admitted
+        under an active policy ride the default tenant)."""
+        if self.tenants is not None:
+            self.tenants.release(
+                tenant if tenant is not None else DEFAULT_TENANT
+            )
+
+    def _tenant_stat(self, tenant: str) -> dict:
+        """The tenant's summary-rollup record. Caller holds ``_lock``
+        (every ``_note_tenant_*`` call site takes it; taking it here
+        too would self-deadlock on the non-reentrant lock)."""
+        st = self._tenant_stats.get(tenant)  # graftlint: disable=GL004 — caller holds _lock (see docstring)
+        if st is None:
+            st = self._tenant_stats[tenant] = {  # graftlint: disable=GL004 — caller holds _lock (see docstring)
+                "requests": 0, "completed": 0, "shed": {}
+            }
+        return st
+
+    def _tenant_counter(self, name: str, tenant: str, **labels):
+        key = (name, tenant, tuple(sorted(labels.items())))
+        c = self._tenant_counters.get(key)
+        if c is None:
+            c = self._metrics.counter(
+                name, tenant=tenant, **labels, **self._metric_labels
+            )
+            self._tenant_counters[key] = c
+        return c
+
+    def _note_tenant_request(self, tenant: str | None) -> None:
+        if tenant is None:
+            return
+        with self._lock:
+            self._tenant_stat(tenant)["requests"] += 1
+        if self._metrics is not None:
+            self._tenant_counter("tenant_requests_total", tenant).inc()
+
+    def _note_tenant_shed(
+        self, tenant: str | None, reason: str, n: int = 1
+    ) -> None:
+        if tenant is None:
+            return
+        with self._lock:
+            shed = self._tenant_stat(tenant)["shed"]
+            shed[reason] = shed.get(reason, 0) + n
+        if self._metrics is not None:
+            self._tenant_counter(
+                "tenant_shed_total", tenant, reason=reason
+            ).inc(n)
+
+    def _note_tenant_done(self, tenant: str | None, lat_ms: float) -> None:
+        if tenant is None:
+            return
+        with self._lock:
+            self._tenant_stat(tenant)["completed"] += 1
+        h = self._tenant_hists.get(tenant)
+        if h is None:
+            h = (
+                self._metrics.histogram(
+                    "tenant_latency_ms", tenant=tenant,
+                    **self._metric_labels,
+                )
+                if self._metrics is not None
+                else LogHistogram()
+            )
+            self._tenant_hists[tenant] = h
+        h.record(lat_ms)
+        if self._metrics is not None:
+            self._tenant_counter("tenant_completed_total", tenant).inc()
 
     def _count_shed(self, reason: str, n: int = 1) -> None:
         with self._lock:
@@ -1669,6 +1894,14 @@ class InferenceServer:
             }
             pack_stats = {k: dict(v) for k, v in self._pack_stats.items()}
             jit_fallbacks = self._jit_fallbacks
+            tenant_stats = {
+                t: {
+                    "requests": v["requests"],
+                    "completed": v["completed"],
+                    "shed": dict(v["shed"]),
+                }
+                for t, v in self._tenant_stats.items()
+            }
             if self._sessions_started:
                 # Rollout-session rollup (serve/rollout.py): sessions
                 # ACCEPTED here (migrated arrivals included) and how
@@ -1694,6 +1927,27 @@ class InferenceServer:
                 for k, cs in dict(self._pack_counters).items()
             }
         summary["jit_fallbacks"] = jit_fallbacks
+        if tenant_stats:
+            # Per-tenant rollup (docs/serving.md "Multi-tenant
+            # isolation"): how each tenant's traffic fared — the
+            # noisy-neighbor A/B's per-arm evidence. Absent entirely
+            # when no request ever carried a tenant tag.
+            summary["tenants"] = {
+                t: {
+                    **st,
+                    "latency_p50_ms": (
+                        self._tenant_hists[t].percentile(0.50)
+                        if t in self._tenant_hists
+                        else None
+                    ),
+                    "latency_p99_ms": (
+                        self._tenant_hists[t].percentile(0.99)
+                        if t in self._tenant_hists
+                        else None
+                    ),
+                }
+                for t, st in sorted(tenant_stats.items())
+            }
         if pack_stats:
             # Per-bucket pad-waste / packing efficiency over every
             # executed dispatch: fill = real/capacity node tokens,

@@ -500,3 +500,59 @@ def test_capacity_ab_artifact_schema():
         1.0 - summary["requests_per_s_on"] / summary["requests_per_s_off"],
         abs=1e-3,
     )
+
+
+def test_tenant_ab_artifact_schema():
+    """The committed noisy-neighbor A/B (tools/tenant_ab.py): one
+    shared two-stream storm (a batch flood >= 3x its fair share beside
+    a well-behaved interactive stream) through the tenant-isolation
+    plane vs the untagged open pool — the ISSUE 17 acceptance bars:
+    the isolated arm's interactive stream sheds NOTHING and holds its
+    p99 SLO while the flooding tenant eats quota fast-fails; the open
+    arm demonstrably breaches (the flood was not vacuous); and the
+    open arm's own event stream proves the default-path pin (zero
+    tenant footprint when nothing is tagged)."""
+    path = os.path.join(ARTIFACT_DIR, "tenant_ab.jsonl")
+    with open(path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    arms = {r["arm"]: r for r in recs if "arm" in r}
+    assert set(arms) == {"isolated", "open"}
+    # Both arms replayed the SAME storm (shared multi_stream trace).
+    assert arms["isolated"]["submitted"] == arms["open"]["submitted"] > 0
+    for r in arms.values():
+        assert r["completed"] + sum(r["shed"].values()) == r["submitted"]
+        per = r["tenants"]
+        assert set(per) == {"interactive", "batch"}
+        for t in per.values():
+            assert t["completed"] + t["shed_total"] == t["submitted"]
+    iso, opn = arms["isolated"], arms["open"]
+    assert iso["tagged"] is True and opn["tagged"] is False
+    assert opn["policy"] is None  # the open arm ran the DEFAULT path
+    # The default-path pin probe reads the open arm's own artifacts.
+    (pin,) = [r for r in recs if r.get("probe") == "default_pin"]
+    assert pin["events_scanned"] > 0
+    assert pin["tenant_named_events"] == pin["tenant_fields"] == 0
+    assert pin["summary_has_tenants"] is False and pin["bar"] == 0
+    (summary,) = [r for r in recs if r.get("summary") == "tenant_ab"]
+    assert summary["quick"] is False
+    assert summary["trace"].startswith("multi_stream:")
+    assert summary["arrivals"] == iso["submitted"]
+    # The flood was real: batch offered >= 3x its fair quarter-share.
+    assert summary["flood_factor"] >= summary["bar_flood_factor"] == 3.0
+    # Isolation bars: the well-behaved tenant rode through untouched.
+    assert summary["isolated_interactive_shed"] == 0
+    assert (
+        summary["isolated_interactive_p99_ms"] <= summary["slo_p99_ms"]
+    )
+    assert summary["isolated_batch_quota_sheds"] >= 1
+    assert summary["isolated_batch_quota_sheds"] == (
+        iso["tenants"]["batch"]["shed"]["shed_tenant_quota"]
+    )
+    # The open pool breached under the SAME storm — the contrast that
+    # makes the isolation bars meaningful.
+    assert summary["open_breached"] is True
+    assert (
+        summary["open_interactive_p99_ms"] > summary["slo_p99_ms"]
+        or summary["open_interactive_shed"] > 0
+    )
+    assert summary["pin_tenant_footprint"] == 0

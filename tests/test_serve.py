@@ -2104,3 +2104,415 @@ def test_rollout_whole_pool_death_resolves_lost_not_hang(setup, tmp_path):
     # Streams terminated too — no consumer left blocked.
     for f in futs:
         list(f.iter_steps(timeout=1))
+
+
+# --- multi-tenant isolation (docs/serving.md "Multi-tenant isolation") ----
+
+
+def _tenant_policy(**kw):
+    from gnot_tpu.serve import TenantPolicy
+
+    kw.setdefault("weights", "interactive:3,batch:1")
+    return TenantPolicy.from_specs(**kw)
+
+
+def test_tenant_spec_parsing_and_config_validation():
+    from gnot_tpu.config import parse_tenant_spec
+    from gnot_tpu.serve import TenantPolicy
+
+    assert parse_tenant_spec("interactive:3, batch:1", what="weight") == {
+        "interactive": "3",
+        "batch": "1",
+    }
+    assert parse_tenant_spec("") == {}
+    with pytest.raises(ValueError, match="weight"):
+        parse_tenant_spec("interactive", what="weight")
+    with pytest.raises(ValueError, match="duplicate"):
+        parse_tenant_spec("a:1,a:2")
+    with pytest.raises(ValueError, match="tenant weight"):
+        make_config(**{"serve.tenant_weights": "a:0"})
+    with pytest.raises(ValueError, match="tenant quota"):
+        make_config(**{"serve.tenant_quotas": "a:none"})
+    with pytest.raises(ValueError, match="tenant priority"):
+        make_config(**{"serve.tenant_priorities": "a:urgent"})
+    cfg = make_config(
+        **{
+            "serve.tenant_weights": "interactive:3,batch:1",
+            "serve.tenant_quotas": "batch:4",
+        }
+    )
+    assert cfg.serve.tenant_weights == "interactive:3,batch:1"
+    # All-empty specs mean the plane is OFF, not a vacuous policy.
+    assert TenantPolicy.from_specs() is None
+    pol = _tenant_policy(quotas="batch:2")
+    assert pol.tenants == ["batch", "interactive"]
+    assert pol.weight("interactive") == 3 and pol.weight("unlisted") == 1
+    # Unlisted tenants are interactive-class — except one literally
+    # named "batch", so the README example reads the way it behaves.
+    assert pol.priority("interactive") == "interactive"
+    assert pol.priority("unlisted") == "interactive"
+    assert pol.priority("batch") == "batch"
+    assert pol.quota("interactive") is None and pol.quota("batch") == 2
+    assert pol.try_admit("batch") and pol.try_admit("batch")
+    assert not pol.try_admit("batch")  # quota full -> O(1) fast-fail
+    assert pol.try_admit("interactive")  # un-quota'd: never limited
+    pol.release("batch")
+    assert pol.try_admit("batch")
+
+
+def test_batcher_wfq_weighted_drain_and_fifo_within_tenant():
+    """The WFQ drain contract: within one bucket and one priority tier,
+    a 3:1-weighted pair of tenants shares each dispatch 3:1 (deficit
+    round robin), and each tenant's own requests dispatch in arrival
+    order (FIFO within tenant)."""
+    pol = _tenant_policy(
+        weights="alice:3,bob:1", priorities="alice:interactive,bob:interactive"
+    )
+    b = Batcher(
+        max_batch=4, max_wait_ms=50, key_fn=lambda r: "k",
+        tenants=pol, tenant_fn=lambda r: r[0],
+    )
+    # Interleave arrivals bob-first so weight (not arrival order) must
+    # explain the drain mix.
+    for i in range(8):
+        b.add(("bob", i), now=0.001 * (2 * i))
+        b.add(("alice", i), now=0.001 * (2 * i + 1))
+    batches = b.pop_ready(1.0, flush_all=True)
+    assert [len(reqs) for _, reqs in batches] == [4, 4, 4, 4]
+    # 3:1 per dispatch while both queues are non-empty: alice's 8 ride
+    # the first three cuts (3+3+2), bob backfills the remainder.
+    mixes = [
+        [t for t, _ in reqs].count("alice") for _, reqs in batches
+    ]
+    assert mixes == [3, 3, 2, 0]
+    for tenant in ("alice", "bob"):
+        served = [
+            i for _, reqs in batches for t, i in reqs if t == tenant
+        ]
+        assert served == sorted(served)  # FIFO within tenant
+
+
+def test_batcher_priority_tier_drains_interactive_first():
+    """Strict priority tiers: every interactive-class request in a
+    bucket dispatches before ANY batch-class one — even when the batch
+    work arrived first — and an in-flight inversion is bounded by ONE
+    dispatch (the cut that left before the interactive work existed)."""
+    pol = _tenant_policy(quotas="")
+    b = Batcher(
+        max_batch=2, max_wait_ms=50, key_fn=lambda r: "k",
+        tenants=pol, tenant_fn=lambda r: r[0],
+    )
+    b.add(("batch", 0), now=0.0)
+    b.add(("batch", 1), now=0.0)
+    # The pre-existing inversion: a full batch-class cut leaves while
+    # no interactive work exists. That one dispatch is the bound.
+    [(_, first)] = b.pop_ready(0.001)
+    assert [t for t, _ in first] == ["batch", "batch"]
+    # Now both classes queue together: interactive preempts everything
+    # still queued, batch backfills only after it drains.
+    for i in range(2, 6):
+        b.add(("batch", i), now=0.002)
+    for i in range(4):
+        b.add(("interactive", i), now=0.003)
+    batches = b.pop_ready(1.0, flush_all=True)
+    order = [t for _, reqs in batches for t, _ in reqs]
+    assert order == ["interactive"] * 4 + ["batch"] * 4
+
+
+def test_batcher_tenant_aged_flush_is_per_request():
+    """Satellite regression (max_wait audit): the age clock is the
+    OLDEST ARRIVAL ANYWHERE in the bucket — not the head of whichever
+    sub-queue WFQ favors — so a lone lowest-weight request's wait is
+    bounded by max_wait_ms even while a heavier sibling keeps arriving,
+    and the aged flush takes the whole bucket (the victim rides it)."""
+    pol = _tenant_policy(weights="alice:9,bob:1")
+    b = Batcher(
+        max_batch=8, max_wait_ms=100, key_fn=lambda r: "k",
+        tenants=pol, tenant_fn=lambda r: r[0],
+    )
+    b.add(("bob", 0), now=0.0)  # the lowest-weight victim
+    b.add(("alice", 0), now=0.09)  # newer, heavier sibling
+    # Not aged yet at t=0.05; the flush countdown reads BOB's arrival.
+    assert b.pop_ready(0.05) == []
+    assert b.next_flush_in(0.05) == pytest.approx(0.05)
+    # At t=0.1 bob's budget is spent: the partial bucket flushes WHOLE
+    # (both tenants), so bob's worst-case wait == max_wait_ms exactly.
+    [(_, reqs)] = b.pop_ready(0.1)
+    assert {t for t, _ in reqs} == {"alice", "bob"}
+    assert len(b) == 0 and b.next_flush_in(0.2) is None
+    # Leftovers from a size-based cut keep their TRUE arrival time: a
+    # bob request surviving a full cut must not have its clock reset.
+    for i in range(9):
+        b.add(("alice", i), now=0.2)
+    b.add(("bob", 1), now=0.2)
+    batches = b.pop_ready(0.201)  # one full 8-wide cut leaves
+    assert [len(reqs) for _, reqs in batches] == [8]
+    # 2 remain (alice's 9th + bob's); their age still counts from 0.2.
+    assert b.next_flush_in(0.25) == pytest.approx(0.05)
+    [(_, rest)] = b.pop_ready(0.301)
+    assert {t for t, _ in rest} == {"alice", "bob"}
+
+
+def test_batcher_untagged_mode_unchanged_by_tenant_code():
+    """Default-path pin at the batcher level: with ``tenants=None`` the
+    structure never consults tenant_fn and behaves exactly like the
+    single-FIFO batcher, whatever tenant attributes requests carry."""
+    seen = []
+
+    def tenant_fn(r):
+        seen.append(r)
+        return "x"
+
+    b = Batcher(
+        max_batch=2, max_wait_ms=100, key_fn=lambda r: r[0],
+        tenant_fn=tenant_fn,
+    )
+    b.add(("a", 1), now=0.0)
+    b.add(("a", 2), now=0.01)
+    [(key, reqs)] = b.pop_ready(0.02)
+    assert key == "a" and [i for _, i in reqs] == [1, 2]
+    assert seen == []  # tenant plumbing never ran
+
+
+def test_tenant_quota_exhaustion_never_blocks_sibling(setup, tmp_path):
+    """Chaos: one tenant exhausts its quota while the queue is stalled
+    (nothing dispatches before drain) — its overflow FAST-fails with
+    tenant-tagged events, the sibling's admissions are untouched, and
+    the per-tenant summary rollup matches number-for-number."""
+    server, sink, path = make_server(
+        setup, tmp_path, max_wait_ms=10_000, tenants=_tenant_policy(
+            quotas="batch:2"
+        ),
+    )
+    _, _, samples, _ = setup
+    with sink:
+        server.start()
+        held = [
+            server.submit(samples[i], tenant="batch") for i in range(2)
+        ]
+        overflow = [
+            server.submit(samples[2 + i], tenant="batch") for i in range(3)
+        ]
+        # Fast-fail means NOW — the queue is stalled (10 s max_wait),
+        # yet the over-quota futures resolve immediately.
+        for f in overflow:
+            assert f.result(timeout=1).reason == "shed_tenant_quota"
+        # The sibling admits freely past the batch quota wall.
+        inter = [
+            server.submit(samples[5 + i], tenant="interactive")
+            for i in range(4)
+        ]
+        summary = server.drain()
+        assert all(f.result(timeout=5).ok for f in held + inter)
+    roll = summary["tenants"]
+    assert roll["batch"] == {
+        "requests": 5, "completed": 2,
+        "shed": {"shed_tenant_quota": 3},
+        "latency_p50_ms": roll["batch"]["latency_p50_ms"],
+        "latency_p99_ms": roll["batch"]["latency_p99_ms"],
+    }
+    assert roll["interactive"]["requests"] == 4
+    assert roll["interactive"]["completed"] == 4
+    assert roll["interactive"]["shed"] == {}
+    events = read_events(path)
+    qevs = [e for e in events if e["event"] == "tenant_quota_shed"]
+    assert len(qevs) == 3
+    assert all(
+        e["tenant"] == "batch" and e["quota"] == 2 and e["in_system"] >= 2
+        for e in qevs
+    )
+    # Quota releases on completion: after drain the tenant re-admits.
+    assert server.tenants.try_admit("batch")
+    server.tenants.release("batch")
+
+
+def test_tenant_sigterm_drain_resolves_with_tenant_summaries(
+    setup, tmp_path
+):
+    """Chaos: SIGTERM mid-storm — every tagged future resolves through
+    the graceful drain and the serve_summary tenants rollup attributes
+    each completion to the right tenant."""
+    with PreemptionHandler() as preempt:
+        server, sink, path = make_server(
+            setup, tmp_path, preempt=preempt, max_wait_ms=10_000,
+            tenants=_tenant_policy(),
+        )
+        _, _, samples, _ = setup
+        server.start()
+        futs = [
+            server.submit(s, tenant=("interactive", "batch")[i % 2])
+            for i, s in enumerate(samples[:6])
+        ]
+        os.kill(os.getpid(), signal.SIGTERM)
+        results = [f.result(timeout=30) for f in futs]
+        summary = server.drain()
+        sink.close()
+    assert all(r.ok for r in results)
+    roll = summary["tenants"]
+    assert roll["interactive"]["completed"] == 3
+    assert roll["batch"]["completed"] == 3
+    [summ] = [
+        e for e in read_events(path) if e["event"] == "serve_summary"
+    ]
+    assert summ["tenants"]["batch"]["requests"] == 3
+
+
+def test_untagged_traffic_coexists_with_policy(setup, tmp_path):
+    """With a policy ACTIVE, untagged requests still flow (they ride
+    the DEFAULT_TENANT WFQ sub-queue — interactive class, no quota) and
+    the tenants rollup charges only the traffic that carried a tag."""
+    server, sink, path = make_server(
+        setup, tmp_path, tenants=_tenant_policy(quotas="batch:1")
+    )
+    _, _, samples, _ = setup
+    with sink:
+        server.start()
+        futs = [server.submit(s) for s in samples[:3]]
+        tagged = [
+            server.submit(s, tenant="batch") for s in samples[3:4]
+        ]
+        assert all(f.result(timeout=30).ok for f in futs + tagged)
+        summary = server.drain()
+    roll = summary["tenants"]
+    assert set(roll) == {"batch"}  # untagged traffic stays anonymous
+    assert roll["batch"]["completed"] == 1
+    assert summary["completed"] == 4  # global counters cover everyone
+
+
+def test_tenant_summary_absent_without_policy(setup, tmp_path):
+    """Default-path pin at the server level: no policy, no tags ->
+    ZERO tenant footprint in the summary and the event stream."""
+    server, sink, path = make_server(setup, tmp_path)
+    _, _, samples, _ = setup
+    with sink:
+        server.start()
+        futs = [server.submit(s) for s in samples[:3]]
+        assert all(f.result(timeout=30).ok for f in futs)
+        summary = server.drain()
+    assert "tenants" not in summary
+    for e in read_events(path):
+        assert "tenant" not in e and "tenants" not in e
+        assert "tenant" not in e["event"]
+
+
+def test_rollout_session_tenant_inherited_across_migration(
+    setup, tmp_path
+):
+    """Chaos: a tagged rollout session survives its owner's death and
+    the migrated session keeps charging the SAME tenant — accounting
+    follows the session, not the replica."""
+    from gnot_tpu.serve import ReplicaRouter
+
+    _, _, samples, _ = setup
+    K = 4
+    pol = _tenant_policy()
+    replicas = _make_replicas(setup, 2)
+    for r in replicas:
+        r.warm(samples[:1], rows=MAX_BATCH)
+    sink = MetricsSink(str(tmp_path / "serve.jsonl"))
+    with sink:
+        router = ReplicaRouter(
+            replicas,
+            sink=sink,
+            max_batch=MAX_BATCH,
+            max_wait_ms=2.0,
+            session_snapshot_every=1,
+            tenants=pol,
+            faults={0: FaultInjector.from_spec("replica_kill@2")},
+        ).start()
+        futs = [
+            router.submit_rollout(s, K, tenant="alice")
+            for s in samples[:3]
+        ]
+        results = [f.result(timeout=60) for f in futs]
+        summary = router.drain()
+    assert all(r.ok for r in results), [r.reason for r in results]
+    assert summary["sessions"]["migrated"] >= 1
+    roll = summary["tenants"]
+    # Every session accepted (including re-accepted migrants) and every
+    # committed step landed under alice — nothing leaked to an
+    # anonymous bucket on the migration path.
+    assert set(roll) == {"alice"}
+    assert roll["alice"]["requests"] >= 3
+    assert roll["alice"]["completed"] >= 3 * K
+    assert roll["alice"]["latency_p50_ms"] is not None
+
+
+def test_rollout_session_tenant_survives_store_resume(setup, tmp_path):
+    """A drained tagged session resumes from the SessionStore on a
+    fresh server with its tenant intact (snapshot_state carries it)."""
+    from gnot_tpu.serve import SessionStore
+
+    _, _, samples, engine = setup
+    store = SessionStore(str(tmp_path / "sessions"))
+    pol = _tenant_policy()
+    server = InferenceServer(
+        engine, max_batch=MAX_BATCH, max_wait_ms=2.0,
+        session_snapshot_every=1, session_store=store, tenants=pol,
+    ).start()
+    fut = server.submit_rollout(
+        samples[0], 6, name="tagged-run", tenant="alice"
+    )
+    it = fut.iter_steps(timeout=60)
+    for _ in range(2):  # mid-rollout by construction
+        next(it)
+    server.drain(10.0)
+    first = fut.result(timeout=10)
+    assert not first.ok and first.reason == "drained"
+    server2 = InferenceServer(
+        engine, max_batch=MAX_BATCH, max_wait_ms=2.0,
+        session_snapshot_every=1, session_store=store, tenants=pol,
+    ).start()
+    fut2 = server2.resume_rollout("tagged-run")
+    assert fut2.result(timeout=60).ok
+    summary = server2.drain()
+    # The resumed server never saw an explicit tag — the tenant came
+    # back from the persisted session state.
+    assert summary["tenants"]["alice"]["requests"] >= 1
+    assert summary["tenants"]["alice"]["completed"] >= 1
+
+
+def test_loadgen_multi_stream_deterministic_and_independent():
+    """Satellite: the merged multi-tenant trace is a pure function of
+    (streams, duration, seed); per-stream seeding is positional, so
+    reshaping one tenant's stream never perturbs a sibling's arrivals."""
+    import loadgen
+
+    streams = {
+        "interactive": {"pattern": "steady", "base_rps": 40.0},
+        "batch": {"pattern": "bursty", "base_rps": 80.0, "bursts": 1},
+    }
+    a = loadgen.multi_stream_times(streams, duration_s=2.0, seed=7)
+    b = loadgen.multi_stream_times(streams, duration_s=2.0, seed=7)
+    assert a == b and len(a) > 50
+    assert a == sorted(a)
+    assert {t for _, t in a} == {"interactive", "batch"}
+    # Per-tenant sub-trace == that tenant's solo trace_times (stream
+    # seed = master seed + insertion index).
+    solo = loadgen.trace_times(
+        "steady", base_rps=40.0, duration_s=2.0, seed=7
+    )
+    assert [t for t, who in a if who == "interactive"] == solo
+    # Changing BATCH's shape leaves interactive's arrivals untouched.
+    streams2 = dict(streams)
+    streams2["batch"] = {"pattern": "steady", "base_rps": 10.0}
+    c = loadgen.multi_stream_times(streams2, duration_s=2.0, seed=7)
+    assert [t for t, w in c if w == "interactive"] == solo
+    with pytest.raises(ValueError, match="at least one"):
+        loadgen.multi_stream_times({}, duration_s=1.0)
+
+
+def test_serve_smoke_tool_tenants(tmp_path):
+    """Tier-1 wiring of tools/serve_smoke.py --tenants: the two-tenant
+    storm's isolation assertions (quota fast-fail, tenant-tagged
+    events, WFQ drain fairness, per-tenant rollup) all hold."""
+    import serve_smoke
+
+    summary = serve_smoke.run(
+        [
+            "--tenants", "--n", "24",
+            "--metrics_path", str(tmp_path / "serve.jsonl"),
+        ]
+    )
+    assert summary["failures"] == []
+    assert summary["tenants"]["batch"]["shed"]["shed_tenant_quota"] >= 1

@@ -24,6 +24,13 @@ Inhomogeneous arrivals are drawn by thinning (Lewis & Shedler): a
 homogeneous Poisson stream at the peak rate, each point kept with
 probability ``rate(t) / rate_max`` — exact for any bounded rate
 function, and deterministic under the seed.
+
+Multi-tenant traces (``multi_stream_times``): N independent seeded
+streams — one per tenant, each its own pattern/rate — merged into one
+interleaved ``[(offset, tenant)]`` schedule. Each stream derives its
+seed from the master seed and its position, so the composite is
+deterministic and one tenant's shape change never perturbs a
+sibling's arrivals (``tools/tenant_ab.py``, ``serve_smoke --tenants``).
 """
 
 from __future__ import annotations
@@ -111,6 +118,41 @@ def trace_times(
         # kept-point stream is a deterministic function of the seed.
         if float(rng.uniform()) * rate_max <= rate(t):
             times.append(t)
+
+
+def multi_stream_times(
+    streams: dict[str, dict],
+    *,
+    duration_s: float,
+    seed: int = 0,
+) -> list[tuple[float, str]]:
+    """Compose N independent per-tenant traces into ONE interleaved
+    open-loop schedule: ``[(offset_s, tenant), ...]`` sorted by offset.
+
+    ``streams`` maps tenant name -> that tenant's ``trace_times``
+    kwargs (``pattern``, ``base_rps``, plus any shape kwargs; an
+    optional per-stream ``seed`` overrides the derived one). Each
+    stream is seeded independently and deterministically —
+    ``seed + stream index in insertion order`` — so one tenant's shape
+    change never perturbs a sibling's arrivals, and the same
+    (streams, duration, seed) always yields the identical interleaved
+    schedule (the tenant A/B's shared-trace requirement: both arms
+    replay the same storm). Ties break by (offset, tenant) — stable
+    and replayable.
+    """
+    if not streams:
+        raise ValueError("multi_stream_times needs at least one stream")
+    merged: list[tuple[float, str]] = []
+    for i, (tenant, spec) in enumerate(streams.items()):
+        kw = dict(spec)
+        stream_seed = kw.pop("seed", seed + i)
+        pattern = kw.pop("pattern")
+        times = trace_times(
+            pattern, duration_s=duration_s, seed=stream_seed, **kw
+        )
+        merged.extend((t, tenant) for t in times)
+    merged.sort(key=lambda e: (e[0], e[1]))
+    return merged
 
 
 def replay(

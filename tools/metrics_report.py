@@ -165,6 +165,36 @@ def breach_intervals(events: list[dict]) -> tuple[list[dict], list[str]]:
     return intervals, problems
 
 
+def tenant_breakdown(events: list[dict]) -> dict[str, dict]:
+    """Per-tenant isolation rollup from a metrics-event JSONL
+    (docs/serving.md "Multi-tenant isolation"): the final
+    ``serve_summary``'s ``tenants`` block (requests / completed / shed
+    / latency percentiles) joined with the ``tenant_quota_shed``
+    admission events (``quota_shed_events``) and any tenant-scoped
+    ``slo_alert`` edges (``slo_edges``). Empty when the run never
+    carried a tenant tag — the single-tenant path emits none of
+    these."""
+    summaries = [
+        e
+        for e in events
+        if e.get("event") == "serve_summary" and e.get("tenants")
+    ]
+    # Prefer the pool-level rollup when a router emitted both tiers.
+    pool = [e for e in summaries if "per_replica" in e or "routing" in e]
+    roll = ((pool or summaries)[-1]["tenants"] if summaries else {}) or {}
+    out = {t: dict(st) for t, st in roll.items()}
+    for e in events:
+        if e.get("event") == "tenant_quota_shed":
+            st = out.setdefault(e["tenant"], {})
+            st["quota_shed_events"] = st.get("quota_shed_events", 0) + 1
+        elif e.get("event") == "slo_alert" and e.get("tenant"):
+            st = out.setdefault(e["tenant"], {})
+            st.setdefault("slo_edges", []).append(
+                (e["objective"], e["state"])
+            )
+    return out
+
+
 def run(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("series", help="the <stem>.series.jsonl time series")
@@ -249,6 +279,32 @@ def run(argv=None) -> int:
                 f"@{iv['fired_ts']:.3f} -> {end} "
                 f"(burn_fast {iv['peak_burn_fast']})"
             )
+        tb = tenant_breakdown(events)
+        if tb:
+            print(f"\nPer-tenant breakdown ({len(tb)} tenants):")
+            for t, st in sorted(tb.items()):
+                shed = st.get("shed") or {}
+                p50, p99 = st.get("latency_p50_ms"), st.get("latency_p99_ms")
+                print(
+                    f"  {t}: requests={st.get('requests', 0)} "
+                    f"completed={st.get('completed', 0)} "
+                    f"shed={dict(sorted(shed.items()))} "
+                    f"p50={p50 if p50 is None else round(p50, 2)}ms "
+                    f"p99={p99 if p99 is None else round(p99, 2)}ms "
+                    f"quota_shed_events={st.get('quota_shed_events', 0)}"
+                )
+                for obj, state in st.get("slo_edges", []):
+                    print(f"    slo_alert {obj}: {state}")
+                # Admission coherence: the fast-fail event stream and
+                # the summary's shed counter are two views of the same
+                # decisions — they must agree per tenant.
+                n_ev = st.get("quota_shed_events", 0)
+                n_sum = shed.get("shed_tenant_quota", 0)
+                if "requests" in st and n_ev != n_sum:
+                    failures.append(
+                        f"tenant {t}: {n_ev} tenant_quota_shed events "
+                        f"!= summary shed_tenant_quota {n_sum}"
+                    )
         summaries = [
             e
             for e in events

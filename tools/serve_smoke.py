@@ -215,6 +215,33 @@ def run(argv=None) -> dict:
              "during hydration + storm"
     )
     p.add_argument(
+        "--tenants", action="store_true",
+        help="multi-tenant isolation mode (serve/policies.py "
+             "TenantPolicy, docs/serving.md 'Multi-tenant isolation'): "
+             "run the storm as a TWO-TENANT burst — 'interactive' "
+             "(weight 3, no quota) interleaved with a flooding 'batch' "
+             "(weight 1, small admission quota) over ONE bucket so the "
+             "WFQ + priority drain is the only arbiter. The smoke then "
+             "ALSO asserts the isolation contract: batch sheds on its "
+             "quota (tenant-tagged tenant_quota_shed events, registry-"
+             "validated) while the interactive sibling never does, the "
+             "priority drain keeps interactive p50 <= batch p50 under "
+             "the shared backlog, and the serve_summary per-tenant "
+             "rollup matches the observed per-future outcomes "
+             "number-for-number. One-shot only (no --rollout)"
+    )
+    p.add_argument(
+        "--tenant_weights", type=str, default="interactive:3,batch:1",
+        help="tenants mode: WFQ weight spec (config.parse_tenant_spec "
+             "grammar)"
+    )
+    p.add_argument(
+        "--tenant_quotas", type=str, default="",
+        help="tenants mode: admission quota spec; default "
+             "batch:max(2, n//4) — small enough that the batch flood "
+             "fast-fails on quota while interactive stays unthrottled"
+    )
+    p.add_argument(
         "--capacity", action="store_true",
         help="program catalog & capacity plane (serve/catalog.py, "
              "docs/observability.md 'Program costs & capacity'): share "
@@ -227,14 +254,18 @@ def run(argv=None) -> dict:
              "traffic totals agree with the summary's own counters"
     )
     args = p.parse_args(argv)
+    if args.tenants and args.rollout:
+        p.error("--tenants is a one-shot storm mode (no --rollout)")
     if args.inject_fault == "none":
         args.inject_fault = ""
     elif not args.inject_fault:
         # Rollout mode defaults to a clean storm (its assertions pin
-        # zero lost sessions); the one-shot smoke keeps its classic
-        # straggler-sheds-the-last-request scenario.
+        # zero lost sessions); tenants mode too (the quota fast-fail IS
+        # the demonstrated shed path, and the isolation assertions pin
+        # the interactive sibling clean); the one-shot smoke keeps its
+        # classic straggler-sheds-the-last-request scenario.
         args.inject_fault = (
-            "" if args.rollout else f"slow_request@{args.n}"
+            "" if (args.rollout or args.tenants) else f"slow_request@{args.n}"
         )
 
     from gnot_tpu.data.batch import bucket_length
@@ -254,6 +285,24 @@ def run(argv=None) -> dict:
         )
     engine = build_engine(max_batch=args.max_batch)
     traffic = mixed_traffic(args.n, mesh_lo=args.mesh_lo, mesh_hi=args.mesh_hi)
+    tenant_policy = tenant_of = None
+    if args.tenants:
+        from gnot_tpu.data import datasets
+        from gnot_tpu.serve import TenantPolicy
+
+        # Single-bucket traffic on purpose: with every request in ONE
+        # bucket the WFQ + priority drain is the only arbiter of
+        # dispatch order, so the fairness assertion (interactive p50 <=
+        # batch p50) measures the policy, not bucket readiness.
+        traffic = datasets.synth_darcy2d(args.n, seed=0, grid_n=8)
+        quotas = args.tenant_quotas or f"batch:{max(2, args.n // 4)}"
+        tenant_policy = TenantPolicy.from_specs(
+            weights=args.tenant_weights, quotas=quotas
+        )
+        # (i//2) % 2: pairs alternate — interactive, interactive,
+        # batch, batch, ... Equal split, interleaved, so both tenants
+        # share the burst's backlog from the first flush on.
+        tenant_of = lambda i: ("interactive", "batch")[(i // 2) % 2]  # noqa: E731
     pack_plan = None
     if args.packed:
         import jax as _jax
@@ -371,6 +420,7 @@ def run(argv=None) -> dict:
                 session_snapshot_every=args.session_snapshot_every,
                 metrics=registry,
                 catalog=catalog,
+                tenants=tenant_policy,
             )
             if registry is not None:
                 w = dict(
@@ -411,11 +461,14 @@ def run(argv=None) -> dict:
                 publisher.start()
             t_submit = _time.perf_counter()
             futures = []
-            for s in traffic:
+            for i, s in enumerate(traffic):
+                tkw = {"tenant": tenant_of(i)} if tenant_of else {}
                 if args.rollout:
-                    futures.append(server.submit_rollout(s, args.rollout))
+                    futures.append(
+                        server.submit_rollout(s, args.rollout, **tkw)
+                    )
                 else:
-                    futures.append(server.submit(s))
+                    futures.append(server.submit(s, **tkw))
                 if args.pace_s:
                     _time.sleep(args.pace_s)
             results = []
@@ -717,6 +770,109 @@ def run(argv=None) -> dict:
             )
         snaps = [e for e in events if e.get("event") == "session_snapshot"]
         check(bool(snaps), "rollout storm took no session snapshots")
+    if args.tenants:
+        # The multi-tenant isolation contract (docs/serving.md
+        # "Multi-tenant isolation"): quota fast-fail is tenant-scoped
+        # and tenant-tagged, the sibling stays clean, the priority/WFQ
+        # drain favors interactive under the shared backlog, and the
+        # serve_summary per-tenant rollup agrees with the observed
+        # per-future outcomes number-for-number.
+        from gnot_tpu.obs import events as ev_registry
+
+        observed: dict = {}
+        for i, r in enumerate(results):
+            st = observed.setdefault(
+                tenant_of(i), {"requests": 0, "completed": 0, "shed": {}}
+            )
+            st["requests"] += 1
+            if r.ok:
+                st["completed"] += 1
+            else:
+                st["shed"][r.reason] = st["shed"].get(r.reason, 0) + 1
+        roll = summary.get("tenants") or {}
+        check(
+            set(roll) == set(observed),
+            f"serve_summary tenants {sorted(roll)} != submitted tenants "
+            f"{sorted(observed)}",
+        )
+        for t, obs in sorted(observed.items()):
+            got = roll.get(t) or {}
+            check(
+                got.get("requests") == obs["requests"]
+                and got.get("completed") == obs["completed"]
+                and (got.get("shed") or {}) == obs["shed"],
+                f"tenant {t} rollup {got} != observed {obs}",
+            )
+        # Quota fast-fail: the flooding batch tenant shed on its quota;
+        # the unthrottled interactive sibling NEVER did (isolation) —
+        # and in the default clean storm interactive shed NOTHING.
+        batch_obs = observed.get("batch") or {"shed": {}, "completed": 0}
+        inter_obs = observed.get("interactive") or {"shed": {}}
+        n_quota = batch_obs["shed"].get("shed_tenant_quota", 0)
+        check(
+            n_quota >= 1,
+            f"batch flood never hit its admission quota: {batch_obs}",
+        )
+        check(
+            inter_obs["shed"].get("shed_tenant_quota", 0) == 0,
+            f"quota sheds leaked onto the interactive sibling: "
+            f"{inter_obs}",
+        )
+        if not args.inject_fault:
+            check(
+                inter_obs["shed"] == {},
+                f"clean tenants storm shed interactive requests: "
+                f"{inter_obs['shed']}",
+            )
+        check(
+            batch_obs["completed"] >= 1,
+            "batch tenant completed nothing — quota too tight to "
+            "measure the drain",
+        )
+        # Tenant-tagged quota shed events, one per observed quota shed,
+        # all naming the offender, all registry-valid.
+        qevents = [
+            e for e in events if e.get("event") == "tenant_quota_shed"
+        ]
+        check(
+            len(qevents)
+            == sum(
+                st["shed"].get("shed_tenant_quota", 0)
+                for st in observed.values()
+            ),
+            f"{len(qevents)} tenant_quota_shed events != observed quota "
+            f"sheds",
+        )
+        check(
+            all(e.get("tenant") == "batch" for e in qevents),
+            f"a quota shed event named the wrong tenant: "
+            f"{sorted({e.get('tenant') for e in qevents})}",
+        )
+        for rec in qevents:
+            check(
+                ev_registry.validate_record(rec) == [],
+                f"tenant_quota_shed fails registry validation: {rec}",
+            )
+        # WFQ/priority drain fairness: both tenants queued into ONE
+        # bucket in one interleaved burst; the interactive class (3x
+        # weight, higher priority tier) must clear no slower than the
+        # deprioritized batch flood.
+        ip50 = (roll.get("interactive") or {}).get("latency_p50_ms")
+        bp50 = (roll.get("batch") or {}).get("latency_p50_ms")
+        check(
+            ip50 is not None and bp50 is not None and ip50 <= bp50,
+            f"priority drain inverted: interactive p50 {ip50}ms > "
+            f"batch p50 {bp50}ms",
+        )
+        print(
+            "serve_smoke: tenants "
+            + ", ".join(
+                f"{t}: {st['completed']}/{st['requests']} ok "
+                f"shed={st['shed']} "
+                f"p50={roll[t]['latency_p50_ms'] and round(roll[t]['latency_p50_ms'], 1)}ms"
+                for t, st in sorted(observed.items())
+            )
+        )
     if args.prewarm:
         # The prewarmed tier must have compiled NOTHING: hydration is
         # snapshot deserialization (zero compile-cache consultations),
