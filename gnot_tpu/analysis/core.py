@@ -94,8 +94,10 @@ class LintConfig:
     # GL005: registry + docs locations (repo-relative).
     events_registry: str = "gnot_tpu/obs/events.py"
     faults_registry: str = "gnot_tpu/resilience/faults.py"
+    messages_registry: str = "gnot_tpu/serve/federation.py"
     docs_events: str = "docs/observability.md"
     docs_faults: str = "docs/robustness.md"
+    docs_messages: str = "docs/serving.md"
     # GL007: the ctypes bindings module and the C source whose
     # extern "C" declarations it must match (arity + dtype tags).
     native_binding: str = "gnot_tpu/native/__init__.py"

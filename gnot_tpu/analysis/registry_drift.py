@@ -1,20 +1,24 @@
-"""GL005 — event/fault registry drift.
+"""GL005 — event/fault/wire registry drift.
 
-Two central registries exist so the observability surface cannot rot
-silently:
+Three central registries exist so the observability and protocol
+surfaces cannot rot silently:
 
 * ``gnot_tpu/obs/events.py`` — every event kind a ``MetricsSink``
   record may carry (name, required payload fields, emitting module);
 * ``gnot_tpu/resilience/faults.py::FAULT_KINDS`` — every injectable
-  fault kind.
+  fault kind;
+* ``gnot_tpu/serve/federation.py::MESSAGES`` — every federation wire
+  message kind (the versioned multi-host protocol).
 
 The rule enforces, per file: every event kind passed to
 ``sink.log(event=...)`` / ``self._event(...)`` / ``on_event(event=...)``
-resolves to a registry entry (string literals and ``events.<CONST>``
-references both). Project-wide: every registry entry appears in the
-user-facing docs (``docs/observability.md`` for events,
-``docs/robustness.md`` for fault kinds) — the docs are part of the
-contract, so adding a kind without documenting it fails tier-1.
+resolves to an events-registry entry, and every wire kind passed to
+``wire(X, ...)`` resolves to a MESSAGES entry (string literals and
+module-constant references both). Project-wide: every registry entry
+appears in the user-facing docs (``docs/observability.md`` for events,
+``docs/robustness.md`` for fault kinds, ``docs/serving.md`` for wire
+messages) — the docs are part of the contract, so adding a kind
+without documenting it fails tier-1.
 
 Registries are read by AST, not import: the linter must not pay a
 jax/numpy import to check a string table.
@@ -73,7 +77,9 @@ def _parse_registry(path: str) -> tuple[dict[str, int], dict[str, str]]:
             continue
         if node.value is None:
             continue
-        if "EVENTS" in names and isinstance(node.value, ast.Dict):
+        if names & {"EVENTS", "MESSAGES"} and isinstance(
+            node.value, ast.Dict
+        ):
             for k in node.value.keys:
                 if isinstance(k, ast.Constant) and isinstance(k.value, str):
                     kinds[k.value] = k.lineno
@@ -135,19 +141,47 @@ def _emitted_kinds(
     return sites
 
 
+def _wire_sites(ctx: FileContext, constants: dict[str, str]) -> list[_EmitSite]:
+    """Wire message kinds this file passes to ``wire(X, ...)`` — the
+    federation protocol's frame builder. ``X`` may be a string literal
+    or a module-level constant (``HELLO``/``federation.HELLO``);
+    dynamic values are skipped, same as event emit sites."""
+    sites: list[_EmitSite] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        if terminal_name(node.func) != "wire":
+            continue
+        expr = node.args[0]
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            sites.append(_EmitSite(expr.value, expr.lineno))
+            continue
+        name = None
+        if isinstance(expr, ast.Attribute):
+            name = expr.attr
+        elif isinstance(expr, ast.Name):
+            name = expr.id
+        if name is not None and name in constants:
+            sites.append(_EmitSite(constants[name], expr.lineno))
+    return sites
+
+
 @register
 class RegistryDrift(Rule):
     id = "GL005"
     title = "registry-drift"
     hint = (
-        "add the kind to gnot_tpu/obs/events.py (events) or "
-        "resilience/faults.py::FAULT_KINDS (faults), and document it "
-        "in docs/observability.md / docs/robustness.md"
+        "add the kind to gnot_tpu/obs/events.py (events), "
+        "resilience/faults.py::FAULT_KINDS (faults) or "
+        "serve/federation.py::MESSAGES (wire), and document it in "
+        "docs/observability.md / docs/robustness.md / docs/serving.md"
     )
 
     def __init__(self) -> None:
         self._event_kinds: dict[str, dict[str, int]] = {}
         self._constants: dict[str, dict[str, str]] = {}
+        self._msg_kinds: dict[str, dict[str, int]] = {}
+        self._msg_constants: dict[str, dict[str, str]] = {}
 
     def _registry(self, root: str, cfg) -> tuple[dict[str, int], dict[str, str]]:
         key = root
@@ -159,27 +193,57 @@ class RegistryDrift(Rule):
             self._constants[key] = constants
         return self._event_kinds[key], self._constants[key]
 
+    def _messages(self, root: str, cfg) -> tuple[dict[str, int], dict[str, str]]:
+        key = root
+        if key not in self._msg_kinds:
+            kinds, constants = _parse_registry(
+                os.path.join(root, cfg.messages_registry)
+            )
+            self._msg_kinds[key] = kinds
+            self._msg_constants[key] = constants
+        return self._msg_kinds[key], self._msg_constants[key]
+
     def check_file(self, ctx: FileContext) -> list[Finding]:
         kinds, constants = self._registry(ctx.root, ctx.config)
-        if not kinds:
-            # No registry in this tree (fixture sandboxes): the
-            # project-level pass reports the missing registry instead.
-            return []
-        findings = []
-        for site in _emitted_kinds(ctx, constants):
-            if site.kind not in kinds:
-                findings.append(
-                    Finding(
-                        rule=self.id,
-                        path=ctx.path,
-                        line=site.line,
-                        message=(
-                            f"event kind {site.kind!r} is not in the "
-                            f"central registry ({ctx.config.events_registry})"
-                        ),
-                        hint=self.hint,
+        findings: list[Finding] = []
+        if kinds:
+            for site in _emitted_kinds(ctx, constants):
+                if site.kind not in kinds:
+                    findings.append(
+                        Finding(
+                            rule=self.id,
+                            path=ctx.path,
+                            line=site.line,
+                            message=(
+                                f"event kind {site.kind!r} is not in the "
+                                f"central registry ({ctx.config.events_registry})"
+                            ),
+                            hint=self.hint,
+                        )
                     )
-                )
+        # No registry in this tree (fixture sandboxes): the
+        # project-level pass reports the missing registry instead.
+        msg_kinds, msg_constants = self._messages(ctx.root, ctx.config)
+        if msg_kinds:
+            # The registry module defines its constants; a CALLER file
+            # referencing federation.HELLO resolves through them too.
+            lookup = dict(msg_constants)
+            lookup.update(_parse_string_constants(ctx.tree))
+            for site in _wire_sites(ctx, lookup):
+                if site.kind not in msg_kinds:
+                    findings.append(
+                        Finding(
+                            rule=self.id,
+                            path=ctx.path,
+                            line=site.line,
+                            message=(
+                                f"wire message kind {site.kind!r} is not "
+                                "in the MESSAGES registry "
+                                f"({ctx.config.messages_registry})"
+                            ),
+                            hint=self.hint,
+                        )
+                    )
         return findings
 
     def check_project(self, project: ProjectContext) -> list[Finding]:
@@ -219,6 +283,36 @@ class RegistryDrift(Rule):
                 project.root, cfg.faults_registry, fault_kinds, cfg.docs_faults
             )
         )
+        msg_path = os.path.join(project.root, cfg.messages_registry)
+        if os.path.exists(msg_path):
+            msg_kinds, _ = self._messages(project.root, cfg)
+            if not msg_kinds:
+                # Same loudness contract as EVENTS: an existing wire
+                # registry that fails to parse silently disables every
+                # wire-site check — surface it.
+                findings.append(
+                    Finding(
+                        rule=self.id,
+                        path=cfg.messages_registry,
+                        line=1,
+                        message=(
+                            "MESSAGES is not parseable as a literal dict "
+                            "of string keys — GL005 cannot check wire "
+                            "sites against it"
+                        ),
+                        hint="keep MESSAGES a literal {str: MessageSpec} "
+                        "dict",
+                    )
+                )
+            else:
+                findings.extend(
+                    self._docs_coverage(
+                        project.root,
+                        cfg.messages_registry,
+                        msg_kinds,
+                        cfg.docs_messages,
+                    )
+                )
         return findings
 
     def _docs_coverage(

@@ -490,6 +490,24 @@ class ServeConfig:
     # (replica count) and model; a model mismatch degrades to cold
     # warmup, loudly.
     prewarm_manifest: str = ""
+    # Topology-honest federation (serve/federation.py,
+    # docs/distributed.md): hosts > 1 splits the replica pool into
+    # `hosts` independent ReplicaRouter pools, each behind a HostAgent,
+    # and serves through a ClusterRouter over the versioned wire
+    # protocol — lease heartbeats, suspicion→dead failure detection,
+    # partition-tolerant placement and cross-host session migration.
+    # hosts = 1 is the historical single-host path, byte-for-byte.
+    # federation_port: 0 = in-proc links (the loopback-deterministic
+    # default); a real port makes host 0's agent listen on loopback
+    # TCP so external controllers can speak the protocol.
+    # heartbeat_interval_s is the controller tick; suspect_after_s /
+    # dead_after_s are the detector's lease ages (the gap between them
+    # is the dwell — a slow host is drained around, not killed).
+    hosts: int = 1
+    federation_port: int = 0
+    heartbeat_interval_s: float = 0.5
+    suspect_after_s: float = 2.0
+    dead_after_s: float = 6.0
 
     def __post_init__(self) -> None:
         if self.max_batch < 1:
@@ -586,6 +604,38 @@ class ServeConfig:
             raise ValueError(
                 "autoscale_heal_after_s must be > 0, got "
                 f"{self.autoscale_heal_after_s}"
+            )
+        if self.hosts < 1:
+            raise ValueError(f"hosts must be >= 1, got {self.hosts}")
+        if self.hosts > 1 and self.replicas % self.hosts:
+            raise ValueError(
+                f"replicas ({self.replicas}) must divide evenly across "
+                f"hosts ({self.hosts}) — every host pool is identically "
+                "sized so the topology key is well-defined"
+            )
+        if self.federation_port and not (
+            1024 <= self.federation_port <= 65535
+        ):
+            raise ValueError(
+                "federation_port must be 0 (in-proc) or in [1024, 65535], "
+                f"got {self.federation_port}"
+            )
+        if self.heartbeat_interval_s <= 0:
+            raise ValueError(
+                "heartbeat_interval_s must be > 0, got "
+                f"{self.heartbeat_interval_s}"
+            )
+        if not 0 < self.suspect_after_s < self.dead_after_s:
+            raise ValueError(
+                "failure detector needs 0 < suspect_after_s < "
+                "dead_after_s (the suspicion dwell), got "
+                f"{self.suspect_after_s}/{self.dead_after_s}"
+            )
+        if self.hosts > 1 and self.autoscale:
+            raise ValueError(
+                "--autoscale is single-host (the pool-level controller); "
+                "with hosts > 1 use the cluster's scale plane "
+                "(ClusterRouter.scale / autoscale_target)"
             )
         for t, w in parse_tenant_spec(
             self.tenant_weights, what="weight"

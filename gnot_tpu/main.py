@@ -269,6 +269,43 @@ def build_parser() -> argparse.ArgumentParser:
              "session from its last snapshotted step (resume_rollout)"
     )
     p.add_argument(
+        "--hosts", type=int, default=1,
+        help="serving: federate the replica pool across N loopback "
+             "hosts (serve/federation.py, docs/distributed.md) — each "
+             "host wraps an even share of --serve_replicas behind a "
+             "HostAgent; a ClusterRouter places requests/sessions over "
+             "the versioned wire protocol, detects dead hosts by lease, "
+             "and re-migrates their sessions to survivors; 1 = the "
+             "single-host tier, byte-identical to before"
+    )
+    p.add_argument(
+        "--federation_port", type=int, default=0,
+        help="federation: base loopback-TCP port — host i listens on "
+             "port+i and the controller connects real sockets instead "
+             "of in-proc links (0 = in-proc transport; chaos hooks are "
+             "in-proc-only)"
+    )
+    p.add_argument(
+        "--heartbeat_interval_s", type=float, default=0.5,
+        help="federation: cluster control-loop cadence — each tick "
+             "probes every host's lease, sweeps the failure detector, "
+             "and publishes the merged per-host series"
+    )
+    p.add_argument(
+        "--suspect_after_s", type=float, default=2.0,
+        help="federation failure detector: a host silent this long is "
+             "SUSPECT — new placements avoid it and its pending "
+             "one-shots are hedged onto siblings, but nothing is "
+             "declared dead yet"
+    )
+    p.add_argument(
+        "--dead_after_s", type=float, default=6.0,
+        help="federation failure detector: a host silent this long is "
+             "DEAD — its sessions re-migrate to survivors from "
+             "persisted snapshots; must exceed --suspect_after_s (the "
+             "suspicion dwell absorbs GC pauses and slow heartbeats)"
+    )
+    p.add_argument(
         "--autoscale", action="store_true",
         help="serving: self-healing elastic pool (serve/autoscaler.py, "
              "docs/serving.md 'Elastic capacity') — an "
@@ -519,6 +556,11 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "serve.rollout_steps": args.serve_rollout_steps,
             "serve.session_snapshot_every": args.session_snapshot_every,
             "serve.session_dir": args.session_dir,
+            "serve.hosts": args.hosts,
+            "serve.federation_port": args.federation_port,
+            "serve.heartbeat_interval_s": args.heartbeat_interval_s,
+            "serve.suspect_after_s": args.suspect_after_s,
+            "serve.dead_after_s": args.dead_after_s,
             "serve.autoscale": args.autoscale,
             "serve.autoscale_min": args.autoscale_min,
             "serve.autoscale_max": args.autoscale_max,
@@ -1008,6 +1050,15 @@ def _run_serve(
             "drop --scan_layers/--flat_params for replicated serving "
             "(single-server --serve supports them)"
         )
+    if sc.hosts > 1:
+        # Topology-honest federation (serve/federation.py,
+        # docs/distributed.md): the pool splits evenly across loopback
+        # hosts and a ClusterRouter drives the same storm through the
+        # wire protocol. A separate function — the single-host path
+        # below must stay byte-identical when --hosts is 1.
+        return _run_serve_federated(
+            args, cfg, trainer, samples, sink, manifest_extra
+        )
     # Packed dispatch ("pack, don't pad", docs/performance.md): derive
     # the ONE fixed dispatch shape from the traffic itself — the same
     # samples we are about to serve are the representative set.
@@ -1403,6 +1454,169 @@ def _run_serve(
             else ""
         )
     )
+    if rollout_k:
+        done = sum(1 for f in futures if f.result().ok)
+        return done / max(1, len(futures))
+    return summary["completed"] / max(1, summary["requests"])
+
+
+def _run_serve_federated(
+    args, cfg, trainer, samples, sink, manifest_extra=None
+) -> float:
+    """``--serve --hosts N``: the federated serving tier
+    (serve/federation.py, docs/distributed.md). The replica pool splits
+    evenly across N loopback hosts — each behind a ``HostAgent``
+    speaking the versioned wire protocol — and a ``ClusterRouter``
+    drives the same demo storm through lease-checked, partition-tolerant
+    placement; a background control loop ticks the failure detector at
+    ``--heartbeat_interval_s``. ``--federation_port`` swaps the in-proc
+    links for real loopback TCP. Returns the completed fraction."""
+    import threading
+
+    from gnot_tpu.resilience.faults import FaultInjector
+    from gnot_tpu.resilience.preemption import PreemptionHandler
+    from gnot_tpu.serve import build_replicas
+    from gnot_tpu.serve.federation import (
+        build_local_federation,
+        topology_key,
+    )
+
+    sc = cfg.serve
+    per = sc.replicas // sc.hosts  # divisibility config-validated
+    tl = trainer.train_loader
+    replicas = build_replicas(
+        trainer.model,
+        trainer.state.params,
+        sc.replicas,
+        batch_size=sc.max_batch,
+        bucket=cfg.data.bucket,
+        pad_nodes=tl.pad_nodes,
+        pad_funcs=tl.pad_funcs,
+        dtype=sc.dtype,
+    )
+    groups = [replicas[i * per : (i + 1) * per] for i in range(sc.hosts)]
+    session_store = None
+    if sc.session_dir:
+        from gnot_tpu.serve import SessionStore
+
+        # The migration substrate: a survivor resumes a dead host's
+        # sessions from snapshots persisted here. Without it, a host
+        # death falls back to restart-from-zero re-placement.
+        session_store = SessionStore(sc.session_dir)
+    manifests = None
+    if sc.prewarm_manifest:
+        from gnot_tpu.serve import aot
+
+        manifest = aot.load_manifest(sc.prewarm_manifest)
+        if manifest["replicas"] != per:
+            raise ValueError(
+                f"--serve_prewarm manifest was compiled for "
+                f"{manifest['replicas']} replicas; each federated host "
+                f"pools {per} — re-run tools/aot_prewarm.py for the "
+                "per-host topology"
+            )
+        if manifest.get("dtype", "float32") != sc.dtype:
+            raise ValueError(
+                f"--serve_prewarm manifest was compiled at serve dtype "
+                f"{manifest.get('dtype', 'float32')!r}; this run serves "
+                f"{sc.dtype!r}"
+            )
+        manifests = {topology_key(sc.hosts, per): manifest}
+    series_path = None
+    if cfg.train.metrics_path:
+        stem = os.path.splitext(cfg.train.metrics_path)[0]
+        series_path = f"{stem}.series.jsonl"
+    metrics_factory = None
+    if sc.metrics_interval_s > 0 or series_path:
+        from gnot_tpu.obs import metrics as metrics_lib
+
+        metrics_factory = metrics_lib.MetricsRegistry
+    fi = FaultInjector.from_spec(sc.inject_fault)
+    host_ids = [f"host{i}" for i in range(sc.hosts)]
+    # ONE injector shared by every hook level (link, agent, local
+    # router): the single-fire gate inside the injector keeps an
+    # armed `host_kill@3` from killing all N hosts at once.
+    chaos = {h: fi for h in host_ids} if fi is not None else None
+    cluster, agents = build_local_federation(
+        groups,
+        sink=sink,
+        suspect_after_s=sc.suspect_after_s,
+        dead_after_s=sc.dead_after_s,
+        session_store=session_store,
+        link_faults=None if sc.federation_port else chaos,
+        host_faults=chaos,
+        manifests=manifests,
+        series_path=series_path,
+        metrics_factory=metrics_factory,
+        tcp_base_port=sc.federation_port,
+        router_kwargs=dict(
+            max_batch=sc.max_batch,
+            max_wait_ms=sc.max_wait_ms,
+            queue_limit=sc.queue_limit,
+            default_deadline_ms=sc.deadline_ms,
+            breaker_threshold=sc.breaker_threshold,
+            breaker_cooldown_s=sc.breaker_cooldown_s,
+            session_snapshot_every=sc.session_snapshot_every,
+            route_policy=sc.route_policy,
+            faults=fi,
+        ),
+    )
+    rollout_k = sc.rollout_steps
+    futures = []
+    with PreemptionHandler() as preempt:
+        for a in agents.values():
+            a.router.start()
+        # Same startup discipline as single-host: every bucket compiles
+        # on every replica BEFORE traffic, or cold compiles land under
+        # deadlines mid-storm.
+        warmed = sum(r.warm(samples, rows=sc.max_batch) for r in replicas)
+        if manifest_extra is not None:
+            manifest_extra["warmup_cache"] = {
+                "programs_warmed": warmed,
+                "replicas": sc.replicas,
+                "hosts": sc.hosts,
+            }
+        stop = threading.Event()
+
+        def _control_loop():
+            while not stop.is_set():
+                cluster.tick()
+                stop.wait(sc.heartbeat_interval_s)
+
+        ticker = threading.Thread(
+            target=_control_loop, name="fed-control", daemon=True
+        )
+        ticker.start()
+        try:
+            for s in samples:
+                if preempt.triggered:
+                    break
+                if rollout_k:
+                    futures.append(cluster.submit_rollout(s, rollout_k))
+                else:
+                    futures.append(cluster.submit(s))
+            session_timeout = sc.drain_timeout_s * max(1, rollout_k)
+            for f in futures:
+                f.result(timeout=session_timeout)
+        finally:
+            stop.set()
+            ticker.join(timeout=5)
+            summary = cluster.drain(sc.drain_timeout_s)
+            for a in agents.values():
+                a.stop()
+    print(
+        f"Federated serve: {sc.hosts} hosts x {per} replicas "
+        f"({'tcp' if sc.federation_port else 'in-proc'}), "
+        f"{summary['completed']}/{summary['requests']} ok, "
+        f"shed={summary['shed']}, sessions={summary['sessions']} "
+        f"(remigrated={summary['remigrated']}, lost={summary['lost']}), "
+        f"hosts_dead={summary['hosts_dead']}, "
+        f"protocol_errors={summary['protocol_errors']}"
+    )
+    if manifest_extra is not None:
+        manifest_extra["federation"] = {
+            k: v for k, v in summary.items() if k != "per_host"
+        }
     if rollout_k:
         done = sum(1 for f in futures if f.result().ok)
         return done / max(1, len(futures))

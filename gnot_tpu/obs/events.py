@@ -63,6 +63,10 @@ REPLICA_REPLACE = "replica_replace"
 PROGRAM_CATALOG = "program_catalog"
 CAPACITY_SNAPSHOT = "capacity_snapshot"
 TENANT_QUOTA_SHED = "tenant_quota_shed"
+HOST_HEARTBEAT = "host_heartbeat"
+HOST_DEAD = "host_dead"
+SESSION_REMIGRATE = "session_remigrate"
+CLUSTER_SUMMARY = "cluster_summary"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -401,6 +405,55 @@ EVENTS: dict[str, EventSpec] = {
         "fields listed under `unavailable` — partial data degrades "
         "explicitly, never silently)",
         optional=("costs", "replica"),
+    ),
+    "host_heartbeat": EventSpec(
+        fields=("host", "seq", "state"),
+        module="gnot_tpu/serve/federation.py",
+        doc="one failure-detector verdict per heartbeat round per host "
+        "(federated serving, docs/distributed.md): `state` is 'alive' "
+        "| 'suspect' | 'dead' — the lease view AFTER this round's "
+        "ack/silence was folded in; `load` and `pool` carry the "
+        "host's reported in-system load and replica count when the "
+        "ack arrived, `rtt_ms` the heartbeat round-trip",
+        optional=("load", "pool", "rtt_ms", "edge"),
+    ),
+    "host_dead": EventSpec(
+        fields=("host", "silent_s", "sessions"),
+        module="gnot_tpu/serve/federation.py",
+        doc="the failure detector declared a host dead after the full "
+        "suspicion dwell (`silent_s` of lease silence): its pending "
+        "requests are re-placed on survivors and its `sessions` "
+        "resident rollout sessions re-migrate from their persisted "
+        "snapshots (docs/distributed.md 'Failure detector')",
+        optional=("pending", "reason"),
+    ),
+    "session_remigrate": EventSpec(
+        fields=(
+            "session", "from_host", "to_host", "at_step", "replay_from",
+            "reason",
+        ),
+        module="gnot_tpu/serve/federation.py",
+        doc="a rollout session was re-placed onto a SURVIVING HOST "
+        "after its owner host died or partitioned away mid-trajectory "
+        "— the cross-host analogue of `session_migrate`: replay "
+        "resumes from the `replay_from` cursor of the persisted "
+        "SessionStore snapshot (0 = no snapshot survived, full "
+        "at-least-once replay; re-delivered steps are suppressed at "
+        "the cluster layer)",
+    ),
+    "cluster_summary": EventSpec(
+        fields=(
+            "hosts", "requests", "completed", "shed", "sessions",
+            "remigrated", "hosts_dead",
+        ),
+        module="gnot_tpu/serve/federation.py",
+        doc="end-of-federation rollup emitted once at cluster drain "
+        "(beside each host's own `serve_summary`): cluster-level "
+        "request/session accounting, the per-host breakdown "
+        "(`per_host`), and the failure-detector ledger — the "
+        "cross-check target for tools/metrics_report.py's per-host "
+        "slicing",
+        optional=("per_host", "lost", "protocol_errors"),
     ),
     "capacity_snapshot": EventSpec(
         fields=("programs", "pool"),

@@ -52,9 +52,32 @@ count of session steps that server has accepted):
   the breaker like ``nan_output``, and the victim session must replay,
   not keep a poisoned carry.
 
+Federation kinds (multi-host control plane, ``serve/federation.py`` —
+docs/distributed.md; one injector per HOST or per LINK, like the
+router's per-replica injector map):
+
+* ``host_kill@N`` — the host dies abruptly just before handling its
+  Nth inbound control message (agent stops responding mid-protocol,
+  local pool torn down hard): the host-loss shape whose resident
+  sessions the cluster must re-migrate from persisted snapshots.
+* ``net_partition@N`` — the link partitions (frames silently dropped
+  BOTH ways) starting at its Nth outbound frame; the host stays
+  healthy behind it. Healing is scripted by the harness
+  (``heal_partition()``) so the detector's suspect→heal path is
+  exercised deterministically.
+* ``msg_drop@N`` — the link's Nth outbound frame is dropped (single
+  lost datagram-shaped loss; retries/the next heartbeat must absorb
+  it without a false death).
+* ``msg_delay@MS`` — one frame (the first consulted after arming) is
+  delayed by MS milliseconds before delivery: the slow-network shape
+  the suspicion DWELL exists for — a slow host is drained around,
+  never declared dead off one late ack.
+
 Steps are 1-indexed global update counts (the trainer's ``host_step``
 after the dispatch), matching the step numbers in metrics records;
-serve ordinals are 1-indexed admission/dispatch/reload counts.
+serve ordinals are 1-indexed admission/dispatch/reload counts;
+federation ordinals are 1-indexed per-host message / per-link frame
+counts (``msg_delay``'s argument is milliseconds, not an ordinal).
 Step- and epoch-keyed faults fire once; ``ckpt_io`` decrements its
 budget per injected error.
 """
@@ -90,6 +113,12 @@ FAULT_KINDS = (
     "replica_kill",
     "stale_session",
     "rollout_nan",
+    # federation (multi-host control plane, serve/federation.py,
+    # docs/distributed.md)
+    "host_kill",
+    "net_partition",
+    "msg_drop",
+    "msg_delay",
 )
 
 KINDS = FAULT_KINDS  # legacy alias
@@ -262,6 +291,59 @@ class FaultInjector:
             )
             return True
         return False
+
+    # -- federation hooks (gnot_tpu/serve/federation.py) -------------------
+
+    def maybe_host_kill(self, msg_ordinal: int) -> bool:
+        """True once when the host's ``msg_ordinal``-th inbound control
+        message has a ``host_kill`` armed: the HostAgent dies abruptly
+        before handling it (stops responding mid-protocol, pool torn
+        down hard) — the cluster's failure detector must notice via
+        lease silence and re-migrate the resident sessions."""
+        if self._take("host_kill", msg_ordinal):
+            logger.warning(
+                "fault injection: host kill before inbound message #%d",
+                msg_ordinal,
+            )
+            return True
+        return False
+
+    def maybe_net_partition(self, frame_ordinal: int) -> bool:
+        """True once when the link's ``frame_ordinal``-th outbound frame
+        has a ``net_partition`` armed: the link enters a partitioned
+        state (frames dropped BOTH ways) until the harness heals it."""
+        if self._take("net_partition", frame_ordinal):
+            logger.warning(
+                "fault injection: network partition at frame #%d",
+                frame_ordinal,
+            )
+            return True
+        return False
+
+    def maybe_msg_drop(self, frame_ordinal: int) -> bool:
+        """True once when the link's ``frame_ordinal``-th outbound frame
+        has a ``msg_drop`` armed: that single frame is dropped (lost
+        datagram shape; the next heartbeat/retry must absorb it)."""
+        if self._take("msg_drop", frame_ordinal):
+            logger.warning(
+                "fault injection: dropping frame #%d", frame_ordinal
+            )
+            return True
+        return False
+
+    def maybe_msg_delay(self) -> int:
+        """Milliseconds to delay the next frame by (0 = none): fires
+        once per armed ``msg_delay@MS`` spec — the argument is the
+        DELAY, not an ordinal, so the first consultation after arming
+        takes it. Models the slow-network ack the suspicion dwell must
+        tolerate without declaring death."""
+        for s in self.specs:
+            if s.kind == "msg_delay" and self._take("msg_delay", s.at):
+                logger.warning(
+                    "fault injection: delaying frame by %d ms", s.at
+                )
+                return s.at
+        return 0
 
     def maybe_reload_corrupt(self, reload_ordinal: int, directory: str) -> bool:
         """``reload_corrupt@N``: before the Nth hot reload restores,

@@ -71,9 +71,30 @@ and batch-only pressure is answered by deferral instead of replicas.
 With no tenant specs configured the plane is entirely absent — the
 single-tenant path is byte-for-byte unchanged.
 
+Topology-honest federation (docs/distributed.md) lifts the pool tier
+to a multi-host control plane:
+
+* ``federation`` — ``HostAgent`` wraps each host's local
+  ``ReplicaRouter`` (unchanged underneath) behind a versioned,
+  length-prefixed JSON wire protocol (the ``MESSAGES`` registry);
+  ``ClusterRouter`` places one-shots and rollout sessions across
+  hosts, keeps lease heartbeats through a suspicion→dead
+  ``FailureDetector`` (SUSPECT hedges one-shots, DEAD re-migrates
+  sessions cross-host from their persisted ``SessionStore``
+  snapshots), tolerates partitions (revival reconcile replays the
+  terminal outbox; duplicates are suppressed by id and high-water
+  step), refuses version skew loudly, and drains the whole cluster to
+  one ``cluster_summary``. Two transports: real loopback TCP
+  (``HostAgent.listen`` + ``TcpLink``) and a deterministic in-proc
+  link with chaos hooks at the wire seam. With ``--hosts 1`` the
+  plane is entirely absent — the single-host path is byte-for-byte
+  unchanged (pinned by ``tools/federation_ab.py``).
+
 Chaos-tested on CPU via the serve-side fault kinds in
 ``resilience.faults`` (``slow_request@N``, ``nan_output@N``,
-``reload_corrupt@N``) — tests/test_serve.py, tests/test_autoscale.py.
+``reload_corrupt@N``, and the federation kinds ``host_kill@N``,
+``net_partition@N``, ``msg_drop@N``, ``msg_delay@MS``) —
+tests/test_serve.py, tests/test_autoscale.py, tests/test_federation.py.
 """
 
 from gnot_tpu.serve import aot  # noqa: F401
@@ -90,6 +111,13 @@ from gnot_tpu.serve.policies import (  # noqa: F401
     Deadline,
     ReplicaHealthPolicy,
     TenantPolicy,
+)
+from gnot_tpu.serve.federation import (  # noqa: F401
+    ClusterRouter,
+    FailureDetector,
+    HostAgent,
+    build_local_federation,
+    topology_key,
 )
 from gnot_tpu.serve.replica import (  # noqa: F401
     EngineReplica,

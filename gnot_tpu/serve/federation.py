@@ -1,0 +1,2084 @@
+"""Topology-honest federation: a multi-host CONTROL plane over loopback.
+
+Every scaling and survivability claim before this module rode a single
+process: one ``ReplicaRouter``, one pool, one failure domain. At the
+north-star scale GNOT serving is a multi-host system whose dominant
+failure modes are HOST DEATH and NETWORK PARTITION — neither of which a
+single-process test can even express. This module makes the control
+plane honest about topology while the data plane stays local (the
+jaxlib CPU wheel ships no cross-process collectives — see
+``docs/distributed.md`` / ``docs/parallelism.md``): every host is a
+real ``ReplicaRouter`` (unchanged underneath), and hosts talk ONLY
+through a versioned, length-prefixed JSON wire protocol.
+
+Three layers, bottom up:
+
+* **Wire protocol** — 4-byte big-endian length prefix + UTF-8 JSON
+  payload. ``MESSAGES`` is the literal wire-schema registry (the GL005
+  registry-drift lint parses it, same as ``obs/events.py::EVENTS``);
+  every frame is built by :func:`wire` which validates against it.
+  ``FrameDecoder`` is a stateful tolerant parser: truncated frames
+  buffer, garbage JSON is counted and skipped, oversize frames are
+  drained in skip-mode — a malformed peer can NEVER wedge a host.
+  Version skew is refused loudly at the ``hello`` handshake.
+
+* **Transports** — ``TcpLink`` speaks the real loopback-TCP shape
+  (socket + reader thread); ``InProcLink`` delivers the SAME encoded
+  bytes synchronously on the caller's thread with an injectable clock,
+  so chaos tests (partitions, dropped/delayed frames, host kills) are
+  deterministic. Both feed identical ``FrameDecoder`` state machines:
+  the in-proc tests exercise the real codec, not a shortcut.
+
+* **Control plane** — ``HostAgent`` wraps one host's local pool and
+  serves the protocol (place, stream, drain, stats, prewarm, scale).
+  ``ClusterRouter`` is the controller: lease-based heartbeats feed a
+  suspicion→dead ``FailureDetector`` (a silent host dwells in SUSPECT —
+  drained around via hedged placements — before being declared dead, so
+  a merely slow host is never killed); one-shot requests hedge/retry to
+  survivors with at-least-once suppression (first ``result`` wins);
+  rollout sessions owned by a dead host are RE-MIGRATED to a survivor
+  from their persisted ``SessionStore`` snapshots (the PR 13 replay
+  discipline, now cross-host: restored prefix is identical, replayed
+  steps are suppressed below the cluster's high-water mark);
+  ``drain()`` resolves every future on every host and emits ONE
+  ``cluster_summary``. Autoscaling is cluster-scoped: merged per-host
+  series, scale-ups target the least-loaded live host, and AOT
+  manifests keyed by host topology hydrate joiners without a compile.
+
+Chaos is injected at the seams the real system fails at:
+``host_kill@N`` (agent dies before its Nth inbound control message),
+``net_partition@N`` / ``msg_drop@N`` (Nth outbound frame partitions the
+link / vanishes), ``msg_delay@MS`` (one frame held MS fake-clock
+milliseconds) — registered in ``resilience/faults.py::FAULT_KINDS`` and
+A/B'd by ``tools/federation_ab.py`` → ``docs/artifacts/federation_ab.jsonl``.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+from gnot_tpu.data.batch import MeshSample
+from gnot_tpu.obs import events
+from gnot_tpu.serve.rollout import RolloutResult
+from gnot_tpu.serve.server import ServeResult
+
+# --------------------------------------------------------------------------
+# Wire protocol: framing
+# --------------------------------------------------------------------------
+
+#: Protocol generation. Bumped on any incompatible wire change; a
+#: ``hello`` carrying a different version is refused with
+#: ``hello_reject`` (version-skew must fail LOUDLY at connect time, not
+#: silently mis-parse mid-storm).
+PROTOCOL_VERSION = 1
+
+#: Hard per-frame payload ceiling. A length prefix above this is
+#: treated as hostile/corrupt: the decoder drains the declared bytes in
+#: skip-mode (never buffering them) and counts ``oversize``.
+MAX_FRAME_BYTES = 8 * 1024 * 1024
+
+
+class ProtocolError(RuntimeError):
+    """Loud failure of the wire contract (version skew, invalid
+    message against ``MESSAGES``, handshake timeout)."""
+
+
+def encode_frame(msg: dict) -> bytes:
+    """One wire frame: 4-byte big-endian payload length + UTF-8 JSON."""
+    payload = json.dumps(msg, separators=(",", ":")).encode("utf-8")
+    if len(payload) > MAX_FRAME_BYTES:
+        raise ProtocolError(
+            f"frame payload {len(payload)}B exceeds MAX_FRAME_BYTES"
+        )
+    return len(payload).to_bytes(4, "big") + payload
+
+
+class FrameDecoder:
+    """Stateful, tolerant frame parser — the receive half of the wire.
+
+    ``feed(data)`` accepts ANY byte split (TCP gives no message
+    boundaries) and returns the complete, well-formed messages it can
+    extract. Malformed input degrades, never wedges:
+
+    * truncated frame → buffered until more bytes arrive;
+    * length prefix of 0 or payload that is not a JSON object with a
+      ``kind`` → counted in ``garbage``, stream continues;
+    * length prefix above ``max_frame_bytes`` → counted in
+      ``oversize`` and the declared payload is DRAINED in skip-mode
+      (bounded memory even for a 4 GiB claim), stream continues.
+
+    Raw non-frame garbage is necessarily misread as a length prefix —
+    the decoder consumes it as a bogus frame and resynchronises; the
+    worst case is skipped bytes and bumped counters, never an
+    exception or an unbounded buffer.
+    """
+
+    def __init__(self, max_frame_bytes: int = MAX_FRAME_BYTES) -> None:
+        self.max_frame_bytes = max_frame_bytes
+        self._buf = bytearray()
+        self._skip = 0  # bytes of an oversize payload left to drain
+        self.garbage = 0
+        self.oversize = 0
+
+    def feed(self, data: bytes) -> list[dict]:
+        self._buf.extend(data)
+        out: list[dict] = []
+        while True:
+            if self._skip:
+                take = min(self._skip, len(self._buf))
+                del self._buf[:take]
+                self._skip -= take
+                if self._skip:
+                    break
+                continue
+            if len(self._buf) < 4:
+                break
+            n = int.from_bytes(self._buf[:4], "big")
+            if n == 0:
+                self.garbage += 1
+                del self._buf[:4]
+                continue
+            if n > self.max_frame_bytes:
+                self.oversize += 1
+                del self._buf[:4]
+                self._skip = n
+                continue
+            if len(self._buf) < 4 + n:
+                break
+            payload = bytes(self._buf[4 : 4 + n])
+            del self._buf[: 4 + n]
+            try:
+                msg = json.loads(payload.decode("utf-8"))
+            except (UnicodeDecodeError, json.JSONDecodeError):
+                self.garbage += 1
+                continue
+            if not isinstance(msg, dict) or "kind" not in msg:
+                self.garbage += 1
+                continue
+            out.append(msg)
+        return out
+
+
+# --------------------------------------------------------------------------
+# Wire protocol: message schema registry (GL005-checked)
+# --------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MessageSpec:
+    """Schema of one wire message kind: required field names, one-line
+    doc (rendered into ``docs/serving.md``), optional field names."""
+
+    fields: tuple[str, ...]
+    doc: str
+    optional: tuple[str, ...] = ()
+
+
+# Controller→agent kinds.
+HELLO = "hello"
+HEARTBEAT = "heartbeat"
+SUBMIT = "submit"
+SUBMIT_ROLLOUT = "submit_rollout"
+DRAIN = "drain"
+STATS = "stats"
+PREWARM = "prewarm"
+SCALE = "scale"
+# Agent→controller kinds.
+HELLO_OK = "hello_ok"
+HELLO_REJECT = "hello_reject"
+HEARTBEAT_ACK = "heartbeat_ack"
+RESULT = "result"
+PLACED = "placed"
+STEP = "step"
+ROLLOUT_DONE = "rollout_done"
+DRAIN_OK = "drain_ok"
+STATS_OK = "stats_ok"
+PREWARM_OK = "prewarm_ok"
+SCALE_OK = "scale_ok"
+ERROR = "error"
+
+#: The wire-schema registry. Same contract as ``obs/events.py::EVENTS``:
+#: literal string keys (the GL005 registry-drift lint AST-parses this
+#: dict — never imports it), every kind documented in
+#: ``docs/serving.md``, every frame built through :func:`wire`.
+MESSAGES: dict[str, MessageSpec] = {
+    "hello": MessageSpec(
+        fields=("version",),
+        doc="Controller handshake; carries the controller's protocol "
+        "version for skew refusal.",
+        optional=("cluster",),
+    ),
+    "hello_ok": MessageSpec(
+        fields=("version", "host", "pool"),
+        doc="Agent accepts the handshake: its host id, pool size and "
+        "(optionally) topology key for manifest matching.",
+        optional=("topology",),
+    ),
+    "hello_reject": MessageSpec(
+        fields=("version", "want"),
+        doc="Version-skew refusal: the agent's version and the version "
+        "it requires. The controller raises ProtocolError.",
+        optional=("host",),
+    ),
+    "heartbeat": MessageSpec(
+        fields=("seq",),
+        doc="Controller lease probe, monotonically sequenced per host.",
+    ),
+    "heartbeat_ack": MessageSpec(
+        fields=("seq", "host", "load"),
+        doc="Agent lease renewal: echoes seq, reports queue load; "
+        "feeds the FailureDetector and cluster autoscaling.",
+        optional=("pool", "sessions", "depth"),
+    ),
+    "submit": MessageSpec(
+        fields=("id", "sample"),
+        doc="Place one one-shot request (base64 array codec) on the "
+        "agent's local router.",
+        optional=("deadline_ms", "tenant"),
+    ),
+    "result": MessageSpec(
+        fields=("id", "ok"),
+        doc="Terminal reply for a one-shot submit; duplicates from "
+        "hedged placements are suppressed (first wins).",
+        optional=("reason", "output", "latency_ms", "detail"),
+    ),
+    "submit_rollout": MessageSpec(
+        fields=("id", "steps"),
+        doc="Place (resume=false) or re-migrate (resume=true, from the "
+        "persisted SessionStore snapshot) a rollout session.",
+        optional=(
+            "sample",
+            "name",
+            "resume",
+            "deadline_ms",
+            "rollout_deadline_ms",
+            "tenant",
+        ),
+    ),
+    "placed": MessageSpec(
+        fields=("id", "host", "at_step"),
+        doc="Rollout placement ack; at_step is the restored snapshot "
+        "cursor (0 for a fresh session) — the migration replay point.",
+    ),
+    "step": MessageSpec(
+        fields=("id", "step", "output"),
+        doc="One committed rollout step streamed back; the cluster's "
+        "high-water mark suppresses replayed duplicates.",
+    ),
+    "rollout_done": MessageSpec(
+        fields=("id", "ok"),
+        doc="Terminal reply for a rollout session; carries the FULL "
+        "per-step outputs so step frames lost to a healed partition "
+        "are repaired at resolution.",
+        optional=(
+            "reason",
+            "steps_completed",
+            "migrations",
+            "drained_at_step",
+            "detail",
+            "outputs",
+        ),
+    ),
+    "drain": MessageSpec(
+        fields=(),
+        doc="Coordinated drain: the agent drains its local pool and "
+        "replies drain_ok with the pool serve_summary.",
+        optional=("timeout_s",),
+    ),
+    "drain_ok": MessageSpec(
+        fields=("host", "summary"),
+        doc="Drain completion with the host's pool-level summary dict.",
+    ),
+    "stats": MessageSpec(
+        fields=("seq",),
+        doc="Poll the agent's MetricsRegistry snapshot.",
+    ),
+    "stats_ok": MessageSpec(
+        fields=("seq", "host", "series"),
+        doc="Registry snapshot reply; the controller prefixes series "
+        "keys with 'host<id>/' and merges across hosts.",
+    ),
+    "prewarm": MessageSpec(
+        fields=("manifest",),
+        doc="Hydrate the joiner's pool from a topology-keyed AOT "
+        "deploy manifest (no trace, no compile).",
+    ),
+    "prewarm_ok": MessageSpec(
+        fields=("host", "replicas"),
+        doc="Prewarm completion: replicas hydrated.",
+    ),
+    "scale": MessageSpec(
+        fields=("direction",),
+        doc="Cluster-scoped autoscale order ('up'/'down') targeted at "
+        "the least-/most-loaded live host.",
+        optional=("reason",),
+    ),
+    "scale_ok": MessageSpec(
+        fields=("host", "ok", "pool"),
+        doc="Scale order outcome with the host's new pool size.",
+        optional=("detail",),
+    ),
+    "error": MessageSpec(
+        fields=("reason",),
+        doc="Agent-side protocol failure for one inbound message "
+        "(unknown kind, schema violation); bad_kind names the "
+        "offending message's kind; the stream continues.",
+        optional=("detail", "bad_kind"),
+    ),
+}
+
+_CONSTANT_KINDS = {
+    v
+    for k, v in list(globals().items())
+    if k.isupper() and isinstance(v, str) and v in MESSAGES
+}
+assert _CONSTANT_KINDS == set(MESSAGES), (
+    "MESSAGES registry and module constants diverged: "
+    f"{_CONSTANT_KINDS.symmetric_difference(set(MESSAGES))}"
+)
+
+
+def validate_message(msg: dict) -> None:
+    """Raise :class:`ProtocolError` unless ``msg`` matches its
+    registered :class:`MessageSpec` (unknown kind, or a required field
+    missing). Extra fields are allowed — the registry pins the floor,
+    forward-compatible senders may say more."""
+    kind = msg.get("kind")
+    spec = MESSAGES.get(kind)
+    if spec is None:
+        raise ProtocolError(f"unregistered message kind {kind!r}")
+    missing = [f for f in spec.fields if f not in msg]
+    if missing:
+        raise ProtocolError(f"message {kind!r} missing fields {missing}")
+
+
+def wire(_kind: str, **fields) -> dict:
+    """Build one validated wire message. EVERY frame either side sends
+    goes through here — the GL005 lint resolves these call sites
+    against ``MESSAGES`` exactly like ``events.py`` emit sites."""
+    msg = {"kind": _kind, **fields}
+    validate_message(msg)
+    return msg
+
+
+# --------------------------------------------------------------------------
+# Array / sample codec (byte-exact: b64 of the raw buffer)
+# --------------------------------------------------------------------------
+
+
+def _enc_arr(a) -> dict | None:
+    if a is None:
+        return None
+    a = np.ascontiguousarray(a)
+    return {
+        "shape": list(a.shape),
+        "dtype": str(a.dtype),
+        "b64": base64.b64encode(a.tobytes()).decode("ascii"),
+    }
+
+
+def _dec_arr(d) -> np.ndarray | None:
+    if d is None:
+        return None
+    raw = base64.b64decode(d["b64"])
+    return (
+        np.frombuffer(raw, dtype=np.dtype(d["dtype"]))
+        .reshape(d["shape"])
+        .copy()
+    )
+
+
+def encode_sample(sample: MeshSample) -> dict:
+    """JSON-safe MeshSample: every array round-trips byte-exactly."""
+    return {
+        "coords": _enc_arr(sample.coords),
+        "y": _enc_arr(sample.y),
+        "theta": _enc_arr(sample.theta),
+        "funcs": [_enc_arr(f) for f in sample.funcs],
+    }
+
+
+def decode_sample(d: dict) -> MeshSample:
+    return MeshSample(
+        coords=_dec_arr(d["coords"]),
+        y=_dec_arr(d["y"]),
+        theta=_dec_arr(d["theta"]),
+        funcs=tuple(_dec_arr(f) for f in d["funcs"]),
+    )
+
+
+def topology_key(hosts: int, replicas_per_host: int) -> str:
+    """Canonical topology identity for AOT manifest matching: a deploy
+    manifest prewarmed for ``h2r3`` only hydrates a joiner in a 2-host,
+    3-replica-per-host cluster."""
+    return f"h{hosts}r{replicas_per_host}"
+
+
+# --------------------------------------------------------------------------
+# Failure detector: ALIVE → SUSPECT → DEAD, with dwell
+# --------------------------------------------------------------------------
+
+ALIVE = "alive"
+SUSPECT = "suspect"
+DEAD = "dead"
+
+
+class FailureDetector:
+    """Lease-based suspicion→dead detector.
+
+    A host that stops acking heartbeats moves to SUSPECT after
+    ``suspect_after_s`` of silence and to DEAD only after
+    ``dead_after_s`` — the dwell between the two is the design point: a
+    SUSPECT host is drained AROUND (hedged placements, no new work) but
+    its in-flight work is left alone, because slowness is far more
+    common than death and a false kill costs a migration storm. Any
+    ack revives (DEAD → ALIVE is allowed: that is a partition healing;
+    the lease renews and hedged duplicates are suppressed downstream).
+    """
+
+    def __init__(
+        self,
+        *,
+        suspect_after_s: float = 2.0,
+        dead_after_s: float = 6.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if not 0 < suspect_after_s < dead_after_s:
+            raise ValueError(
+                "need 0 < suspect_after_s < dead_after_s (the dwell), "
+                f"got {suspect_after_s} / {dead_after_s}"
+            )
+        self.suspect_after_s = suspect_after_s
+        self.dead_after_s = dead_after_s
+        self._clock = clock
+        self._last: dict[str, float] = {}
+        self._state: dict[str, str] = {}
+        self._probe_start: dict[str, float] = {}  # first UNANSWERED probe
+
+    def register(self, host: str) -> None:
+        self._last[host] = self._clock()
+        self._state[host] = ALIVE
+        self._probe_start.pop(host, None)
+
+    def probe(self, host: str) -> None:
+        """Record that a liveness probe was just sent. Once probing is
+        in use, silence is anchored at the first UNANSWERED probe — a
+        controller that idles between registration and its first
+        heartbeat round (replica warm-up, a long GC pause) must not
+        have its OWN idle gap billed as host silence, or the first
+        sweep after the gap declares every slow-to-ack host instantly
+        dead without a single real probe going unanswered."""
+        if host not in self._probe_start:
+            self._probe_start[host] = self._clock()
+
+    def ack(self, host: str) -> str:
+        """Lease renewal — any ack, from any state, revives the host.
+        Returns the PREVIOUS state, so the caller can reconcile a
+        revival (a healed partition means frames were lost both ways —
+        in-flight work on the revived host must be re-driven)."""
+        old = self._state.get(host, DEAD)
+        self._last[host] = self._clock()
+        self._state[host] = ALIVE
+        self._probe_start.pop(host, None)  # the probe was answered
+        return old
+
+    def state(self, host: str) -> str:
+        return self._state.get(host, DEAD)
+
+    def silent_s(self, host: str) -> float:
+        now = self._clock()
+        anchor = self._last.get(host, now)
+        p = self._probe_start.get(host)
+        if p is not None:
+            anchor = max(anchor, p)
+        return now - anchor
+
+    def sweep(self) -> list[tuple[str, str, str]]:
+        """Advance every host's state off lease age; returns the edge
+        list ``[(host, old_state, new_state), ...]`` (empty when
+        nothing changed). DEAD is sticky under silence — only
+        :meth:`ack` leaves it."""
+        edges: list[tuple[str, str, str]] = []
+        for host in list(self._last):
+            old = self._state[host]
+            silent = self.silent_s(host)
+            if silent >= self.dead_after_s:
+                new = DEAD
+            elif silent >= self.suspect_after_s:
+                new = SUSPECT if old != DEAD else DEAD
+            else:
+                new = old  # freshness is recorded by ack(), not sweep
+            if new != old:
+                self._state[host] = new
+                edges.append((host, old, new))
+        return edges
+
+
+# --------------------------------------------------------------------------
+# Transports
+# --------------------------------------------------------------------------
+
+
+class InProcLink:
+    """Deterministic in-proc transport: the SAME encoded frames as TCP,
+    delivered synchronously on the caller's thread through real
+    ``FrameDecoder`` state, with chaos hooks at the wire seam.
+
+    Outbound (controller→agent) frames are ordinal-counted per link:
+    ``net_partition@N`` partitions the link BOTH ways at the Nth frame
+    (healed only by :meth:`heal_partition`), ``msg_drop@N`` silently
+    drops the Nth frame, ``msg_delay@MS`` holds one frame for MS
+    fake-clock milliseconds (released by :meth:`flush`, which
+    ``ClusterRouter.tick`` calls). Replies cross the same partition
+    check — a partition is a LINK failure, not a direction failure.
+    """
+
+    def __init__(
+        self,
+        agent: "HostAgent",
+        *,
+        faults=None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._agent = agent
+        self._faults = faults
+        self._clock = clock
+        self._n_out = 0
+        self.partitioned = False
+        self._pending: list[tuple[float, bytes]] = []  # (due, frame)
+        self._on_message: Callable[[dict], None] | None = None
+        self._to_agent = FrameDecoder()
+        self._to_ctrl = FrameDecoder()
+
+    # -- controller side ----------------------------------------------------
+    def connect(self, on_message: Callable[[dict], None]) -> None:
+        self._on_message = on_message
+
+    def arm(self, faults) -> None:
+        """(Re)attach a fault injector mid-stream — the federation
+        builder arms chaos only after the handshake so the hello frame
+        can never be the chaos victim."""
+        self._faults = faults
+
+    def send(self, msg: dict) -> bool:
+        """Controller→agent. Returns False when the frame was eaten by
+        a fault (partition/drop) or deferred by msg_delay."""
+        frame = encode_frame(msg)
+        self._n_out += 1
+        f = self._faults
+        if f is not None and f.maybe_net_partition(self._n_out):
+            self.partitioned = True
+        if self.partitioned:
+            return False
+        if f is not None and f.maybe_msg_drop(self._n_out):
+            return False
+        if f is not None:
+            delay_ms = f.maybe_msg_delay()
+            if delay_ms > 0:
+                self._pending.append(
+                    (self._clock() + delay_ms / 1000.0, frame)
+                )
+                return False
+        self._deliver(frame)
+        return True
+
+    def flush(self) -> int:
+        """Release every delayed frame whose due time has passed (the
+        tick-driven half of ``msg_delay``). Returns frames released."""
+        now = self._clock()
+        due = [f for t, f in self._pending if t <= now]
+        self._pending = [(t, f) for t, f in self._pending if t > now]
+        for frame in due:
+            if not self.partitioned:
+                self._deliver(frame)
+        return len(due)
+
+    def heal_partition(self) -> None:
+        self.partitioned = False
+
+    def close(self) -> None:
+        self._pending.clear()
+
+    # -- delivery -----------------------------------------------------------
+    def _deliver(self, frame: bytes) -> None:
+        for msg in self._to_agent.feed(frame):
+            self._agent.handle(msg, self._reply)
+
+    def _reply(self, msg: dict) -> None:
+        """Agent→controller: same partition, same codec."""
+        if self.partitioned:
+            return
+        frame = encode_frame(msg)
+        if self._on_message is None:
+            return
+        for m in self._to_ctrl.feed(frame):
+            self._on_message(m)
+
+    @property
+    def protocol_errors(self) -> int:
+        return (
+            self._to_agent.garbage
+            + self._to_agent.oversize
+            + self._to_ctrl.garbage
+            + self._to_ctrl.oversize
+        )
+
+
+class TcpLink:
+    """Real loopback-TCP transport: a client socket to a
+    ``HostAgent.listen`` endpoint, frames written whole under a lock,
+    replies decoded on a reader thread and handed to ``connect``'s
+    callback. No chaos hooks — determinism lives in ``InProcLink``;
+    this transport exists so the protocol is proven against real
+    sockets (partial reads, interleaved frames, peer close)."""
+
+    def __init__(self, host: str, port: int, *, timeout_s: float = 5.0):
+        self._sock = socket.create_connection((host, port), timeout=timeout_s)
+        self._sock.settimeout(0.2)
+        self._wlock = threading.Lock()
+        self._decoder = FrameDecoder()
+        self._on_message: Callable[[dict], None] | None = None
+        self._closed = False
+        self._reader: threading.Thread | None = None
+        self.partitioned = False  # API parity with InProcLink
+
+    def connect(self, on_message: Callable[[dict], None]) -> None:
+        self._on_message = on_message
+        self._reader = threading.Thread(
+            target=self._read_loop, name="fed-link-reader", daemon=True
+        )
+        self._reader.start()
+
+    def send(self, msg: dict) -> bool:
+        frame = encode_frame(msg)
+        with self._wlock:
+            try:
+                self._sock.sendall(frame)
+                return True
+            except OSError:
+                return False
+
+    def flush(self) -> int:
+        return 0
+
+    def heal_partition(self) -> None:
+        self.partitioned = False
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _read_loop(self) -> None:
+        while not self._closed:
+            try:
+                data = self._sock.recv(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if not data:
+                return
+            for msg in self._decoder.feed(data):
+                if self._on_message is not None:
+                    self._on_message(msg)
+
+    @property
+    def protocol_errors(self) -> int:
+        return self._decoder.garbage + self._decoder.oversize
+
+
+# --------------------------------------------------------------------------
+# HostAgent: one host's protocol server around its local pool
+# --------------------------------------------------------------------------
+
+
+class HostAgent:
+    """The per-host half of the federation: speaks the wire protocol on
+    behalf of one local ``ReplicaRouter`` (unchanged underneath).
+
+    The agent is transport-agnostic — ``handle(msg, send)`` is the
+    whole server, called by ``InProcLink`` synchronously or by the TCP
+    accept loop per connection. Replies go through the ``send`` the
+    message arrived with, so hedged controllers and multiple
+    connections each get their own stream.
+
+    Chaos: ``faults`` arms ``host_kill@N`` — the agent dies (stops
+    handling AND stops sending; in-flight local work keeps running but
+    its results never leave the host) immediately BEFORE handling its
+    Nth inbound control message. That models a kill -9 between frames:
+    the controller sees only silence and must detect it by lease.
+    """
+
+    def __init__(
+        self,
+        host_id: str,
+        router,
+        *,
+        sink=None,
+        faults=None,
+        session_store=None,
+        metrics=None,
+        scale_cb: Callable[[str], int] | None = None,
+        version: int = PROTOCOL_VERSION,
+        topology: str | None = None,
+    ) -> None:
+        self.host_id = host_id
+        self.router = router
+        self.sink = sink
+        self.faults = faults
+        self.session_store = session_store
+        self.metrics = metrics
+        self.scale_cb = scale_cb
+        self.version = version
+        self.topology = topology
+        self.alive = True
+        self.errors = 0  # inbound messages refused with ERROR
+        self._n_in = 0
+        self._hb_seq_seen = -1
+        # At-least-once discipline: the controller re-sends in-flight
+        # work after a partition heals, so duplicates are NORMAL.
+        # ``_inflight`` makes a duplicate placement a no-op (the live
+        # future's callbacks already stream to the link); ``_outbox``
+        # retains every terminal reply so a duplicate for finished work
+        # re-sends the SAME result instead of re-running it.
+        self._inflight: set[str] = set()
+        self._outbox: dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._server_sock: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        self._stopping = False
+
+    # -- lifecycle ----------------------------------------------------------
+    def kill(self) -> None:
+        """Silent death: no goodbye frame, no flush — exactly what the
+        failure detector must be able to survive."""
+        self.alive = False
+
+    def drain_local(self, timeout_s: float = 30.0) -> dict:
+        return self.router.drain(timeout_s=timeout_s)
+
+    # -- protocol server ----------------------------------------------------
+    def handle(self, msg: dict, send: Callable[[dict], None]) -> None:
+        """Serve one inbound message. Schema violations answer ERROR
+        and the stream continues — one bad frame never wedges the
+        agent. All replies are suppressed once killed."""
+        if not self.alive:
+            return
+        with self._lock:
+            self._n_in += 1
+            n = self._n_in
+        if self.faults is not None and self.faults.maybe_host_kill(n):
+            self.kill()
+            return
+        reply = self._guarded(send)
+        try:
+            validate_message(msg)
+        except ProtocolError as e:
+            self.errors += 1
+            reply(wire(ERROR, reason=str(e), bad_kind=str(msg.get("kind"))))
+            return
+        kind = msg["kind"]
+        if kind == SUBMIT_ROLLOUT and not msg.get("resume") and (
+            "sample" not in msg
+        ):
+            self.errors += 1
+            reply(
+                wire(
+                    ERROR,
+                    reason="submit_rollout without resume needs a sample",
+                    bad_kind=kind,
+                )
+            )
+            return
+        try:
+            if kind == HELLO:
+                self._on_hello(msg, reply)
+            elif kind == HEARTBEAT:
+                self._on_heartbeat(msg, reply)
+            elif kind == SUBMIT:
+                self._on_submit(msg, reply)
+            elif kind == SUBMIT_ROLLOUT:
+                self._on_submit_rollout(msg, reply)
+            elif kind == DRAIN:
+                summary = self.drain_local(
+                    timeout_s=float(msg.get("timeout_s", 30.0))
+                )
+                reply(wire(DRAIN_OK, host=self.host_id, summary=summary))
+            elif kind == STATS:
+                series = (
+                    self.metrics.snapshot() if self.metrics is not None else {}
+                )
+                reply(
+                    wire(
+                        STATS_OK,
+                        seq=msg["seq"],
+                        host=self.host_id,
+                        series=series,
+                    )
+                )
+            elif kind == PREWARM:
+                stats = self.router.prewarm_from(msg["manifest"])
+                reply(
+                    wire(
+                        PREWARM_OK, host=self.host_id, replicas=len(stats)
+                    )
+                )
+            elif kind == SCALE:
+                self._on_scale(msg, reply)
+            else:
+                # Agent→controller kinds arriving here are a peer bug.
+                self.errors += 1
+                reply(
+                    wire(
+                        ERROR,
+                        reason=f"kind {kind!r} is not a controller request",
+                        bad_kind=kind,
+                    )
+                )
+        except ProtocolError as e:
+            self.errors += 1
+            reply(wire(ERROR, reason=str(e), bad_kind=kind))
+        except Exception as e:  # hardening: one bad frame never wedges
+            self.errors += 1
+            reply(
+                wire(ERROR, reason="internal", bad_kind=kind, detail=repr(e))
+            )
+
+    def _guarded(self, send: Callable[[dict], None]):
+        def _send(msg: dict) -> None:
+            if self.alive:
+                send(msg)
+
+        return _send
+
+    # -- handlers -----------------------------------------------------------
+    def _on_hello(self, msg: dict, reply) -> None:
+        if int(msg["version"]) != self.version:
+            reply(
+                wire(
+                    HELLO_REJECT,
+                    version=int(msg["version"]),
+                    want=self.version,
+                    host=self.host_id,
+                )
+            )
+            return
+        out = wire(
+            HELLO_OK,
+            version=self.version,
+            host=self.host_id,
+            pool=len(self.router.pool()),
+        )
+        if self.topology is not None:
+            out["topology"] = self.topology
+        reply(out)
+
+    def _on_heartbeat(self, msg: dict, reply) -> None:
+        with self._lock:
+            self._hb_seq_seen = max(self._hb_seq_seen, int(msg["seq"]))
+        reply(
+            wire(
+                HEARTBEAT_ACK,
+                seq=int(msg["seq"]),
+                host=self.host_id,
+                load=self._load(),
+                pool=len(self.router.pool()),
+            )
+        )
+
+    def _load(self) -> float:
+        """The placement signal: live queue depth across the pool."""
+        total = 0
+        for rep in self.router.pool():
+            server = getattr(rep, "server", None)
+            if server is not None:
+                try:
+                    total += int(server.depth())
+                except Exception:
+                    pass
+        return float(total)
+
+    def _on_submit(self, msg: dict, reply) -> None:
+        rid = msg["id"]
+        with self._lock:
+            done_msg = self._outbox.get(rid)
+            running = rid in self._inflight
+            if done_msg is None and not running:
+                self._inflight.add(rid)
+        if done_msg is not None:
+            reply(done_msg)  # idempotent replay of the terminal result
+            return
+        if running:
+            return  # live future's callback will stream the result
+        sample = decode_sample(msg["sample"])
+        fut = self.router.submit(
+            sample,
+            deadline_ms=msg.get("deadline_ms"),
+            tenant=msg.get("tenant"),
+        )
+
+        def _done(f: Future) -> None:
+            try:
+                res = f.result()
+                out = wire(
+                    RESULT,
+                    id=rid,
+                    ok=bool(res.ok),
+                    reason=res.reason,
+                    output=_enc_arr(res.output),
+                    latency_ms=res.latency_ms,
+                    detail=res.detail,
+                )
+            except Exception as e:  # a local bug, surfaced honestly
+                out = wire(
+                    RESULT, id=rid, ok=False, reason="exception",
+                    detail=str(e),
+                )
+            with self._lock:
+                self._outbox[rid] = out
+                self._inflight.discard(rid)
+            reply(out)
+
+        fut.add_done_callback(_done)
+
+    def _on_submit_rollout(self, msg: dict, reply) -> None:
+        rid = msg["id"]
+        name = msg.get("name") or rid
+        at_step = 0
+        with self._lock:
+            done_msg = self._outbox.get(rid)
+            running = rid in self._inflight
+            if done_msg is None and not running:
+                self._inflight.add(rid)
+        if done_msg is not None:
+            reply(done_msg)  # idempotent replay of the terminal result
+            return
+        if running:
+            # Reconcile duplicate for a session still executing here:
+            # ack the placement; its live callbacks keep streaming.
+            reply(wire(PLACED, id=rid, host=self.host_id, at_step=0))
+            return
+
+        def _on_step(sid: str, step: int, output) -> None:
+            reply(
+                wire(STEP, id=rid, step=int(step), output=_enc_arr(output))
+            )
+
+        if msg.get("resume"):
+            # Re-migration: restore from the persisted snapshot. The
+            # restored cursor is the replay point the controller's
+            # session_remigrate event reports.
+            state = None
+            if self.session_store is not None:
+                try:
+                    state = self.session_store.load(name)
+                except KeyError:
+                    state = None
+            if state is None:
+                with self._lock:
+                    self._inflight.discard(rid)
+                reply(
+                    wire(
+                        ROLLOUT_DONE,
+                        id=rid,
+                        ok=False,
+                        reason="no_snapshot",
+                        detail=f"nothing persisted for {name!r}",
+                    )
+                )
+                return
+            at_step = int(state.get("cursor", 0))
+            fut = self.router.resume_rollout(
+                name,
+                deadline_ms=msg.get("deadline_ms"),
+                rollout_deadline_ms=msg.get("rollout_deadline_ms"),
+                on_step=_on_step,
+            )
+        else:
+            fut = self.router.submit_rollout(
+                decode_sample(msg["sample"]),
+                int(msg["steps"]),
+                deadline_ms=msg.get("deadline_ms"),
+                rollout_deadline_ms=msg.get("rollout_deadline_ms"),
+                on_step=_on_step,
+                name=name,
+                tenant=msg.get("tenant"),
+            )
+        reply(wire(PLACED, id=rid, host=self.host_id, at_step=at_step))
+
+        def _done(f: Future) -> None:
+            try:
+                res = f.result()
+                out = wire(
+                    ROLLOUT_DONE,
+                    id=rid,
+                    ok=bool(res.ok),
+                    reason=res.reason,
+                    steps_completed=int(res.steps_completed),
+                    migrations=int(res.migrations),
+                    drained_at_step=res.drained_at_step,
+                    detail=res.detail,
+                    # Full per-step outputs ride the terminal frame so
+                    # step frames lost to a healed partition are
+                    # repaired at cluster resolution.
+                    outputs=[_enc_arr(o) for o in res.outputs],
+                )
+            except Exception as e:
+                out = wire(
+                    ROLLOUT_DONE, id=rid, ok=False,
+                    reason="exception", detail=str(e),
+                )
+            with self._lock:
+                self._outbox[rid] = out
+                self._inflight.discard(rid)
+            reply(out)
+
+        fut.add_done_callback(_done)
+
+    def _on_scale(self, msg: dict, reply) -> None:
+        if self.scale_cb is None:
+            reply(
+                wire(
+                    SCALE_OK,
+                    host=self.host_id,
+                    ok=False,
+                    pool=len(self.router.pool()),
+                    detail="no scale_cb wired",
+                )
+            )
+            return
+        pool = int(self.scale_cb(str(msg["direction"])))
+        reply(wire(SCALE_OK, host=self.host_id, ok=True, pool=pool))
+
+    # -- TCP server ---------------------------------------------------------
+    def listen(self, port: int = 0) -> int:
+        """Serve the protocol on loopback TCP; returns the bound port
+        (``port=0`` asks the OS). One reader thread per connection —
+        each connection gets its own framed reply writer."""
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(("127.0.0.1", port))
+        srv.listen(8)
+        srv.settimeout(0.2)
+        self._server_sock = srv
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"fed-{self.host_id}", daemon=True
+        )
+        self._accept_thread.start()
+        return srv.getsockname()[1]
+
+    def stop(self) -> None:
+        self._stopping = True
+        if self._server_sock is not None:
+            try:
+                self._server_sock.close()
+            except OSError:
+                pass
+
+    def _accept_loop(self) -> None:
+        while not self._stopping:
+            try:
+                conn, _ = self._server_sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._conn_loop,
+                args=(conn,),
+                name=f"fed-{self.host_id}-conn",
+                daemon=True,
+            ).start()
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        conn.settimeout(0.2)
+        wlock = threading.Lock()
+
+        def _send(msg: dict) -> None:
+            frame = encode_frame(msg)
+            with wlock:
+                try:
+                    conn.sendall(frame)
+                except OSError:
+                    pass
+
+        decoder = FrameDecoder()
+        while not self._stopping:
+            try:
+                data = conn.recv(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                break
+            if not data:
+                break
+            for msg in decoder.feed(data):
+                self.handle(msg, _send)
+        self.errors += decoder.garbage + decoder.oversize
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+# --------------------------------------------------------------------------
+# ClusterRouter: the federation controller
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class _Pending:
+    """One in-flight one-shot: where it has been placed (hedges add
+    hosts) and the caller's future (first RESULT wins)."""
+
+    rid: str
+    sample: MeshSample
+    deadline_ms: float | None
+    tenant: str | None
+    future: Future
+    hosts: set[str] = field(default_factory=set)
+    last_sent: float = 0.0  # clock of the last placement frame
+
+
+@dataclass
+class _ClusterSession:
+    """One cluster-owned rollout session: current owner host,
+    high-water streamed step (replay suppression across migrations),
+    and accumulated per-step outputs."""
+
+    rid: str
+    name: str
+    steps: int
+    owner: str
+    future: Future
+    on_step: Callable | None
+    deadline_ms: float | None
+    rollout_deadline_ms: float | None
+    tenant: str | None
+    sample: MeshSample | None = None  # retained for restart-from-zero
+    streamed: int = 0  # high-water committed step seen by the cluster
+    at_step: int = 0  # last placement's restored cursor
+    migrations: int = 0
+    restarts: int = 0  # no-snapshot restarts consumed (bounded)
+    outputs: dict[int, np.ndarray] = field(default_factory=dict)
+    last_sent: float = 0.0  # clock of the last placement frame
+    acked: bool = False  # PLACED seen for the CURRENT placement
+    last_resume: bool = False  # how the current placement was sent
+
+
+@dataclass
+class _HostState:
+    host_id: str
+    link: object
+    pool: int = 0
+    load: float = 0.0
+    hb_seq: int = 0
+    last_series: dict = field(default_factory=dict)
+    placed: int = 0  # placements routed here (hedges included)
+
+
+class ClusterRouter:
+    """The federation controller: places work across ``HostAgent``
+    hosts, keeps leases, survives partitions and host death, drains
+    the whole cluster to one ``cluster_summary``.
+
+    Single-threaded control loop by design: the owner calls
+    :meth:`tick` on whatever cadence it likes (tests drive a fake
+    clock); inbound messages may arrive on any thread (TCP readers) —
+    state is lock-guarded, and the lock is NEVER held across a
+    ``link.send`` (the in-proc transport delivers synchronously, so a
+    send can re-enter :meth:`_on_message` on the same stack).
+    """
+
+    def __init__(
+        self,
+        *,
+        sink=None,
+        clock: Callable[[], float] = time.monotonic,
+        suspect_after_s: float = 2.0,
+        dead_after_s: float = 6.0,
+        manifests: dict[str, dict] | None = None,
+        series_path: str | None = None,
+        failover: bool = True,
+    ) -> None:
+        self.sink = sink
+        self.failover = failover  # False: the A/B twin — a dead host's
+        # work resolves lost instead of re-placing (tools/federation_ab.py
+        # measures what failover is worth against this baseline)
+        self._clock = clock
+        self.detector = FailureDetector(
+            suspect_after_s=suspect_after_s,
+            dead_after_s=dead_after_s,
+            clock=clock,
+        )
+        self.manifests = dict(manifests or {})
+        self._series_path = series_path
+        self._series_seq = 0
+        self._lock = threading.RLock()
+        self._hosts: dict[str, _HostState] = {}
+        self._pending: dict[str, _Pending] = {}
+        self._sessions: dict[str, _ClusterSession] = {}
+        self._session_by_name: dict[str, str] = {}
+        self._next_id = 0
+        self._hb_seq = 0
+        self._stats_seq = 0
+        self._drained = False
+        self.protocol_errors = 0  # controller-side schema violations
+        # The honest ledger cluster_summary reports.
+        self.counts = {
+            "requests": 0,
+            "completed": 0,
+            "shed": 0,
+            "suppressed": 0,
+            "sessions": 0,
+            "remigrated": 0,
+            "lost": 0,
+            "hosts_dead": 0,
+        }
+
+    # -- membership ---------------------------------------------------------
+    def add_host(self, host_id: str, link) -> None:
+        """Handshake and register one host. Version skew raises
+        :class:`ProtocolError` LOUDLY — a skewed host must never join
+        quietly and mis-parse frames mid-storm. If an AOT manifest is
+        registered for the joiner's topology key, it is hydrated before
+        taking traffic (warm join, no compile)."""
+        if host_id in self._hosts:
+            raise ValueError(f"host {host_id!r} already federated")
+        state = _HostState(host_id=host_id, link=link)
+        done = threading.Event()
+        verdict: dict = {}
+
+        def _on_message(msg: dict) -> None:
+            if not done.is_set() and msg.get("kind") in (
+                HELLO_OK,
+                HELLO_REJECT,
+            ):
+                verdict.update(msg)
+                done.set()
+                return
+            self._on_message(host_id, msg)
+
+        link.connect(_on_message)
+        link.send(wire(HELLO, version=PROTOCOL_VERSION))
+        if not done.wait(timeout=5.0):
+            raise ProtocolError(f"host {host_id!r}: no hello reply")
+        if verdict["kind"] == HELLO_REJECT:
+            raise ProtocolError(
+                f"host {host_id!r} refused federation: protocol version "
+                f"skew (ours {PROTOCOL_VERSION}, theirs {verdict['want']})"
+            )
+        state.pool = int(verdict.get("pool", 0))
+        with self._lock:
+            self._hosts[host_id] = state
+        self.detector.register(host_id)
+        manifest = self.manifests.get(verdict.get("topology"))
+        if manifest is not None:
+            link.send(wire(PREWARM, manifest=manifest))
+
+    def hosts(self) -> list[str]:
+        with self._lock:
+            return list(self._hosts)
+
+    def host_state(self, host_id: str) -> str:
+        return self.detector.state(host_id)
+
+    # -- placement ----------------------------------------------------------
+    def _alive_hosts(self) -> list[_HostState]:
+        with self._lock:
+            return [
+                h
+                for h in self._hosts.values()
+                if self.detector.state(h.host_id) == ALIVE
+            ]
+
+    def _pick_host(
+        self, exclude: set[str] = frozenset()
+    ) -> _HostState | None:
+        """Least-loaded ALIVE host (SUSPECT hosts are drained around)."""
+        candidates = [
+            h for h in self._alive_hosts() if h.host_id not in exclude
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=lambda h: (h.load, h.placed, h.host_id))
+
+    def merged_load(self) -> dict[str, float]:
+        """Per-host queue load from the last heartbeat acks — the
+        cluster autoscaler's sensor."""
+        with self._lock:
+            return {h.host_id: h.load for h in self._hosts.values()}
+
+    def autoscale_target(self, direction: str = "up") -> str | None:
+        """Host an autoscale order should land on. Both directions
+        target the LEAST-loaded live host: a scale-up lands where
+        there is headroom to absorb the new replica's warmup, a
+        scale-down removes capacity where it is least missed."""
+        h = self._pick_host()
+        return None if h is None else h.host_id
+
+    def scale(self, direction: str, *, reason: str = "load") -> bool:
+        target = self.autoscale_target(direction)
+        if target is None:
+            return False
+        with self._lock:
+            link = self._hosts[target].link
+        return bool(link.send(wire(SCALE, direction=direction, reason=reason)))
+
+    def submit(
+        self,
+        sample: MeshSample,
+        *,
+        deadline_ms: float | None = None,
+        tenant: str | None = None,
+    ) -> Future:
+        """Place one one-shot on the least-loaded live host. Mirrors
+        ``ReplicaRouter.submit``: the future resolves to a
+        ``ServeResult`` (ok=False with reason ``no_host`` when no live
+        host exists — shed honestly, never hung)."""
+        fut: Future = Future()
+        rid = self._new_id("q")
+        pend = _Pending(
+            rid=rid,
+            sample=sample,
+            deadline_ms=deadline_ms,
+            tenant=tenant,
+            future=fut,
+        )
+        with self._lock:
+            self.counts["requests"] += 1
+            self._pending[rid] = pend
+        if not self._place_oneshot(pend):
+            self._resolve_oneshot(
+                rid,
+                ServeResult(
+                    ok=False, reason="no_host", output=None,
+                    detail="no live host", latency_ms=0.0,
+                ),
+            )
+        return fut
+
+    def _place_oneshot(self, pend: _Pending) -> bool:
+        host = self._pick_host(exclude=pend.hosts)
+        if host is None:
+            return False
+        msg = wire(
+            SUBMIT,
+            id=pend.rid,
+            sample=encode_sample(pend.sample),
+        )
+        if pend.deadline_ms is not None:
+            msg["deadline_ms"] = pend.deadline_ms
+        if pend.tenant is not None:
+            msg["tenant"] = pend.tenant
+        with self._lock:
+            pend.hosts.add(host.host_id)
+            pend.last_sent = self._clock()
+            host.placed += 1
+        host.link.send(msg)
+        return True
+
+    def submit_rollout(
+        self,
+        sample: MeshSample,
+        steps: int,
+        *,
+        deadline_ms: float | None = None,
+        rollout_deadline_ms: float | None = None,
+        on_step: Callable | None = None,
+        name: str | None = None,
+        tenant: str | None = None,
+    ) -> Future:
+        """Place one rollout session. Every cluster session is NAMED
+        (auto ``c%05d``) so the owner host persists its rolling
+        snapshots — the migration substrate: if the owner dies, the
+        session resumes on a survivor from the persisted cursor, and
+        steps replayed below the cluster's high-water mark are
+        suppressed. The future resolves to a ``RolloutResult``."""
+        fut: Future = Future()
+        rid = self._new_id("s")
+        sess = _ClusterSession(
+            rid=rid,
+            name=name or rid,
+            steps=int(steps),
+            owner="",
+            future=fut,
+            on_step=on_step,
+            deadline_ms=deadline_ms,
+            rollout_deadline_ms=rollout_deadline_ms,
+            tenant=tenant,
+            sample=sample,
+        )
+        host = self._pick_host()
+        with self._lock:
+            self.counts["sessions"] += 1
+            self._sessions[rid] = sess
+            self._session_by_name[sess.name] = rid
+        if host is None:
+            self._resolve_session(
+                rid, ok=False, reason="no_host", detail="no live host"
+            )
+            return fut
+        self._send_rollout(sess, host, sample=sample, resume=False)
+        return fut
+
+    def _send_rollout(
+        self,
+        sess: _ClusterSession,
+        host: _HostState,
+        *,
+        sample: MeshSample | None,
+        resume: bool,
+    ) -> None:
+        msg = wire(
+            SUBMIT_ROLLOUT,
+            id=sess.rid,
+            steps=sess.steps,
+            name=sess.name,
+            resume=resume,
+        )
+        if sample is not None:
+            msg["sample"] = encode_sample(sample)
+        if sess.deadline_ms is not None:
+            msg["deadline_ms"] = sess.deadline_ms
+        if sess.rollout_deadline_ms is not None:
+            msg["rollout_deadline_ms"] = sess.rollout_deadline_ms
+        if sess.tenant is not None:
+            msg["tenant"] = sess.tenant
+        with self._lock:
+            sess.owner = host.host_id
+            sess.last_sent = self._clock()
+            sess.acked = False  # each placement needs a fresh PLACED
+            sess.last_resume = resume
+            host.placed += 1
+        host.link.send(msg)
+
+    # -- inbound ------------------------------------------------------------
+    def _on_message(self, host_id: str, msg: dict) -> None:
+        """Controller-side dispatch. May run on a TCP reader thread or
+        re-entrantly on the controller's own stack (in-proc sends) —
+        hence the RLock, and no sends while holding it."""
+        try:
+            validate_message(msg)
+        except ProtocolError:
+            with self._lock:
+                self.protocol_errors += 1
+            return
+        kind = msg["kind"]
+        if kind == HEARTBEAT_ACK:
+            was = self.detector.ack(host_id)
+            with self._lock:
+                h = self._hosts.get(host_id)
+                if h is not None:
+                    h.load = float(msg["load"])
+                    h.pool = int(msg.get("pool", h.pool))
+            if was != ALIVE:
+                # Revival (partition healed / slow host caught up):
+                # frames were lost BOTH ways while the link was down —
+                # re-drive this host's in-flight work. Agents are
+                # idempotent (inflight set + terminal outbox), so the
+                # worst case is a replayed result the first-wins /
+                # high-water suppression already handles.
+                self._reconcile(host_id)
+        elif kind == RESULT:
+            res = ServeResult(
+                ok=bool(msg["ok"]),
+                reason=str(msg.get("reason") or ""),
+                output=_dec_arr(msg.get("output")),
+                detail=str(msg.get("detail") or ""),
+                latency_ms=float(msg.get("latency_ms") or 0.0),
+            )
+            self._resolve_oneshot(msg["id"], res)
+        elif kind == PLACED:
+            with self._lock:
+                sess = self._sessions.get(msg["id"])
+                if sess is not None:
+                    sess.at_step = int(msg["at_step"])
+                    sess.acked = True
+        elif kind == STEP:
+            self._on_step(msg)
+        elif kind == ROLLOUT_DONE:
+            self._on_rollout_done(host_id, msg)
+        elif kind in (STATS_OK,):
+            with self._lock:
+                h = self._hosts.get(host_id)
+                if h is not None:
+                    h.last_series = dict(msg["series"])
+        elif kind in (DRAIN_OK, PREWARM_OK, SCALE_OK, ERROR, HELLO_OK,
+                      HELLO_REJECT):
+            # DRAIN_OK is consumed by drain()'s waiter; the others are
+            # informational acks — recorded, never fatal.
+            with self._lock:
+                h = self._hosts.get(host_id)
+                if h is not None and kind == DRAIN_OK:
+                    h.last_series["_drain_summary"] = msg["summary"]
+
+    def _on_step(self, msg: dict) -> None:
+        cb = None
+        with self._lock:
+            sess = self._sessions.get(msg["id"])
+            if sess is None:
+                return
+            sess.acked = True  # a streamed step proves delivery even
+            # when the PLACED ack itself was the dropped frame
+            step = int(msg["step"])
+            if step <= sess.streamed:
+                # Replayed duplicate from a migration (or a hedge):
+                # at-least-once delivery, exactly-once consumption.
+                self.counts["suppressed"] += 1
+                return
+            sess.streamed = step
+            sess.outputs[step] = _dec_arr(msg["output"])
+            cb = sess.on_step
+            name = sess.name
+            out = sess.outputs[step]
+        if cb is not None:
+            cb(name, step, out)
+
+    def _on_rollout_done(self, host_id: str, msg: dict) -> None:
+        restart_to = None
+        with self._lock:
+            sess = self._sessions.get(msg["id"])
+            if sess is None or sess.future.done():
+                if sess is not None:
+                    self.counts["suppressed"] += 1
+                return
+            if not msg["ok"] and sess.owner != host_id:
+                # A failure report from a PREVIOUS owner (e.g. the dead
+                # host's local failure surfacing after we re-migrated):
+                # the new placement is authoritative.
+                self.counts["suppressed"] += 1
+                return
+            if (
+                not msg["ok"]
+                and msg.get("reason") == "no_snapshot"
+                and sess.sample is not None
+                and sess.restarts < 3
+            ):
+                # The owner died before its first persisted snapshot —
+                # there is nothing to resume, but the cluster still
+                # holds the original sample: RESTART from step zero on
+                # a survivor (deterministic engine → identical steps;
+                # re-streamed prefixes are suppressed by the high-water
+                # mark). Bounded, so a poisoned session cannot bounce
+                # forever.
+                sess.restarts += 1
+                restart_to = True
+        if restart_to:
+            host = self._pick_host()
+            if host is not None:
+                self._send_rollout(
+                    sess, host, sample=sess.sample, resume=False
+                )
+                return
+        self._resolve_session(
+            msg["id"],
+            ok=bool(msg["ok"]),
+            reason=msg.get("reason"),
+            steps_completed=int(msg.get("steps_completed") or 0),
+            drained_at_step=msg.get("drained_at_step"),
+            local_migrations=int(msg.get("migrations") or 0),
+            detail=msg.get("detail"),
+            wire_outputs=msg.get("outputs"),
+        )
+
+    # -- resolution ---------------------------------------------------------
+    def _resolve_oneshot(self, rid: str, res: ServeResult) -> None:
+        with self._lock:
+            pend = self._pending.pop(rid, None)
+            if pend is None or pend.future.done():
+                self.counts["suppressed"] += 1
+                return
+            self.counts["completed" if res.ok else "shed"] += 1
+        pend.future.set_result(res)
+
+    def _resolve_session(
+        self,
+        rid: str,
+        *,
+        ok: bool,
+        reason: str | None,
+        steps_completed: int = 0,
+        drained_at_step=None,
+        local_migrations: int = 0,
+        detail=None,
+        wire_outputs=None,
+    ) -> None:
+        with self._lock:
+            sess = self._sessions.pop(rid, None)
+            if sess is None or sess.future.done():
+                return
+            self._session_by_name.pop(sess.name, None)
+            if ok:
+                self.counts["completed"] += 1
+            elif reason in ("host_dead", "no_host", "no_snapshot"):
+                self.counts["lost"] += 1
+            else:
+                self.counts["shed"] += 1
+            # Gap repair: step frames lost to a healed partition are
+            # filled from the terminal frame's full output list
+            # (deterministic engine — streamed and terminal copies of
+            # one step are byte-identical, so precedence is moot).
+            for i, enc in enumerate(wire_outputs or []):
+                step = i + 1
+                if step not in sess.outputs and enc is not None:
+                    sess.outputs[step] = _dec_arr(enc)
+            outputs = [sess.outputs[k] for k in sorted(sess.outputs)]
+        sess.future.set_result(
+            RolloutResult(
+                ok=ok,
+                reason=str(reason or ("ok" if ok else "error")),
+                session=sess.name,
+                steps=sess.steps,
+                steps_completed=steps_completed or sess.streamed,
+                outputs=outputs,
+                drained_at_step=drained_at_step,
+                migrations=sess.migrations + local_migrations,
+                detail=str(detail or ""),
+            )
+        )
+
+    # -- the control loop ---------------------------------------------------
+    def tick(self) -> list[tuple[str, str, str]]:
+        """One control-loop beat: flush delayed frames, probe leases,
+        sweep the detector, react to edges (hedge around SUSPECT,
+        declare + re-migrate on DEAD), publish merged per-host series.
+        Returns the detector edges (tests assert on them)."""
+        with self._lock:
+            hosts = list(self._hosts.values())
+            self._hb_seq += 1
+            seq = self._hb_seq
+        for h in hosts:
+            h.link.flush()
+        for h in hosts:
+            # Every host gets probed, DEAD ones included: a partition
+            # heal revives via the next ack — DEAD is not forever.
+            # probe() anchors the silence clock BEFORE the send: an
+            # in-proc ack arrives inline and clears it, an unanswered
+            # probe starts the suspicion dwell from here, not from
+            # whenever the controller last had time to tick.
+            self.detector.probe(h.host_id)
+            h.link.send(wire(HEARTBEAT, seq=seq))
+        edges = self.detector.sweep()
+        for host_id, old, new in edges:
+            if new == SUSPECT:
+                self._hedge_around(host_id)
+            elif new == DEAD:
+                self._on_host_dead(host_id)
+        self._redrive_stale()
+        for h in hosts:
+            self._event(
+                events.HOST_HEARTBEAT,
+                host=h.host_id,
+                seq=seq,
+                state=self.detector.state(h.host_id),
+                load=h.load,
+                pool=h.pool,
+                edge=next(
+                    (f"{o}->{n}" for hid, o, n in edges if hid == h.host_id),
+                    None,
+                ),
+            )
+        self._publish_series(hosts)
+        return edges
+
+    def _redrive_stale(self) -> None:
+        """At-least-once re-delivery: a submit frame dropped on an
+        otherwise-HEALTHY link hangs its future forever — heartbeats
+        keep flowing, so no detector edge ever re-drives it (the
+        reconcile/hedge/death paths all key off lease state). Re-send
+        any placement unacknowledged for a full suspicion dwell:
+        agents dedupe by request id (inflight set + terminal-outbox
+        replay) and the controller suppresses duplicate replies, so a
+        spurious re-send costs one suppressed result, never a fork."""
+        now = self._clock()
+        dwell = self.detector.suspect_after_s
+        with self._lock:
+            stale_pend = [
+                p
+                for p in self._pending.values()
+                if not p.future.done()
+                and p.hosts
+                and now - p.last_sent >= dwell
+            ]
+            stale_sess = [
+                s
+                for s in self._sessions.values()
+                if not s.acked
+                and not s.future.done()
+                and s.last_sent > 0.0
+                and now - s.last_sent >= dwell
+            ]
+        for p in stale_pend:
+            with self._lock:
+                p.last_sent = now
+            for host_id in sorted(p.hosts):
+                if self.detector.state(host_id) == DEAD:
+                    continue  # _on_host_dead owns the death path
+                with self._lock:
+                    host = self._hosts.get(host_id)
+                if host is None:
+                    continue
+                msg = wire(
+                    SUBMIT, id=p.rid, sample=encode_sample(p.sample)
+                )
+                if p.deadline_ms is not None:
+                    msg["deadline_ms"] = p.deadline_ms
+                if p.tenant is not None:
+                    msg["tenant"] = p.tenant
+                host.link.send(msg)
+        for s in stale_sess:
+            if self.detector.state(s.owner) == DEAD:
+                continue
+            with self._lock:
+                host = self._hosts.get(s.owner)
+            if host is None:
+                continue
+            # Replay the CURRENT placement verbatim: a dropped resume
+            # stays a resume (a failed one falls through to the
+            # restart-from-zero fallback), a dropped fresh submit
+            # re-ships the sample.
+            self._send_rollout(
+                s,
+                host,
+                sample=None if s.last_resume else s.sample,
+                resume=s.last_resume,
+            )
+
+    def _reconcile(self, host_id: str) -> None:
+        """Re-drive a revived host's in-flight work: re-send every
+        pending one-shot placed there and re-attach every session it
+        owns (``resume=True`` — the agent acks a still-running session,
+        replays a terminal outbox hit, or resumes from snapshot)."""
+        with self._lock:
+            host = self._hosts.get(host_id)
+            pend = [
+                p
+                for p in self._pending.values()
+                if host_id in p.hosts and not p.future.done()
+            ]
+            sessions = [
+                s
+                for s in self._sessions.values()
+                if s.owner == host_id and not s.future.done()
+            ]
+        if host is None:
+            return
+        for p in pend:
+            msg = wire(SUBMIT, id=p.rid, sample=encode_sample(p.sample))
+            if p.deadline_ms is not None:
+                msg["deadline_ms"] = p.deadline_ms
+            if p.tenant is not None:
+                msg["tenant"] = p.tenant
+            with self._lock:
+                p.last_sent = self._clock()
+            host.link.send(msg)
+        for s in sessions:
+            self._send_rollout(s, host, sample=None, resume=True)
+
+    def _hedge_around(self, host_id: str) -> None:
+        """SUSPECT reaction: duplicate this host's in-flight one-shots
+        onto a healthy sibling. If the suspect was merely slow, the
+        first RESULT wins and the loser is suppressed — the request
+        never notices. Sessions are NOT hedged (two live writers of one
+        session would fork it); they wait for the dwell."""
+        with self._lock:
+            pending = [
+                p
+                for p in self._pending.values()
+                if host_id in p.hosts and not p.future.done()
+            ]
+        for pend in pending:
+            self._place_oneshot(pend)
+
+    def _on_host_dead(self, host_id: str) -> None:
+        """DEAD reaction: the dwell expired. Re-place every one-shot
+        whose only placement was the dead host; re-migrate every owned
+        session to a survivor from its persisted snapshot; resolve
+        honestly (reason ``host_dead``) when no survivor exists."""
+        with self._lock:
+            self.counts["hosts_dead"] += 1
+            silent = self.detector.silent_s(host_id)
+            owned_sessions = [
+                s for s in self._sessions.values() if s.owner == host_id
+            ]
+            sole_pending = [
+                p
+                for p in self._pending.values()
+                if p.hosts == {host_id} and not p.future.done()
+            ]
+        self._event(
+            events.HOST_DEAD,
+            host=host_id,
+            silent_s=round(silent, 3),
+            sessions=len(owned_sessions),
+            pending=len(sole_pending),
+            reason="lease_expired",
+        )
+        for pend in sole_pending:
+            if not self.failover or not self._place_oneshot(pend):
+                self._resolve_oneshot(
+                    pend.rid,
+                    ServeResult(
+                        ok=False, reason="host_dead", output=None,
+                        detail=f"owner {host_id} dead, no survivor",
+                        latency_ms=0.0,
+                    ),
+                )
+        for sess in owned_sessions:
+            survivor = (
+                self._pick_host(exclude={host_id}) if self.failover else None
+            )
+            if survivor is None:
+                self._resolve_session(
+                    sess.rid,
+                    ok=False,
+                    reason="host_dead",
+                    detail=f"owner {host_id} dead, no survivor",
+                )
+                continue
+            from_host = sess.owner
+            with self._lock:
+                sess.migrations += 1
+                self.counts["remigrated"] += 1
+            self._send_rollout(sess, survivor, sample=None, resume=True)
+            self._event(
+                events.SESSION_REMIGRATE,
+                session=sess.name,
+                from_host=from_host,
+                to_host=survivor.host_id,
+                at_step=sess.streamed,
+                replay_from=sess.at_step,
+                reason="host_dead",
+            )
+
+    def _publish_series(self, hosts: list[_HostState]) -> None:
+        """Merged per-host metrics row: every host's registry snapshot
+        with keys prefixed ``host<id>/`` — one row a single
+        ``metrics_report.py`` invocation can slice by host."""
+        if self._series_path is None:
+            return
+        with self._lock:
+            self._stats_seq += 1
+            seq = self._stats_seq
+        for h in hosts:
+            if self.detector.state(h.host_id) == ALIVE:
+                h.link.send(wire(STATS, seq=seq))
+        merged: dict = {}
+        with self._lock:
+            self._series_seq += 1
+            row_seq = self._series_seq
+            for h in hosts:
+                for key, st in h.last_series.items():
+                    if key.startswith("_"):
+                        continue
+                    merged[f"{h.host_id}/{key}"] = st
+        row = {"seq": row_seq, "t": self._clock(), "series": merged}
+        with open(self._series_path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(row) + "\n")
+
+    # -- drain --------------------------------------------------------------
+    def drain(self, timeout_s: float = 30.0) -> dict:
+        """Coordinated cluster drain: every live host drains its local
+        pool; every still-pending cluster future resolves (drained
+        one-shots as shed, unfinished sessions honestly); ONE
+        ``cluster_summary`` event reports the ledger. Idempotent."""
+        with self._lock:
+            if self._drained:
+                return self._summary()
+            self._drained = True
+            hosts = list(self._hosts.values())
+        per_host: dict[str, dict] = {}
+        deadline = self._clock() + timeout_s
+        for h in hosts:
+            if self.detector.state(h.host_id) == DEAD:
+                continue
+            h.link.flush()
+            h.link.send(wire(DRAIN, timeout_s=timeout_s))
+        # TCP replies are asynchronous: poll for the summaries.
+        while self._clock() < deadline:
+            with self._lock:
+                missing = [
+                    h
+                    for h in hosts
+                    if self.detector.state(h.host_id) != DEAD
+                    and "_drain_summary" not in h.last_series
+                ]
+            if not missing:
+                break
+            time.sleep(0.02)
+        # Final series row at the drained registries' terminal values:
+        # without it the last published row predates the storm's
+        # completion and a per-host breakdown reads zero counters.
+        self._publish_series(hosts)
+        with self._lock:
+            for h in hosts:
+                if "_drain_summary" in h.last_series:
+                    per_host[h.host_id] = h.last_series["_drain_summary"]
+            leftover_pending = list(self._pending.keys())
+            leftover_sessions = list(self._sessions.keys())
+        for rid in leftover_pending:
+            self._resolve_oneshot(
+                rid,
+                ServeResult(
+                    ok=False, reason="drained", output=None,
+                    detail="cluster drained", latency_ms=0.0,
+                ),
+            )
+        for rid in leftover_sessions:
+            self._resolve_session(
+                rid, ok=False, reason="drained", detail="cluster drained"
+            )
+        summary = self._summary(per_host)
+        self._event(events.CLUSTER_SUMMARY, **summary)
+        return summary
+
+    def _summary(self, per_host: dict | None = None) -> dict:
+        with self._lock:
+            proto_errors = self.protocol_errors + sum(
+                getattr(h.link, "protocol_errors", 0)
+                for h in self._hosts.values()
+            )
+            return {
+                "hosts": len(self._hosts),
+                "requests": self.counts["requests"],
+                "completed": self.counts["completed"],
+                "shed": self.counts["shed"],
+                "sessions": self.counts["sessions"],
+                "remigrated": self.counts["remigrated"],
+                "hosts_dead": self.counts["hosts_dead"],
+                "per_host": per_host or {},
+                "lost": self.counts["lost"],
+                "protocol_errors": proto_errors,
+            }
+
+    # -- plumbing -----------------------------------------------------------
+    def _new_id(self, prefix: str) -> str:
+        with self._lock:
+            self._next_id += 1
+            return f"{prefix}{self._next_id:05d}"
+
+    def _event(self, event: str, **fields) -> None:
+        if self.sink is not None:
+            self.sink.log(event=event, **fields)
+
+
+# --------------------------------------------------------------------------
+# Assembly helpers
+# --------------------------------------------------------------------------
+
+
+class _HostSink:
+    """Per-host sink wrapper: tags every record with its host id so one
+    merged event stream stays attributable (the events registry allows
+    extra keys by contract — see ``obs/events.py``)."""
+
+    def __init__(self, inner, host_id: str) -> None:
+        self._inner = inner
+        self.host_id = host_id
+
+    def log(self, **fields) -> None:
+        if self._inner is not None:
+            self._inner.log(host=self.host_id, **fields)
+
+    def flush(self) -> None:
+        if self._inner is not None and hasattr(self._inner, "flush"):
+            self._inner.flush()
+
+
+def build_local_federation(
+    replica_groups,
+    *,
+    sink=None,
+    clock: Callable[[], float] = time.monotonic,
+    suspect_after_s: float = 2.0,
+    dead_after_s: float = 6.0,
+    session_store=None,
+    link_faults: dict[str, object] | None = None,
+    host_faults: dict[str, object] | None = None,
+    manifests: dict[str, dict] | None = None,
+    series_path: str | None = None,
+    router_kwargs: dict | None = None,
+    metrics_factory: Callable | None = None,
+    tcp_base_port: int = 0,
+    failover: bool = True,
+) -> tuple[ClusterRouter, dict[str, "HostAgent"]]:
+    """Wire a whole loopback federation in one call: one
+    ``ReplicaRouter`` + ``HostAgent`` per replica group, in-proc links
+    (chaos-hookable per host via ``link_faults`` / ``host_faults``),
+    one shared ``SessionStore`` (the migration substrate — a survivor
+    must be able to READ the dead host's snapshots; on one machine that
+    is one directory, in production a shared object store), and a
+    ``ClusterRouter`` over the lot. Returns ``(cluster, agents)``.
+
+    ``tcp_base_port`` > 0 runs the real loopback-TCP transport instead
+    of in-proc links: ``host<i>`` listens on ``tcp_base_port + i`` and
+    the controller connects a ``TcpLink`` to it (chaos hooks are
+    in-proc-only — ``link_faults`` is rejected here).
+    """
+    from gnot_tpu.serve.router import ReplicaRouter
+
+    if tcp_base_port and link_faults:
+        raise ValueError(
+            "link_faults are in-proc chaos hooks; the TCP transport "
+            "(tcp_base_port) has none — drop one or the other"
+        )
+    cluster = ClusterRouter(
+        sink=sink,
+        clock=clock,
+        failover=failover,
+        suspect_after_s=suspect_after_s,
+        dead_after_s=dead_after_s,
+        manifests=manifests,
+        series_path=series_path,
+    )
+    agents: dict[str, HostAgent] = {}
+    kwargs = dict(router_kwargs or {})
+    for i, replicas in enumerate(replica_groups):
+        host_id = f"host{i}"
+        host_sink = _HostSink(sink, host_id) if sink is not None else None
+        metrics = metrics_factory() if metrics_factory is not None else None
+        router = ReplicaRouter(
+            replicas,
+            sink=host_sink,
+            clock=clock,
+            session_store=session_store,
+            persist_snapshots=session_store is not None,
+            metrics=metrics,
+            **kwargs,
+        )
+        agent = HostAgent(
+            host_id,
+            router,
+            sink=host_sink,
+            faults=(host_faults or {}).get(host_id),
+            session_store=session_store,
+            metrics=metrics,
+            topology=topology_key(len(replica_groups), len(replicas)),
+        )
+        if tcp_base_port:
+            port = agent.listen(tcp_base_port + i)
+            link: object = TcpLink("127.0.0.1", port)
+        else:
+            link = InProcLink(agent, clock=clock)
+        cluster.add_host(host_id, link)
+        if not tcp_base_port:
+            # Arm link chaos AFTER the handshake: faults target
+            # steady-state traffic — an armed msg_delay/net_partition
+            # eating the hello frame would wedge setup instead of
+            # exercising resilience.
+            link.arm((link_faults or {}).get(host_id))
+        agents[host_id] = agent
+    return cluster, agents

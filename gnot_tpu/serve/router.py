@@ -132,6 +132,7 @@ class ReplicaRouter:
         max_session_migrations: int = 3,
         metrics=None,
         session_store=None,
+        persist_snapshots: bool = False,
         catalog=None,
         tenants=None,
     ):
@@ -181,6 +182,7 @@ class ReplicaRouter:
             session_snapshot_every=session_snapshot_every,
             metrics=metrics,
             session_store=session_store,
+            persist_snapshots=persist_snapshots,
             catalog=catalog,
             tenants=tenants,
         )

@@ -206,6 +206,7 @@ class InferenceServer:
         session_snapshot_every: int = 1,
         metrics=None,
         session_store=None,
+        persist_snapshots: bool = False,
         catalog=None,
         tenants=None,
     ):
@@ -439,6 +440,14 @@ class InferenceServer:
         # a drained session's final snapshot is written here so a
         # restarted server/router can resume it (resume_rollout).
         self._session_store = session_store
+        # Rolling persistence (serve/federation.py): when on, every DUE
+        # snapshot of a NAMED session is also written to the store, not
+        # just the final drain-time one — the cross-host migration
+        # substrate: a host killed without warning leaves its sessions'
+        # last-good cursors on disk for a survivor to resume from. Off
+        # by default: the single-host path keeps its drain-only write
+        # pattern (and its byte-identical event stream).
+        self._persist_snapshots = persist_snapshots
         # Scale-in eviction hook (router.remove_replica): when set, a
         # committed step hands its unfinished session to the callback
         # (re-placed on a sibling at a step boundary) instead of
@@ -836,6 +845,18 @@ class InferenceServer:
                     session=session.sid,
                     step=session.take_snapshot(),
                 )
+                if (
+                    self._persist_snapshots
+                    and session.named
+                    and self._session_store is not None
+                ):
+                    # A failed write must not fail the step — the
+                    # in-memory session is still authoritative; only
+                    # the crash-resume point goes stale.
+                    try:
+                        self._session_store.save(session)
+                    except OSError:
+                        pass
             if session.finished:
                 if session.resolve(True, "ok"):
                     with self._lock:

@@ -490,6 +490,86 @@ def test_gl005_docs_coverage(tmp_path):
     assert findings[0].path == "gnot_tpu/obs/events.py"
 
 
+#: A minimal wire-message registry for fixture sandboxes (the GL005
+#: wire-site check resolves ``wire(X, ...)`` against the MESSAGES dict
+#: of the tree it lints, exactly like EVENTS for emit sites).
+MINI_MESSAGES = '''
+GOOD_MSG = "good_msg"
+MESSAGES = {
+    "good_msg": None,
+}
+'''
+
+
+def _messages_sandbox(tmp_path, *, serving_doc="`good_msg`\n"):
+    """Registry + docs scaffolding for the wire-site fixtures (events
+    side included so the project pass has nothing else to report)."""
+    reg = tmp_path / "gnot_tpu" / "serve"
+    reg.mkdir(parents=True, exist_ok=True)
+    (reg / "federation.py").write_text(MINI_MESSAGES)
+    docs = tmp_path / "docs"
+    docs.mkdir(exist_ok=True)
+    (docs / "serving.md").write_text(serving_doc)
+
+
+GL005_WIRE_BAD = """
+    def ping(link):
+        link.send(wire("bogus_kind", x=1))
+"""
+
+GL005_WIRE_CLEAN = """
+    GOOD_MSG = "good_msg"
+
+    def ping(link):
+        link.send(wire("good_msg", x=1))
+        link.send(wire(GOOD_MSG, x=2))  # constant form resolves too
+"""
+
+
+def test_gl005_fires_on_unregistered_wire_kind(tmp_path):
+    _messages_sandbox(tmp_path)
+    findings, _ = lint_source(
+        tmp_path, GL005_WIRE_BAD, rules=["GL005"], registry=True
+    )
+    assert rule_ids(findings) == ["GL005"]
+    assert len(findings) == 1
+    assert "bogus_kind" in findings[0].message
+    assert "MESSAGES" in findings[0].message
+
+
+def test_gl005_silent_on_registered_wire_kind(tmp_path):
+    _messages_sandbox(tmp_path)
+    findings, _ = lint_source(
+        tmp_path, GL005_WIRE_CLEAN, rules=["GL005"], registry=True
+    )
+    assert findings == []
+
+
+def test_gl005_messages_docs_coverage(tmp_path):
+    """A MESSAGES kind missing its code-token mention in
+    docs/serving.md is a project-level finding anchored at the wire
+    registry — the federation protocol table must stay complete."""
+    _messages_sandbox(tmp_path, serving_doc="prose only, no token\n")
+    findings, _ = lint_source(tmp_path, "x = 1\n", rules=["GL005"],
+                              registry=True)
+    assert len(findings) == 1
+    assert "'good_msg'" in findings[0].message
+    assert findings[0].path == "gnot_tpu/serve/federation.py"
+
+
+def test_gl005_unparseable_messages_is_a_finding(tmp_path):
+    """A wire registry that EXISTS but whose MESSAGES is not a literal
+    dict must fail loudly, mirroring the EVENTS loudness contract."""
+    _messages_sandbox(tmp_path)
+    (tmp_path / "gnot_tpu" / "serve" / "federation.py").write_text(
+        "MESSAGES = dict(good_msg=None)\n"
+    )
+    findings, _ = lint_source(tmp_path, "x = 1\n", rules=["GL005"],
+                              registry=True)
+    assert len(findings) == 1
+    assert "MESSAGES is not parseable" in findings[0].message
+
+
 # --- GL006 aliased-host-view ------------------------------------------------
 
 #: The PR-7 historical bug, reconstructed pre-fix

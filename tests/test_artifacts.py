@@ -556,3 +556,51 @@ def test_tenant_ab_artifact_schema():
         or summary["open_interactive_shed"] > 0
     )
     assert summary["pin_tenant_footprint"] == 0
+
+
+def test_federation_ab_artifact_schema():
+    """The committed federation chaos A/B (tools/federation_ab.py):
+    a 2-host loopback federation with the owner host of a mid-flight
+    rollout session KILLED — the ISSUE 18 acceptance bars: the chaos
+    arm loses ZERO sessions (re-migrated cross-host from persisted
+    snapshots) with <= 1e-5 per-step parity against the offline loop,
+    the no-failover twin measurably loses sessions (the kill was not
+    vacuous), and the federation-off single-host path stays
+    byte-identical at the batcher and serve_summary levels."""
+    path = os.path.join(ARTIFACT_DIR, "federation_ab.jsonl")
+    with open(path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    arms = {r["arm"]: r for r in recs if "arm" in r}
+    assert set(arms) == {"chaos", "no_failover"}
+    for r in arms.values():
+        # Identical storm + identical kill across the arms.
+        assert r["hosts"] >= 2 and r["sessions"] > 0 and r["steps"] > 1
+        assert r["snapshot_every"] >= 2  # re-migration replays for real
+        assert r["killed_host"].startswith("host")
+        assert r["hosts_dead"] == 1
+        assert r["protocol_errors"] == 0
+        assert r["completed"] + r["lost"] == r["sessions"]
+    # The acceptance bars.
+    chaos, nofail = arms["chaos"], arms["no_failover"]
+    assert chaos["failover"] is True and nofail["failover"] is False
+    assert chaos["lost"] == 0
+    assert chaos["remigrated"] >= 1
+    assert chaos["completed"] == chaos["sessions"]
+    assert nofail["lost"] >= 1
+    assert nofail["lost_reasons"] == ["host_dead"]
+    assert nofail["remigrated"] == 0
+    (parity,) = [r for r in recs if r.get("probe") == "parity"]
+    assert parity["sessions_checked"] == chaos["sessions"]
+    assert parity["max_abs_diff"] <= parity["bar"] == 1e-5
+    (pin,) = [r for r in recs if r.get("probe") == "single_host_pin"]
+    assert pin["byte_identical"] is True
+    assert pin["summary_match"] is True
+    assert pin["ledger"]["requests"] == pin["requests"] > 0
+    assert pin["ledger"]["completed"] == pin["requests"]
+    (summary,) = [r for r in recs if r.get("summary") == "federation_ab"]
+    assert summary["quick"] is False
+    assert summary["lost_chaos"] == 0 == summary["bar_lost_chaos"]
+    assert summary["lost_no_failover"] == nofail["lost"] >= 1
+    assert summary["remigrated"] == chaos["remigrated"]
+    assert summary["max_abs_diff"] <= summary["bar_numeric"] == 1e-5
+    assert summary["single_host_byte_identical"] is True

@@ -1,0 +1,360 @@
+"""Chaos A/B: topology-honest federation vs a no-failover twin, plus
+the federation-off single-host byte-pin.
+
+The ISSUE 18 acceptance artifact, three probes over identical traffic:
+
+* ``chaos`` — a 2-host loopback federation (serve/federation.py)
+  running a storm of concurrent K-step rollout sessions; once a
+  session is mid-trajectory the OWNER HOST IS KILLED (silent death —
+  no goodbye frame, the lease just stops renewing). Bars: **0 lost
+  sessions** (every orphan re-migrates to the survivor from its
+  persisted SessionStore snapshot), ``remigrated >= 1``, and every
+  served rollout matches the offline engine-only K-step loop
+  (``offline_rollout``) to <= 1e-5 per step — at-least-once replay
+  across hosts is EXACT.
+* ``no_failover`` — the twin with ``failover=False``: the dead host's
+  sessions resolve ``host_dead`` instead of re-placing. Bar:
+  **measured losses >= 1** (the kill genuinely orphaned sessions; the
+  chaos arm's zero is an achievement, not a vacuous storm).
+* ``single_host_pin`` — the federation-off path (``--hosts 1`` never
+  touches federation.py): the SAME serial one-shot storm through a
+  plain single-replica ``ReplicaRouter``, twice. Bars: per-request
+  outputs **byte-identical** across the runs (batcher level) and the
+  deterministic ``serve_summary`` ledger fields equal (summary level)
+  — growing the federation plane perturbed nothing underneath.
+
+Writes JSONL to ``--out`` (committed as
+``docs/artifacts/federation_ab.jsonl``; schema pinned by
+``tests/test_artifacts.py::test_federation_ab_artifact_schema``).
+
+Usage::
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/federation_ab.py --out docs/artifacts/federation_ab.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+BAR_NUMERIC = 1e-5
+
+#: serve_summary fields that are deterministic under a SERIAL storm
+#: (no batching races, no wall-clock): the summary-level pin set.
+PIN_FIELDS = (
+    "requests", "admitted", "completed", "shed", "dispatches",
+    "reloads", "breaker_trips", "compiled_shapes",
+)
+
+
+def _federated_storm(args, engine, traffic, *, failover: bool) -> dict:
+    """One federated rollout storm with the owner of a mid-flight
+    session killed. Returns results + the cluster ledger + victim."""
+    import jax
+
+    from gnot_tpu.serve import build_replica
+    from gnot_tpu.serve.federation import build_local_federation
+    from gnot_tpu.serve.rollout import SessionStore
+    from gnot_tpu.utils.metrics import MetricsSink
+
+    devs = jax.devices()
+    groups = [
+        [
+            build_replica(
+                engine.model, engine.params, 0, [devs[h % len(devs)]],
+                batch_size=args.max_batch,
+            )
+        ]
+        for h in range(args.hosts)
+    ]
+    tmp = tempfile.mkdtemp(prefix="federation_ab_")
+    sink = MetricsSink(os.path.join(tmp, "events.jsonl"))
+    cluster, agents = build_local_federation(
+        groups,
+        sink=sink,
+        failover=failover,
+        suspect_after_s=0.25,
+        dead_after_s=0.6,
+        session_store=SessionStore(os.path.join(tmp, "sessions")),
+        router_kwargs=dict(
+            max_batch=args.max_batch,
+            max_wait_ms=2.0,
+            session_snapshot_every=args.snapshot_every,
+        ),
+    )
+    for a in agents.values():
+        a.router.start()
+    for g in groups:
+        for r in g:
+            r.warm(traffic, rows=args.max_batch)
+    with sink:
+        futs = [
+            cluster.submit_rollout(s, args.steps, name=f"s{i:03d}")
+            for i, s in enumerate(traffic)
+        ]
+        # Kill the owner of the first session caught mid-trajectory —
+        # after real progress (snapshots exist), before the tail (the
+        # kill cannot be a no-op).
+        victim = None
+        deadline = time.time() + 60
+        while victim is None and time.time() < deadline:
+            cluster.tick()
+            for s in cluster._sessions.values():
+                if 2 <= s.streamed < args.steps - 2:
+                    victim = s.owner
+                    break
+            time.sleep(0.01)
+        assert victim is not None, "no session reached the kill window"
+        agents[victim].kill()
+        stop = threading.Event()
+
+        def _ticker():
+            while not stop.is_set():
+                cluster.tick()
+                stop.wait(0.02)
+
+        t = threading.Thread(target=_ticker, daemon=True)
+        t.start()
+        results = [f.result(timeout=180) for f in futs]
+        stop.set()
+        t.join(timeout=5)
+        summary = cluster.drain()
+    for a in agents.values():
+        a.stop()
+    return {"results": results, "summary": summary, "victim": victim}
+
+
+def _single_host_storm(args, engine, traffic) -> dict:
+    """The federation-off path: a serial one-shot storm through a
+    plain single-replica ReplicaRouter (exactly what ``--hosts 1``
+    runs). Returns per-request output bytes + the drain summary."""
+    import jax
+
+    from gnot_tpu.serve import ReplicaRouter, build_replicas
+
+    replicas = build_replicas(
+        engine.model, engine.params, 1,
+        batch_size=args.max_batch, devices=jax.devices()[:1],
+    )
+    for r in replicas:
+        r.warm(traffic, rows=args.max_batch)
+    router = ReplicaRouter(
+        replicas, max_batch=args.max_batch, max_wait_ms=2.0
+    ).start()
+    outs = []
+    for s in traffic:
+        res = router.submit(s).result(timeout=60)
+        assert res.ok, f"single-host pin request failed: {res.reason}"
+        outs.append(res.output.tobytes())
+    summary = router.drain()
+    return {"outputs": outs, "summary": summary}
+
+
+def run(argv=None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", type=str, required=True, help="JSONL output")
+    p.add_argument("--hosts", type=int, default=2)
+    p.add_argument("--sessions", type=int, default=8)
+    p.add_argument("--steps", type=int, default=12)
+    p.add_argument(
+        "--snapshot_every", type=int, default=2,
+        help="session snapshot cadence > 1, so a re-migration exercises "
+             "a REAL cross-host replay from the persisted cursor"
+    )
+    p.add_argument("--max_batch", type=int, default=2)
+    p.add_argument(
+        "--quick", action="store_true",
+        help="smaller storm for the in-process test-suite smoke"
+    )
+    args = p.parse_args(argv)
+    if args.quick:
+        args.sessions, args.steps = 4, 8
+
+    import serve_smoke
+
+    from gnot_tpu.serve import offline_rollout
+    from gnot_tpu.serve.rollout import parity_check
+
+    engine = serve_smoke.build_engine(max_batch=args.max_batch)
+    traffic = serve_smoke.mixed_traffic(
+        args.sessions, seed=7, mesh_lo=100, mesh_hi=300
+    )
+    engine.warmup(traffic, rows=args.max_batch)
+    records: list[dict] = []
+    failures: list[str] = []
+
+    def check(ok: bool, msg: str) -> None:
+        if not ok:
+            failures.append(msg)
+
+    # The offline engine-only reference trajectories (no serve stack,
+    # no federation) every served session must match.
+    reference = [
+        offline_rollout(engine, s, args.steps, rows=args.max_batch)
+        for s in traffic
+    ]
+
+    arm_stats: dict[str, dict] = {}
+    arm_out: dict[str, dict] = {}
+    for arm, failover in (("chaos", True), ("no_failover", False)):
+        out = _federated_storm(args, engine, traffic, failover=failover)
+        results, summary = out["results"], out["summary"]
+        lost = [r for r in results if not r.ok]
+        check(
+            len(results) == args.sessions,
+            f"{arm}: {len(results)} futures resolved != {args.sessions}",
+        )
+        check(
+            summary["lost"] == len(lost),
+            f"{arm}: ledger lost={summary['lost']} != observed "
+            f"{len(lost)}",
+        )
+        check(
+            summary["hosts_dead"] == 1,
+            f"{arm}: hosts_dead={summary['hosts_dead']} — the kill "
+            "didn't land as ONE dead host",
+        )
+        arm_stats[arm] = {
+            "arm": arm,
+            "hosts": args.hosts,
+            "failover": failover,
+            "sessions": args.sessions,
+            "steps": args.steps,
+            "snapshot_every": args.snapshot_every,
+            "killed_host": out["victim"],
+            "completed": summary["completed"],
+            "lost": len(lost),
+            "lost_reasons": sorted({r.reason for r in lost}),
+            "remigrated": summary["remigrated"],
+            "hosts_dead": summary["hosts_dead"],
+            "protocol_errors": summary["protocol_errors"],
+            "steps_committed": sum(r.steps_completed for r in results),
+        }
+        records.append(arm_stats[arm])
+        arm_out[arm] = out
+
+    chaos, nofail = arm_stats["chaos"], arm_stats["no_failover"]
+    check(
+        chaos["lost"] == 0,
+        f"chaos arm lost {chaos['lost']} sessions (must be 0)",
+    )
+    check(
+        chaos["remigrated"] >= 1,
+        "chaos arm never re-migrated a session — the kill was vacuous",
+    )
+    check(
+        chaos["completed"] == args.sessions,
+        f"chaos arm completed {chaos['completed']}/{args.sessions}",
+    )
+    check(
+        chaos["protocol_errors"] == 0,
+        f"chaos arm counted {chaos['protocol_errors']} protocol errors",
+    )
+    check(
+        nofail["lost"] >= 1,
+        "no-failover twin lost nothing — the host kill was vacuous",
+    )
+    check(
+        nofail["lost_reasons"] == ["host_dead"],
+        f"no-failover losses must read host_dead, got "
+        f"{nofail['lost_reasons']}",
+    )
+
+    # Parity: every chaos-arm rollout (re-migrated sessions included)
+    # matches the offline loop per step, at the original tolerance.
+    worst = 0.0
+    for r, ref in zip(arm_out["chaos"]["results"], reference):
+        worst = max(worst, parity_check(r.outputs, ref, atol=BAR_NUMERIC))
+    check(
+        worst <= BAR_NUMERIC,
+        f"federated rollouts drifted {worst} from the offline loop "
+        f"(bar {BAR_NUMERIC})",
+    )
+    records.append(
+        {
+            "probe": "parity",
+            "sessions_checked": sum(
+                r.ok for r in arm_out["chaos"]["results"]
+            ),
+            "steps": args.steps,
+            "max_abs_diff": worst,
+            "bar": BAR_NUMERIC,
+        }
+    )
+
+    # The federation-off byte-pin: two identical single-host runs.
+    pin_a = _single_host_storm(args, engine, traffic)
+    pin_b = _single_host_storm(args, engine, traffic)
+    byte_identical = pin_a["outputs"] == pin_b["outputs"]
+    check(
+        byte_identical,
+        "single-host outputs differ between identical runs — the "
+        "federation-off batcher path is no longer deterministic",
+    )
+    pin_view_a = {k: pin_a["summary"].get(k) for k in PIN_FIELDS}
+    pin_view_b = {k: pin_b["summary"].get(k) for k in PIN_FIELDS}
+    check(
+        pin_view_a == pin_view_b,
+        f"single-host serve_summary ledgers diverged: {pin_view_a} "
+        f"vs {pin_view_b}",
+    )
+    records.append(
+        {
+            "probe": "single_host_pin",
+            "requests": len(traffic),
+            "byte_identical": byte_identical,
+            "summary_match": pin_view_a == pin_view_b,
+            "summary_fields": list(PIN_FIELDS),
+            "ledger": pin_view_a,
+        }
+    )
+
+    summary_rec = {
+        "summary": "federation_ab",
+        "quick": args.quick,
+        "hosts": args.hosts,
+        "sessions": args.sessions,
+        "steps": args.steps,
+        "snapshot_every": args.snapshot_every,
+        "lost_chaos": chaos["lost"],
+        "lost_no_failover": nofail["lost"],
+        "remigrated": chaos["remigrated"],
+        "max_abs_diff": worst,
+        "single_host_byte_identical": byte_identical,
+        "bar_numeric": BAR_NUMERIC,
+        "bar_lost_chaos": 0,
+    }
+    records.append(summary_rec)
+    os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
+    with open(args.out, "w") as f:
+        for rec in records:
+            f.write(json.dumps(rec) + "\n")
+    print(
+        f"federation_ab: chaos lost={chaos['lost']} "
+        f"(remigrated={chaos['remigrated']}) vs no_failover "
+        f"lost={nofail['lost']}; parity max |diff| = {worst:.2e} "
+        f"(bar {BAR_NUMERIC}); single-host pin "
+        f"byte_identical={byte_identical}; wrote {args.out}"
+    )
+    for msg in failures:
+        print(f"FAIL: {msg}")
+    summary_rec = dict(summary_rec)
+    summary_rec["failures"] = failures
+    return summary_rec
+
+
+def main(argv=None) -> int:
+    return 1 if run(argv)["failures"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

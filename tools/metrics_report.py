@@ -195,6 +195,79 @@ def tenant_breakdown(events: list[dict]) -> dict[str, dict]:
     return out
 
 
+def host_breakdown(rows: list[dict]) -> dict[str, dict]:
+    """Per-host slice of a federated series (docs/distributed.md): the
+    ``ClusterRouter`` publishes every host's registry snapshot under
+    ``host<i>/<series_key>`` merged keys; this splits the LAST row back
+    into per-host rollups — series count, counter totals by name, gauge
+    levels, histogram observation totals. Empty for a single-host
+    series (no prefixed keys)."""
+    out: dict[str, dict] = {}
+    if not rows:
+        return out
+    for key, st in rows[-1]["series"].items():
+        host, sep, _rest = key.partition("/")
+        if not sep or not host.startswith("host"):
+            continue
+        h = out.setdefault(
+            host,
+            {"series": 0, "counters": {}, "gauges": {}, "observations": 0},
+        )
+        h["series"] += 1
+        name = st.get("name", key)
+        if st["type"] == "counter":
+            h["counters"][name] = h["counters"].get(name, 0) + st["value"]
+        elif st["type"] == "gauge":
+            h["gauges"][name] = st["value"]
+        elif st["type"] == "histogram":
+            h["observations"] += st.get("count", 0)
+    return out
+
+
+def cluster_crosscheck(events: list[dict]) -> tuple[dict | None, list[str]]:
+    """(final ``cluster_summary`` or None, problems): the controller's
+    drain-time ledger checked against the raw federation event stream
+    it claims to roll up — one ``host_dead`` event per counted death,
+    one ``session_remigrate`` per counted re-migration, heartbeats
+    observed from every member host, and internal coherence (every
+    one-shot resolved, lost bounded by sessions)."""
+    summaries = [e for e in events if e.get("event") == "cluster_summary"]
+    if not summaries:
+        return None, []
+    s = summaries[-1]
+    problems: list[str] = []
+    deaths = [e for e in events if e.get("event") == "host_dead"]
+    remigs = [e for e in events if e.get("event") == "session_remigrate"]
+    hb_hosts = {
+        e["host"] for e in events if e.get("event") == "host_heartbeat"
+    }
+    if s["hosts_dead"] != len(deaths):
+        problems.append(
+            f"cluster_summary hosts_dead={s['hosts_dead']} != "
+            f"{len(deaths)} host_dead events"
+        )
+    if s["remigrated"] != len(remigs):
+        problems.append(
+            f"cluster_summary remigrated={s['remigrated']} != "
+            f"{len(remigs)} session_remigrate events"
+        )
+    if hb_hosts and s["hosts"] != len(hb_hosts):
+        problems.append(
+            f"cluster_summary hosts={s['hosts']} != heartbeats observed "
+            f"from {sorted(hb_hosts)}"
+        )
+    if s["completed"] + s["shed"] != s["requests"]:
+        problems.append(
+            f"one-shot ledger incoherent: completed {s['completed']} + "
+            f"shed {s['shed']} != requests {s['requests']}"
+        )
+    if s["lost"] > s["sessions"]:
+        problems.append(
+            f"lost {s['lost']} exceeds sessions {s['sessions']}"
+        )
+    return s, problems
+
+
 def run(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("series", help="the <stem>.series.jsonl time series")
@@ -243,6 +316,19 @@ def run(argv=None) -> int:
                 )
             else:
                 print(f"    seq {e['seq']:>4}  value={e['value']}")
+
+    per_host = host_breakdown(rows)
+    if per_host:
+        print(f"\nPer-host breakdown ({len(per_host)} hosts):")
+        for host, st in sorted(per_host.items()):
+            counters = ", ".join(
+                f"{k}={v}" for k, v in sorted(st["counters"].items())
+            )
+            print(
+                f"  {host}: {st['series']} series, "
+                f"{st['observations']} observations"
+                + (f", {counters}" if counters else "")
+            )
 
     pool = pool_size_series(rows)
     if pool:
@@ -305,12 +391,28 @@ def run(argv=None) -> int:
                         f"tenant {t}: {n_ev} tenant_quota_shed events "
                         f"!= summary shed_tenant_quota {n_sum}"
                     )
+        cluster, cluster_problems = cluster_crosscheck(events)
+        if cluster is not None:
+            failures.extend(cluster_problems)
+            if not cluster_problems:
+                print(
+                    "\ncluster_summary agrees with the federation event "
+                    f"stream (hosts={cluster['hosts']}, "
+                    f"requests={cluster['requests']}, "
+                    f"sessions={cluster['sessions']}, "
+                    f"remigrated={cluster['remigrated']}, "
+                    f"hosts_dead={cluster['hosts_dead']}, "
+                    f"lost={cluster['lost']})"
+                )
         summaries = [
             e
             for e in events
             if e.get("event") == "serve_summary" and "routing" not in e
         ] or [e for e in events if e.get("event") == "serve_summary"]
-        if summaries:
+        # A federated run's serve_summary events are PER-HOST (each
+        # covers one pool's slice of the storm); the merged series can
+        # only be checked against the cluster ledger above.
+        if summaries and cluster is None:
             # Prefer the pool-level summary when a router emitted both
             # tiers (per-replica summaries cover a subset each).
             pool = [e for e in events if e.get("event") == "serve_summary"

@@ -93,6 +93,211 @@ def mixed_traffic(n: int, seed: int = 0, mesh_lo: int = 300, mesh_hi: int = 700)
     return out
 
 
+def _run_federated(args) -> dict:
+    """``--hosts N``: the federated storm (serve/federation.py,
+    docs/distributed.md). The pool splits across N loopback hosts —
+    one ``ReplicaRouter`` + ``HostAgent`` each, a ``ClusterRouter``
+    placing the storm over in-proc links that run the real frame codec
+    — and the smoke asserts the federation contract: zero lost futures,
+    registry-valid events, per-host compile bounds, a coherent
+    ``cluster_summary`` ledger."""
+    import threading
+    import time as _time
+
+    import jax
+
+    from gnot_tpu.data.batch import bucket_length
+    from gnot_tpu.obs import events as events_registry
+    from gnot_tpu.resilience.faults import FaultInjector
+    from gnot_tpu.serve import build_replica
+    from gnot_tpu.serve.federation import build_local_federation
+    from gnot_tpu.serve.rollout import SessionStore
+    from gnot_tpu.utils.metrics import MetricsSink
+
+    metrics_path = args.metrics_path or os.path.join(
+        tempfile.mkdtemp(prefix="serve_smoke_"), "serve.jsonl"
+    )
+    engine = build_engine(max_batch=args.max_batch)
+    traffic = mixed_traffic(args.n, mesh_lo=args.mesh_lo, mesh_hi=args.mesh_hi)
+    per = max(1, args.replicas // args.hosts)
+    devs = jax.devices()
+    # Device slices wrap modulo the visible set: a 1-device CPU run
+    # still federates (hosts share the device; the protocol plane —
+    # what this mode tests — is host-level, not device-level).
+    groups = [
+        [
+            build_replica(
+                engine.model, engine.params, r,
+                [devs[(h * per + r) % len(devs)]],
+                batch_size=args.max_batch,
+            )
+            for r in range(per)
+        ]
+        for h in range(args.hosts)
+    ]
+    store = SessionStore(tempfile.mkdtemp(prefix="serve_smoke_sess_"))
+    fi = FaultInjector.from_spec(args.inject_fault)
+    chaos = (
+        {f"host{h}": fi for h in range(args.hosts)}
+        if fi is not None
+        else None
+    )
+    failures = []
+
+    def check(ok: bool, msg: str) -> None:
+        if not ok:
+            failures.append(msg)
+
+    with MetricsSink(metrics_path) as sink:
+        cluster, agents = build_local_federation(
+            groups,
+            sink=sink,
+            session_store=store,
+            suspect_after_s=0.5,
+            dead_after_s=1.5,
+            link_faults=chaos,
+            host_faults=chaos,
+            router_kwargs=dict(
+                max_batch=args.max_batch,
+                max_wait_ms=args.max_wait_ms,
+                queue_limit=args.queue_limit,
+                default_deadline_ms=args.deadline_ms,
+                session_snapshot_every=args.session_snapshot_every,
+            ),
+        )
+        for a in agents.values():
+            a.router.start()
+        # Serving-startup discipline, per host: every bucket compiles
+        # on every replica before traffic.
+        for g in groups:
+            for r in g:
+                r.warm(traffic, rows=args.max_batch)
+        stop = threading.Event()
+
+        def _ticker():
+            while not stop.is_set():
+                cluster.tick()
+                stop.wait(0.05)
+
+        ticker = threading.Thread(target=_ticker, daemon=True)
+        ticker.start()
+        try:
+            t_submit = _time.perf_counter()
+            futures = [
+                cluster.submit_rollout(s, args.rollout)
+                if args.rollout
+                else cluster.submit(s)
+                for s in traffic
+            ]
+            results = [f.result(timeout=120) for f in futures]
+            wall_s = _time.perf_counter() - t_submit
+        finally:
+            # Stop the control loop BEFORE the sink context closes —
+            # a ticker outliving a failed storm would write heartbeat
+            # events into a closed file.
+            stop.set()
+            ticker.join(timeout=5)
+        summary = cluster.drain()
+        for a in agents.values():
+            a.stop()
+    summary["wall_s"] = wall_s
+    summary["requests_per_s"] = args.n / wall_s if wall_s > 0 else None
+
+    # -- assertions (the federation contract) -------------------------------
+    # Zero lost futures: every submission resolved — a partition, a
+    # dead host, or a drain may shed work honestly, but a future that
+    # never resolves (or a session the ledger wrote off) fails.
+    check(
+        len(results) == args.n,
+        f"{len(results)} resolved futures != {args.n} submitted",
+    )
+    check(
+        summary["lost"] == 0,
+        f"cluster lost sessions: lost={summary['lost']}",
+    )
+    n_ok = sum(r.ok for r in results)
+    if args.rollout:
+        check(
+            summary["sessions"] == args.n,
+            f"sessions ledger {summary['sessions']} != {args.n} submitted",
+        )
+    else:
+        check(
+            summary["requests"] == args.n
+            and summary["completed"] + summary["shed"] == args.n,
+            f"one-shot ledger incoherent: {summary['completed']}+"
+            f"{summary['shed']} != {summary['requests']} != {args.n}",
+        )
+    if not args.inject_fault:
+        check(
+            n_ok == args.n,
+            f"clean federated storm failed futures: {n_ok}/{args.n} ok",
+        )
+        check(
+            summary["hosts_dead"] == 0 and summary["remigrated"] == 0,
+            f"clean storm declared deaths/migrations: {summary}",
+        )
+        check(
+            summary["protocol_errors"] == 0,
+            f"clean storm counted protocol errors: "
+            f"{summary['protocol_errors']}",
+        )
+    # Every record in the merged stream validates against the central
+    # registry — per-host tagging (host=...) rides the extras contract.
+    events = [json.loads(l) for l in open(metrics_path)]
+    bad = [
+        (e.get("event"), events_registry.validate_record(e))
+        for e in events
+        if events_registry.validate_record(e)
+    ]
+    check(
+        not bad,
+        f"{len(bad)} events fail registry validation; first: {bad[:3]}",
+    )
+    check(
+        sum(e.get("event") == "cluster_summary" for e in events) == 1,
+        "expected exactly one cluster_summary event",
+    )
+    hb_hosts = {
+        e["host"] for e in events if e.get("event") == "host_heartbeat"
+    }
+    check(
+        hb_hosts == set(agents),
+        f"heartbeats observed from {sorted(hb_hosts)} != hosts "
+        f"{sorted(agents)}",
+    )
+    # Single compile per bucket per host: each host's replicas warmed
+    # every traffic bucket exactly once — the compiled-program count is
+    # bounded by the distinct-bucket count, never O(traffic).
+    expected = {
+        (
+            bucket_length(s.coords.shape[0]),
+            bucket_length(max(f.shape[0] for f in s.funcs)),
+        )
+        for s in traffic
+    }
+    for h, g in enumerate(groups):
+        for r in g:
+            check(
+                r.engine.compiled_shapes <= len(expected),
+                f"host{h} replica {r.replica_id} compiled "
+                f"{r.engine.compiled_shapes} shapes > "
+                f"{len(expected)} traffic buckets",
+            )
+    print(
+        f"serve_smoke: federated {args.hosts} hosts x {per} replicas, "
+        f"{n_ok}/{args.n} ok, lost={summary['lost']}, "
+        f"remigrated={summary['remigrated']}, "
+        f"hosts_dead={summary['hosts_dead']}, "
+        f"protocol_errors={summary['protocol_errors']}, "
+        f"{summary['requests_per_s']:.1f} req/s"
+    )
+    for msg in failures:
+        print(f"FAIL: {msg}")
+    summary["failures"] = failures
+    return summary
+
+
 def run(argv=None) -> dict:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--n", type=int, default=16, help="requests to fire")
@@ -242,6 +447,22 @@ def run(argv=None) -> dict:
              "fast-fails on quota while interactive stays unthrottled"
     )
     p.add_argument(
+        "--hosts", type=int, default=1,
+        help="federated storm mode (serve/federation.py, docs/"
+             "distributed.md): split the pool across N loopback hosts "
+             "— each behind a HostAgent speaking the versioned wire "
+             "protocol, a ClusterRouter placing the storm over lease-"
+             "checked links. The smoke then asserts the FEDERATION "
+             "contract instead: zero lost futures (every submission "
+             "resolves, cluster_summary.lost == 0), every event record "
+             "validates against the obs/events.py registry, per-host "
+             "single-compile-per-bucket bounds, heartbeats observed "
+             "from every host, and a coherent cluster_summary ledger. "
+             "Composes with --rollout (K-step sessions through the "
+             "cluster) and --inject_fault (federation kinds: host_kill@"
+             "N, net_partition@N, msg_drop@N, msg_delay@MS)"
+    )
+    p.add_argument(
         "--capacity", action="store_true",
         help="program catalog & capacity plane (serve/catalog.py, "
              "docs/observability.md 'Program costs & capacity'): share "
@@ -256,6 +477,15 @@ def run(argv=None) -> dict:
     args = p.parse_args(argv)
     if args.tenants and args.rollout:
         p.error("--tenants is a one-shot storm mode (no --rollout)")
+    if args.hosts > 1:
+        if args.tenants or args.packed or args.prewarm or args.capacity:
+            p.error(
+                "--hosts composes with --rollout/--inject_fault only "
+                "(the single-host modes assert single-host invariants)"
+            )
+        if args.inject_fault == "none":
+            args.inject_fault = ""
+        return _run_federated(args)
     if args.inject_fault == "none":
         args.inject_fault = ""
     elif not args.inject_fault:
