@@ -19,6 +19,19 @@ were enforced only by comments and reviewer vigilance:
   registry (``obs/events.py``, ``resilience/faults.py::FAULT_KINDS``)
   and user-facing docs; an emit site or registry entry that drifts from
   them is an observability hole. Rule **GL005**.
+* **lock ordering and convoys** — the serving/federation planes hold
+  locks while calling into code that takes other locks; a cycle in the
+  project-wide acquires-while-holding graph is a deadlock no per-file
+  view can see, and a blocking call under a held lock is a convoy.
+  Rules **GL008** (lock-order inversion, via the cross-file lock graph
+  — published as ``docs/artifacts/lockmap.jsonl``) and **GL009**
+  (blocking-call-under-lock, ``#: allowed_blocking — reason`` to
+  justify); ``utils/lockguard.py`` is the runtime witness for what the
+  AST cannot resolve.
+* **config drift** — a ``ServeConfig``/``TrainConfig`` field that no
+  CLI flag reaches (or a ``config_from_args`` key naming a ghost
+  field, or a field no docs page mentions) is dead configuration that
+  looks alive. Rule **GL010**.
 
 The framework (``core.py``) is pure stdlib ``ast`` — the analysis
 itself never imports the code under test, touches no devices, and
@@ -44,8 +57,10 @@ from gnot_tpu.analysis.core import (  # noqa: F401
 
 # Importing the rule modules registers them.
 from gnot_tpu.analysis import aliasing  # noqa: F401
+from gnot_tpu.analysis import config_drift  # noqa: F401
 from gnot_tpu.analysis import donation  # noqa: F401
 from gnot_tpu.analysis import hostsync  # noqa: F401
+from gnot_tpu.analysis import lockorder  # noqa: F401
 from gnot_tpu.analysis import locks  # noqa: F401
 from gnot_tpu.analysis import native_abi  # noqa: F401
 from gnot_tpu.analysis import recompile  # noqa: F401

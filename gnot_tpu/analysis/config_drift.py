@@ -1,0 +1,244 @@
+"""GL010 — config drift: dataclass knobs vs CLI flags vs docs.
+
+``TrainConfig``/``ServeConfig`` are the operator surface: every field
+is a promise that a run can be configured that way. The promise rots
+in three directions, each observed in review at least once:
+
+* a field lands with no ``--`` flag — reachable from library code
+  only, invisible to ``python -m gnot_tpu.main --help``;
+* the CLI mapping in ``main.py::config_from_args`` references a field
+  (or an ``args.<flag>``) that no longer exists — a typo that
+  ``make_config`` may only reject at run time;
+* the knob is documented nowhere — ``docs/serving.md`` /
+  ``robustness.md`` / ``observability.md`` never mention it.
+
+The rule closes the triangle, project-wide and AST-only (GL005's
+discipline: registries are *parsed*, never imported): every field of
+the configured dataclasses must appear as a ``"<section>.<field>"``
+key in the CLI module's config mapping, every such key must name a
+real field, every ``args.<flag>`` the mapping reads must be a declared
+``--<flag>``, and every field must be mentioned in at least one
+configured doc — as a backticked code token (`` `field` ``) or as its
+flag spelling (``--flag``, fenced command lines count). Suppressions
+anchor at the field's declaration line in the config module.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from gnot_tpu.analysis.core import (
+    FileContext,
+    Finding,
+    ProjectContext,
+    Rule,
+    register,
+)
+
+
+def _dataclass_fields(tree: ast.Module, class_name: str) -> dict[str, int]:
+    """``field -> declaration line`` for one dataclass, by AST."""
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            return {
+                st.target.id: st.lineno
+                for st in node.body
+                if isinstance(st, ast.AnnAssign)
+                and isinstance(st.target, ast.Name)
+            }
+    return {}
+
+
+def _declared_flags(tree: ast.Module) -> set[str]:
+    """Flag names from every ``*.add_argument("--name", ...)``."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "add_argument"
+        ):
+            continue
+        for a in node.args:
+            if (
+                isinstance(a, ast.Constant)
+                and isinstance(a.value, str)
+                and a.value.startswith("--")
+            ):
+                out.add(a.value[2:])
+    return out
+
+
+def _config_mapping(
+    tree: ast.Module, prefixes: tuple[str, ...]
+) -> dict[str, tuple[int, set[str]]]:
+    """``"section.field" -> (line, {args attributes read})`` from every
+    dict literal whose string keys carry a configured section prefix —
+    the ``config_from_args`` mapping, without naming the function."""
+    out: dict[str, tuple[int, set[str]]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict):
+            continue
+        for key, value in zip(node.keys, node.values):
+            if not (
+                isinstance(key, ast.Constant)
+                and isinstance(key.value, str)
+                and key.value.partition(".")[0] in prefixes
+                and "." in key.value
+            ):
+                continue
+            refs = {
+                n.attr
+                for n in ast.walk(value)
+                if isinstance(n, ast.Attribute)
+                and isinstance(n.value, ast.Name)
+                and n.value.id == "args"
+            }
+            if key.value not in out:
+                out[key.value] = (key.lineno, refs)
+    return out
+
+
+def _parse_module(root: str, rel: str) -> ast.Module | None:
+    try:
+        with open(os.path.join(root, rel), encoding="utf-8") as f:
+            return ast.parse(f.read(), filename=rel)
+    except (OSError, SyntaxError):
+        return None
+
+
+def _doc_mentions(root: str, docs: list[str]) -> str:
+    chunks = []
+    for rel in docs:
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as f:
+                chunks.append(f.read())
+        except OSError:
+            pass
+    return "\n".join(chunks)
+
+
+def _documented(field: str, flags: set[str], corpus: str) -> bool:
+    """Mentioned as a code token: `` `field` `` (optionally dotted or
+    ``--``-prefixed inside the backticks) or a ``--flag`` occurrence —
+    fenced command lines count, bare prose does not."""
+    toks = {field} | flags
+    for tok in toks:
+        if re.search(rf"`(--|[\w.]+\.)?{re.escape(tok)}[`@ =]", corpus):
+            return True
+        if re.search(rf"(^|[^\w-])--{re.escape(tok)}\b", corpus):
+            return True
+    return False
+
+
+@register
+class ConfigDrift(Rule):
+    id = "GL010"
+    title = "config-drift"
+    hint = (
+        "wire the field through main.py (add_argument + the "
+        "config_from_args mapping) and mention it in docs/serving.md, "
+        "docs/robustness.md or docs/observability.md — or delete the "
+        "dead knob"
+    )
+
+    def check_project(self, project: ProjectContext) -> list[Finding]:
+        cfg = project.config
+        cfg_path = os.path.join(project.root, cfg.config_module)
+        cli_path = os.path.join(project.root, cfg.cli_module)
+        if not (os.path.exists(cfg_path) and os.path.exists(cli_path)):
+            return []  # fixture sandboxes without a config surface
+        cfg_tree = _parse_module(project.root, cfg.config_module)
+        cli_tree = _parse_module(project.root, cfg.cli_module)
+        if cfg_tree is None or cli_tree is None:
+            return []  # unparseable files already carry a GL000
+        sections: list[tuple[str, str]] = []
+        for spec in cfg.config_sections:
+            prefix, _, cls = spec.partition(":")
+            if prefix and cls:
+                sections.append((prefix, cls))
+        # The configured files' FileContexts, for suppression anchoring.
+        by_path = {c.path: c for c in project.contexts}
+        cfg_ctx = by_path.get(cfg.config_module)
+        cli_ctx = by_path.get(cfg.cli_module)
+
+        flags = _declared_flags(cli_tree)
+        mapping = _config_mapping(
+            cli_tree, tuple(p for p, _ in sections)
+        )
+        corpus = _doc_mentions(project.root, cfg.docs_config)
+        findings: list[Finding] = []
+
+        def emit(ctx: FileContext | None, path: str, line: int, msg: str):
+            if ctx is not None and ctx.is_suppressed(self.id, line):
+                return
+            findings.append(
+                Finding(
+                    rule=self.id, path=path, line=line, message=msg,
+                    hint=self.hint,
+                )
+            )
+
+        all_fields: set[str] = set()
+        for prefix, cls in sections:
+            fields = _dataclass_fields(cfg_tree, cls)
+            if not fields:
+                # The class EXISTS in config (sections name it) but has
+                # no parseable annotated fields: every check below
+                # would be vacuous — say so loudly (GL005 contract).
+                emit(
+                    cfg_ctx,
+                    cfg.config_module,
+                    1,
+                    f"config section {prefix!r}: dataclass {cls} has no "
+                    "parseable annotated fields — GL010 cannot check "
+                    "its CLI/docs wiring",
+                )
+                continue
+            for field, line in sorted(fields.items(), key=lambda kv: kv[1]):
+                key = f"{prefix}.{field}"
+                all_fields.add(key)
+                wired = mapping.get(key)
+                if wired is None:
+                    emit(
+                        cfg_ctx,
+                        cfg.config_module,
+                        line,
+                        f"config field {key} has no CLI wiring in "
+                        f"{cfg.cli_module} (no {key!r} entry in the "
+                        "config mapping)",
+                    )
+                    field_flags: set[str] = set()
+                else:
+                    _, refs = wired
+                    field_flags = refs & flags
+                    for ref in sorted(refs - flags):
+                        emit(
+                            cli_ctx,
+                            cfg.cli_module,
+                            wired[0],
+                            f"config mapping {key!r} reads args.{ref} "
+                            f"but no --{ref} flag is declared",
+                        )
+                if not _documented(field, field_flags, corpus):
+                    emit(
+                        cfg_ctx,
+                        cfg.config_module,
+                        line,
+                        f"config field {key} is not documented in any "
+                        f"of {', '.join(cfg.docs_config)} (mention "
+                        f"`{field}` or its --flag)",
+                    )
+        for key, (line, _) in sorted(mapping.items()):
+            if key not in all_fields:
+                emit(
+                    cli_ctx,
+                    cfg.cli_module,
+                    line,
+                    f"config mapping key {key!r} does not match any "
+                    f"field of the configured dataclasses in "
+                    f"{cfg.config_module}",
+                )
+        return findings

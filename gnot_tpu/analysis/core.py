@@ -102,6 +102,38 @@ class LintConfig:
     # extern "C" declarations it must match (arity + dtype tags).
     native_binding: str = "gnot_tpu/native/__init__.py"
     native_source: str = "gnot_tpu/native/ragged_pack.cpp"
+    # GL009: terminal names of project callables known to block for
+    # "long" (dispatch/compile/IO scale, not counter-bump scale) —
+    # calling one inside a held-lock region wedges every sibling
+    # thread. A trailing "*" makes the entry a prefix match
+    # ("infer*" covers infer/infer_batch/infer_packed/infer_session).
+    slow_callables: list[str] = dataclasses.field(
+        default_factory=lambda: [
+            "infer*",
+            "warmup",
+            "aot_compile",
+            "save_checkpoint",
+            "restore_checkpoint",
+            "reload",
+        ]
+    )
+    # GL010: the config dataclasses, the CLI that must wire them, and
+    # the docs where every knob must be mentioned.
+    config_module: str = "gnot_tpu/config.py"
+    cli_module: str = "gnot_tpu/main.py"
+    # "<mapping prefix>:<dataclass name>" pairs: every field of the
+    # class must appear as a "<prefix>.<field>" key in the CLI's
+    # config mapping, and vice versa.
+    config_sections: list[str] = dataclasses.field(
+        default_factory=lambda: ["train:TrainConfig", "serve:ServeConfig"]
+    )
+    docs_config: list[str] = dataclasses.field(
+        default_factory=lambda: [
+            "docs/serving.md",
+            "docs/robustness.md",
+            "docs/observability.md",
+        ]
+    )
 
     def rule_enabled(self, rule_id: str) -> bool:
         if rule_id in self.disable:

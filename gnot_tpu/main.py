@@ -179,6 +179,18 @@ def build_parser() -> argparse.ArgumentParser:
              "half-open trial dispatch decides recovery"
     )
     p.add_argument(
+        "--drain_timeout_s", type=float, default=30.0,
+        help="serving: graceful-drain budget — how long drain() waits "
+             "for in-flight requests before force-resolving the "
+             "stragglers"
+    )
+    p.add_argument(
+        "--wedge_after_s", type=float, default=2.0,
+        help="serving: seconds of worker-loop silence (with requests "
+             "in-system) before the router treats a replica as wedged "
+             "and drains its traffic to siblings"
+    )
+    p.add_argument(
         "--serve_inject_fault", type=str, default="",
         help="serving-side deterministic fault injection "
              "(docs/serving.md): comma-separated kind@N — "
@@ -339,6 +351,27 @@ def build_parser() -> argparse.ArgumentParser:
         help="autoscale: controller tick cadence (seconds)"
     )
     p.add_argument(
+        "--autoscale_up_load", type=float, default=8.0,
+        help="autoscale: per-replica in-system load (requests + "
+             "sessions) above which the controller scales out; must "
+             "exceed --autoscale_down_load (hysteresis)"
+    )
+    p.add_argument(
+        "--autoscale_down_load", type=float, default=1.0,
+        help="autoscale: per-replica load below which a tick counts as "
+             "calm; the hysteresis floor of the up/down load band"
+    )
+    p.add_argument(
+        "--autoscale_down_ticks", type=int, default=3,
+        help="autoscale: consecutive calm ticks required before any "
+             "scale-in (sustained-calm guard)"
+    )
+    p.add_argument(
+        "--autoscale_heal_after_s", type=float, default=5.0,
+        help="autoscale: seconds a replica stays dead/wedged/breaker-"
+             "stuck before the controller replaces it (self-healing)"
+    )
+    p.add_argument(
         "--metrics_interval_s", type=float, default=0.0,
         help="serving: live metrics plane (obs/metrics.py, docs/"
              "observability.md 'Live metrics') — publish a registry "
@@ -357,6 +390,17 @@ def build_parser() -> argparse.ArgumentParser:
         "--slo_shed_frac", type=float, default=0.05,
         help="serving SLO: tolerated windowed shed fraction before "
              "the live metrics plane fires an slo_alert; 0 = off"
+    )
+    p.add_argument(
+        "--slo_fast_window_s", type=float, default=5.0,
+        help="serving SLO: fast burn-rate window (seconds) — both "
+             "windows must burn > 1.0 to FIRE; the fast window "
+             "clearing CLEARS (edge-triggered alerts)"
+    )
+    p.add_argument(
+        "--slo_slow_window_s", type=float, default=30.0,
+        help="serving SLO: slow burn-rate window (seconds) — the "
+             "sustained-violation half of the two-window burn gate"
     )
     p.add_argument(
         "--tenant_weights", type=str, default="",
@@ -415,6 +459,13 @@ def build_parser() -> argparse.ArgumentParser:
         help="disable graceful SIGTERM/SIGINT handling (stop at the "
              "next step boundary + 'latest' save + resume-ready exit; "
              "on by default)"
+    )
+    p.add_argument(
+        "--preempt_sync_every", type=int, default=1,
+        help="multi-host graceful preemption: allgather the stop flag "
+             "every N dispatches so all hosts stop at the same step "
+             "boundary (1 = every step; raise it when the per-dispatch "
+             "collective matters)"
     )
     p.add_argument("--metrics_path", type=str, default="")
     p.add_argument(
@@ -530,6 +581,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "train.snapshot_every": args.snapshot_every,
             "train.max_rollbacks": args.max_rollbacks,
             "train.graceful_preempt": not args.no_preempt,
+            "train.preempt_sync_every": args.preempt_sync_every,
             "train.metrics_path": args.metrics_path,
             "train.log_every": args.log_every,
             "train.telemetry": args.telemetry,
@@ -546,6 +598,8 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "serve.deadline_ms": args.serve_deadline_ms,
             "serve.breaker_threshold": args.serve_breaker_threshold,
             "serve.breaker_cooldown_s": args.serve_breaker_cooldown_s,
+            "serve.drain_timeout_s": args.drain_timeout_s,
+            "serve.wedge_after_s": args.wedge_after_s,
             "serve.inject_fault": args.serve_inject_fault,
             "serve.packed": args.serve_packed,
             "serve.pack_chunk": args.serve_pack_chunk,
@@ -566,9 +620,15 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "serve.autoscale_max": args.autoscale_max,
             "serve.autoscale_cooldown_s": args.autoscale_cooldown_s,
             "serve.autoscale_interval_s": args.autoscale_interval_s,
+            "serve.autoscale_up_load": args.autoscale_up_load,
+            "serve.autoscale_down_load": args.autoscale_down_load,
+            "serve.autoscale_down_ticks": args.autoscale_down_ticks,
+            "serve.autoscale_heal_after_s": args.autoscale_heal_after_s,
             "serve.metrics_interval_s": args.metrics_interval_s,
             "serve.slo_p99_ms": args.slo_p99_ms,
             "serve.slo_shed_frac": args.slo_shed_frac,
+            "serve.slo_fast_window_s": args.slo_fast_window_s,
+            "serve.slo_slow_window_s": args.slo_slow_window_s,
             "serve.tenant_weights": args.tenant_weights,
             "serve.tenant_quotas": args.tenant_quotas,
             "serve.tenant_priorities": args.tenant_priorities,

@@ -644,6 +644,10 @@ class TcpLink:
     def __init__(self, host: str, port: int, *, timeout_s: float = 5.0):
         self._sock = socket.create_connection((host, port), timeout=timeout_s)
         self._sock.settimeout(0.2)
+        # _wlock guards the WRITE path only: send() emits each frame
+        # with one sendall under it, so concurrent senders can never
+        # interleave partial frames. The reader thread needs no lock —
+        # the socket is full-duplex and recv() has a single consumer.
         self._wlock = threading.Lock()
         self._decoder = FrameDecoder()
         self._on_message: Callable[[dict], None] | None = None
@@ -745,7 +749,7 @@ class HostAgent:
         self.topology = topology
         self.alive = True
         self.errors = 0  # inbound messages refused with ERROR
-        self._n_in = 0
+        self._n_in = 0  #: guarded_by _lock
         self._hb_seq_seen = -1
         # At-least-once discipline: the controller re-sends in-flight
         # work after a partition heals, so duplicates are NORMAL.
@@ -753,8 +757,8 @@ class HostAgent:
         # future's callbacks already stream to the link); ``_outbox``
         # retains every terminal reply so a duplicate for finished work
         # re-sends the SAME result instead of re-running it.
-        self._inflight: set[str] = set()
-        self._outbox: dict[str, dict] = {}
+        self._inflight: set[str] = set()  #: guarded_by _lock
+        self._outbox: dict[str, dict] = {}  #: guarded_by _lock
         self._lock = threading.Lock()
         self._server_sock: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
@@ -1225,18 +1229,19 @@ class ClusterRouter:
         )
         self.manifests = dict(manifests or {})
         self._series_path = series_path
-        self._series_seq = 0
+        self._series_seq = 0  #: guarded_by _lock
         self._lock = threading.RLock()
-        self._hosts: dict[str, _HostState] = {}
-        self._pending: dict[str, _Pending] = {}
-        self._sessions: dict[str, _ClusterSession] = {}
-        self._session_by_name: dict[str, str] = {}
-        self._next_id = 0
-        self._hb_seq = 0
-        self._stats_seq = 0
-        self._drained = False
+        self._hosts: dict[str, _HostState] = {}  #: guarded_by _lock
+        self._pending: dict[str, _Pending] = {}  #: guarded_by _lock
+        self._sessions: dict[str, _ClusterSession] = {}  #: guarded_by _lock
+        self._session_by_name: dict[str, str] = {}  #: guarded_by _lock
+        self._next_id = 0  #: guarded_by _lock
+        self._hb_seq = 0  #: guarded_by _lock
+        self._stats_seq = 0  #: guarded_by _lock
+        self._drained = False  #: guarded_by _lock
         self.protocol_errors = 0  # controller-side schema violations
         # The honest ledger cluster_summary reports.
+        #: guarded_by _lock
         self.counts = {
             "requests": 0,
             "completed": 0,
@@ -1255,8 +1260,9 @@ class ClusterRouter:
         quietly and mis-parse frames mid-storm. If an AOT manifest is
         registered for the joiner's topology key, it is hydrated before
         taking traffic (warm join, no compile)."""
-        if host_id in self._hosts:
-            raise ValueError(f"host {host_id!r} already federated")
+        with self._lock:
+            if host_id in self._hosts:
+                raise ValueError(f"host {host_id!r} already federated")
         state = _HostState(host_id=host_id, link=link)
         done = threading.Event()
         verdict: dict = {}
@@ -1282,6 +1288,11 @@ class ClusterRouter:
             )
         state.pool = int(verdict.get("pool", 0))
         with self._lock:
+            if host_id in self._hosts:
+                # A racing add_host handshook the same id concurrently:
+                # losing the race after a successful hello must not
+                # silently replace the winner's registered state.
+                raise ValueError(f"host {host_id!r} already federated")
             self._hosts[host_id] = state
         self.detector.register(host_id)
         manifest = self.manifests.get(verdict.get("topology"))

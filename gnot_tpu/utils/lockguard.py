@@ -1,0 +1,314 @@
+"""Runtime deadlock witness: the dynamic belt to graftlint GL008's brace.
+
+The static lock-order pass (``analysis/lockorder.py``, GL008) proves
+the *source* acquires-while-holding graph cycle-free — but it resolves
+calls by AST and must drop what it cannot prove (callbacks, getattr
+dispatch, locks threaded through data structures). This module closes
+that gap at runtime: it observes the acquisition order that *actually
+happens* and reports the first inversion with both witness stacks,
+exactly the two artifacts a deadlock post-mortem needs and never has.
+
+``GNOT_LOCK_GUARD`` selects the mode (read at :func:`install` time):
+
+* **off** (unset / ``0`` / ``off``) — nothing is patched:
+  ``threading.Lock`` / ``threading.RLock`` remain the original
+  factories (``test_lockguard.py`` pins ``threading.Lock is _ORIG_LOCK``
+  — the identity proof, same contract as the donation sanitizer's
+  off mode). Every lock in the process is byte-identical to an
+  unguarded run.
+* **witness** (``1`` / ``on`` / ``witness``) — lock *construction* in
+  this project's files is wrapped: each lock remembers its
+  construction site (``file:line`` — the same identity GL008 and
+  ``docs/artifacts/lockmap.jsonl`` use), every thread tracks its held
+  stack, and each first-seen acquisition edge ``A -> B`` (acquire B
+  while holding A) is added to a process-wide happened-before graph.
+  The first edge that closes a cycle triggers ONE ``warnings.warn``
+  carrying both stacks: the stack now (B under A) and the recorded
+  stack of the first reverse observation (A under B). The run
+  continues — witness observes, it does not arbitrate.
+* **strict** (``strict``) — as witness, but the closing edge raises
+  :class:`LockOrderViolation` *before* the real acquire, so the test
+  that provoked the inversion fails at the inversion, not as a hung
+  CI job 870 seconds later.
+
+Scope and cost: only constructions whose caller lives under
+``gnot_tpu/`` or ``tests/`` are wrapped — stdlib and third-party locks
+(queue, logging, jax) keep the original primitives. The steady-state
+acquire cost is a thread-local list append plus one dict probe per
+already-held lock; stacks are captured only when a NEW edge first
+appears (bounded by the edge count, ~dozens — see the lockmap), never
+per acquire. Tier-1 runs with witness on via ``tests/conftest.py``;
+the measured overhead is recorded in docs/static_analysis.md.
+
+Same-site, different-instance pairs (two ``EngineReplica._lock``s)
+do NOT form self-edges: instance-order inversions within one
+construction site would alias into an always-on false positive, and
+no code here acquires sibling instances nested. Reentrant
+re-acquisition of an ``RLock`` by its holder is legal and ignored; a
+*non-reentrant* lock re-acquired by its holding thread is reported
+immediately as a self-deadlock (that acquire never returns).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import traceback
+import warnings
+
+_MODES = ("off", "witness", "strict")
+
+#: Live mode; "off" until install() runs.
+_mode = "off"
+
+#: The untouched factories, captured once at import (before any
+#: install can swap them) — off-mode restores these very objects.
+_ORIG_LOCK = threading.Lock
+_ORIG_RLOCK = threading.RLock
+
+#: Graph bookkeeping lock — a raw original primitive, so the guard
+#: never traces itself.
+_meta = _ORIG_LOCK()
+
+#: site -> {site acquired while holding it, ...}
+_edges: dict[str, set[str]] = {}
+#: (held_site, acquired_site) -> witness stack of the FIRST observation.
+_edge_stacks: dict[tuple[str, str], str] = {}
+#: Reported inversions: list of dicts (test/triage introspection).
+_inversions: list[dict] = []
+_reported: set[tuple[str, str]] = set()
+
+_tls = threading.local()
+
+
+class LockOrderViolation(RuntimeError):
+    """Strict mode: an acquisition closed a lock-order cycle."""
+
+
+def guard_mode() -> str:
+    """The mode ``GNOT_LOCK_GUARD`` requests (not necessarily
+    installed yet): off / witness / strict."""
+    raw = os.environ.get("GNOT_LOCK_GUARD", "").strip().lower()
+    if raw in ("", "0", "off", "false", "no"):
+        return "off"
+    if raw == "strict":
+        return "strict"
+    return "witness"  # "1" / "on" / "true" / "witness"
+
+
+def installed_mode() -> str:
+    """The mode actually live in this process."""
+    return _mode
+
+
+def install() -> str:
+    """Install the guard per ``GNOT_LOCK_GUARD``. Idempotent; safe to
+    call from conftest, main() and tools. Off-mode restores the
+    ORIGINAL factory objects — no wrapper shims left behind. Locks
+    constructed while a previous mode was live keep their wrapping
+    (witness/strict wrappers re-check the live mode per acquire, so
+    switching to off disarms them too). Returns the live mode."""
+    global _mode
+    want = guard_mode()
+    if want == _mode:
+        return _mode
+    if want == "off":
+        threading.Lock = _ORIG_LOCK
+        threading.RLock = _ORIG_RLOCK
+    else:
+        threading.Lock = _make_lock
+        threading.RLock = _make_rlock
+    _mode = want
+    return _mode
+
+
+def _site(depth: int = 2) -> str | None:
+    """``file:line`` of the construction site when it lies in project
+    code (path contains gnot_tpu/ or tests/), else None — stdlib and
+    third-party constructions stay unwrapped."""
+    frame = sys._getframe(depth)
+    fn = frame.f_code.co_filename.replace(os.sep, "/")
+    for anchor in ("gnot_tpu/", "tests/"):
+        i = fn.rfind(anchor)
+        if i >= 0:
+            return f"{fn[i:]}:{frame.f_lineno}"
+    return None
+
+
+def _make_lock():
+    site = _site()
+    real = _ORIG_LOCK()
+    if site is None or _mode == "off":
+        return real
+    return _LockGuard(real, site, reentrant=False)
+
+
+def _make_rlock():
+    site = _site()
+    real = _ORIG_RLOCK()
+    if site is None or _mode == "off":
+        return real
+    return _LockGuard(real, site, reentrant=True)
+
+
+def _held() -> list:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+    return held
+
+
+def _stack() -> str:
+    """The current stack, lockguard frames trimmed."""
+    frames = traceback.extract_stack()
+    keep = [
+        f for f in frames
+        if "utils/lockguard" not in f.filename.replace(os.sep, "/")
+    ]
+    return "".join(traceback.format_list(keep[-12:]))
+
+
+def _reaches(src: str, dst: str) -> list[str] | None:
+    """DFS path ``src -> ... -> dst`` in the happened-before graph, or
+    None. Called under _meta."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        for nxt in _edges.get(node, ()):
+            if nxt == dst:
+                return path + [dst]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _report(kind: str, message: str, record: dict) -> None:
+    record = {"kind": kind, "message": message, **record}
+    _inversions.append(record)
+    if _mode == "strict":
+        raise LockOrderViolation(message)
+    warnings.warn(f"GNOT_LOCK_GUARD: {message}", stacklevel=4)
+
+
+class _LockGuard:
+    """A project lock: the real primitive plus order bookkeeping."""
+
+    __slots__ = ("_real", "site", "reentrant")
+
+    def __init__(self, real, site: str, reentrant: bool):
+        self._real = real
+        self.site = site
+        self.reentrant = reentrant
+
+    def __repr__(self):
+        return f"<lockguard {'RLock' if self.reentrant else 'Lock'} {self.site}>"
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if _mode != "off":
+            self._before()
+        ok = (
+            self._real.acquire(blocking, timeout)
+            if timeout != -1
+            else self._real.acquire(blocking)
+        )
+        if ok:
+            _held().append(self)
+        return ok
+
+    def release(self):
+        self._real.release()
+        held = _held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is self:
+                del held[i]
+                break
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._real.locked()
+
+    def _before(self) -> None:
+        """Pre-acquire ordering checks: self-deadlock and cycle-closing
+        edges are reported BEFORE the real acquire (strict mode must
+        raise while the thread can still raise)."""
+        held = _held()
+        if not held:
+            return
+        if not self.reentrant and any(g is self for g in held):
+            with _meta:
+                key = (self.site, self.site)
+                if key not in _reported:
+                    _reported.add(key)
+                    stack = _stack()
+                    _report(
+                        "self-deadlock",
+                        f"non-reentrant lock {self.site} re-acquired by "
+                        f"its holding thread (this acquire never "
+                        f"returns)\n--- acquiring stack ---\n{stack}",
+                        {"cycle": [self.site], "stacks": [stack]},
+                    )
+            return
+        holder = held[-1]
+        if holder is self or holder.site == self.site:
+            # Reentrant re-acquire, or a sibling instance from the
+            # same construction site: no orderable edge either way.
+            return
+        with _meta:
+            edge = (holder.site, self.site)
+            if self.site in _edges.get(holder.site, ()):
+                return  # known edge: steady state, no stack capture
+            stack = _stack()
+            _edges.setdefault(holder.site, set()).add(self.site)
+            _edge_stacks[edge] = stack
+            back = _reaches(self.site, holder.site)
+            if back is None:
+                return
+            cycle = [holder.site] + back
+            key = (holder.site, self.site)
+            if key in _reported:
+                return
+            _reported.add(key)
+            first = _edge_stacks.get((back[0], back[1]), "<unrecorded>")
+            _report(
+                "inversion",
+                f"lock-order inversion: acquiring {self.site} while "
+                f"holding {holder.site}, but the reverse order "
+                f"{' -> '.join(cycle)} was already witnessed\n"
+                f"--- this acquisition ---\n{stack}"
+                f"--- first reverse witness ({back[0]} -> {back[1]}) ---\n"
+                f"{first}",
+                {"cycle": cycle, "stacks": [stack, first]},
+            )
+
+
+def inversions() -> list[dict]:
+    """Reported inversions so far (test/triage introspection)."""
+    with _meta:
+        return list(_inversions)
+
+
+def edge_count() -> int:
+    """Witnessed happened-before edges (test/triage introspection)."""
+    with _meta:
+        return sum(len(v) for v in _edges.values())
+
+
+def reset() -> None:
+    """Drop the happened-before graph and reports (test isolation).
+    Held-stack state is per-thread and survives — callers reset
+    between scenarios, not mid-acquisition."""
+    with _meta:
+        _edges.clear()
+        _edge_stacks.clear()
+        _inversions.clear()
+        _reported.clear()

@@ -85,3 +85,15 @@ os.environ.setdefault("GNOT_ALIAS_GUARD", "1")
 from gnot_tpu.utils import sanitizer
 
 sanitizer.install()
+
+# Runtime deadlock witness ON for tier-1 (ISSUE 19): GNOT_LOCK_GUARD
+# defaults to witness mode, so every project lock constructed by the
+# serving/federation/autoscale suites records its acquisition order
+# and the first lock-order inversion warns with both stacks — the
+# dynamic belt to graftlint GL008's static brace (docs/robustness.md
+# "The lock guard"). An explicit GNOT_LOCK_GUARD=0 (or =strict) still
+# wins. utils/lockguard.py; measured overhead in static_analysis.md.
+os.environ.setdefault("GNOT_LOCK_GUARD", "witness")
+from gnot_tpu.utils import lockguard
+
+lockguard.install()

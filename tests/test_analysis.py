@@ -896,7 +896,8 @@ def test_pyproject_config_parses_without_tomllib():
     fallback parser (this image's python predates tomllib)."""
     cfg = load_config(REPO)
     assert cfg.enable == [
-        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"
+        "GL001", "GL002", "GL003", "GL004", "GL005",
+        "GL006", "GL007", "GL008", "GL009", "GL010",
     ]
     assert cfg.paths == ["gnot_tpu", "tests", "tools"]
     assert "gnot_tpu/native/" in cfg.exclude
@@ -1163,7 +1164,8 @@ def test_repo_tree_is_clean():
     cfg = load_config(REPO)
     findings, stats = run_analysis(cfg.paths, root=REPO, config=cfg)
     assert stats["rules"] == [
-        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"
+        "GL001", "GL002", "GL003", "GL004", "GL005",
+        "GL006", "GL007", "GL008", "GL009", "GL010",
     ]
     assert stats["files"] > 90  # gnot_tpu + tests + tools, not a subset
     assert findings == [], "\n".join(f.format() for f in findings)
@@ -1183,7 +1185,8 @@ def test_rule_registry_complete():
     from gnot_tpu.analysis import RULES
 
     assert sorted(RULES) == [
-        "GL001", "GL002", "GL003", "GL004", "GL005", "GL006", "GL007"
+        "GL001", "GL002", "GL003", "GL004", "GL005",
+        "GL006", "GL007", "GL008", "GL009", "GL010",
     ]
     for rid, cls in RULES.items():
         assert cls.id == rid and cls.title and cls.hint
@@ -1283,3 +1286,386 @@ def test_gl007_real_tree_bindings_agree():
     cfg.enable = ["GL007"]
     findings, _ = run_analysis(["gnot_tpu"], root=REPO, config=cfg)
     assert findings == [], "\n".join(f.format() for f in findings)
+
+
+# --- GL008: lock-order inversion (the concurrency plane) ------------------
+
+
+# Mutation-style reconstruction of the pre-fix autoscaler<->router
+# shape GL008 exists to forbid: the controller ticks into the pool
+# under its tick lock, and the pool — in this mutated twin — calls
+# back into the controller while still holding the pool lock.
+GL008_BAD = """
+    import threading
+
+    class Router:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.scaler = None
+
+        def pool(self):
+            with self._lock:
+                return 1
+
+        def remove(self):
+            with self._lock:
+                self.scaler.assess()
+
+    class Controller:
+        def __init__(self, router):
+            self._tick_lock = threading.Lock()
+            self.router = router
+
+        def tick(self):
+            with self._tick_lock:
+                self.router.pool()
+
+        def assess(self):
+            with self._tick_lock:
+                return 2
+"""
+
+# The shipped shape: calls into the other class happen with the
+# caller's lock held in ONE direction only.
+GL008_CLEAN = GL008_BAD.replace(
+    """        def remove(self):
+            with self._lock:
+                self.scaler.assess()
+""",
+    """        def remove(self):
+            with self._lock:
+                n = 1
+            self.scaler.assess()
+""",
+)
+
+
+def test_gl008_lock_order_cycle_is_caught(tmp_path):
+    findings, _ = lint_source(tmp_path, GL008_BAD, rules=["GL008"])
+    assert [f.rule for f in findings] == ["GL008"]
+    f = findings[0]
+    assert "lock-order cycle" in f.message
+    assert f.project_level  # --changed must never scope it out
+    # Both witness paths, each a file:line hop chain through the call.
+    assert "Controller._tick_lock" in f.message
+    assert "Router._lock" in f.message
+    assert f.message.count("mod.py:") >= 4
+
+
+def test_gl008_consistent_order_is_clean(tmp_path):
+    findings, _ = lint_source(tmp_path, GL008_CLEAN, rules=["GL008"])
+    assert findings == []
+
+
+def test_gl008_suppression_on_the_edge_acquisition(tmp_path):
+    suppressed = GL008_BAD.replace(
+        "self.scaler.assess()",
+        "self.scaler.assess()  # graftlint: disable=GL008 — fixture: "
+        "callback is documented reentrancy-safe",
+    )
+    findings, stats = lint_source(tmp_path, suppressed, rules=["GL008"])
+    assert findings == []
+
+
+def test_gl008_self_deadlock_is_caught(tmp_path):
+    src = """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def fetch(self):
+                with self._lock:
+                    return self.census()
+
+            def census(self):
+                with self._lock:
+                    return 0
+    """
+    findings, _ = lint_source(tmp_path, src, rules=["GL008"])
+    assert [f.rule for f in findings] == ["GL008"]
+    assert "self-deadlock" in findings[0].message
+
+
+def test_gl008_rlock_reentrancy_is_not_a_finding(tmp_path):
+    src = """
+        import threading
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.RLock()
+
+            def fetch(self):
+                with self._lock:
+                    return self.census()
+
+            def census(self):
+                with self._lock:
+                    return 0
+    """
+    findings, _ = lint_source(tmp_path, src, rules=["GL008"])
+    assert findings == []
+
+
+def test_gl008_single_lock_class_is_clean(tmp_path):
+    src = """
+        import threading
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def bump(self):
+                with self._lock:
+                    self._n += 1
+    """
+    findings, _ = lint_source(tmp_path, src, rules=["GL008"])
+    assert findings == []
+
+
+# --- GL009: blocking call under a held lock -------------------------------
+
+
+def test_gl009_unbounded_future_result_under_lock(tmp_path):
+    src = """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.fut = None
+
+            def wait_result(self):
+                with self._lock:
+                    return self.fut.result()
+    """
+    findings, _ = lint_source(tmp_path, src, rules=["GL009"])
+    assert [f.rule for f in findings] == ["GL009"]
+    assert "result()" in findings[0].message
+    assert "_lock" in findings[0].message
+
+
+def test_gl009_bounded_wait_and_unlocked_wait_are_clean(tmp_path):
+    src = """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.fut = None
+
+            def wait_bounded(self):
+                with self._lock:
+                    return self.fut.result(timeout=1.0)
+
+            def wait_unlocked(self):
+                return self.fut.result()
+    """
+    findings, _ = lint_source(tmp_path, src, rules=["GL009"])
+    assert findings == []
+
+
+def test_gl009_socket_and_slow_callable_under_lock(tmp_path):
+    src = """
+        import threading
+
+        class Host:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.sock = None
+                self.engine = None
+
+            def pump(self):
+                with self._lock:
+                    data = self.sock.recv(65536)
+                return data
+
+            def warm(self):
+                with self._lock:
+                    self.engine.warmup()
+
+            def run(self):
+                with self._lock:
+                    self.engine.infer_packed(None)
+    """
+    findings, _ = lint_source(tmp_path, src, rules=["GL009"])
+    assert len(findings) == 3
+    msgs = " | ".join(f.message for f in findings)
+    assert "recv" in msgs and "warmup" in msgs and "infer_packed" in msgs
+
+
+def test_gl009_allowed_blocking_annotation_contract(tmp_path):
+    justified = """
+        import threading
+
+        class Host:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.engine = None
+
+            def warm(self):
+                with self._lock:
+                    #: allowed_blocking — startup path, no traffic yet
+                    self.engine.warmup()
+    """
+    findings, _ = lint_source(tmp_path, justified, rules=["GL009"])
+    assert findings == []
+    # The annotation WITHOUT a reason is itself a finding: the
+    # contract is a justification, not a mute button.
+    bare = justified.replace(
+        "#: allowed_blocking — startup path, no traffic yet",
+        "#: allowed_blocking",
+    )
+    findings, _ = lint_source(tmp_path, bare, rules=["GL009"])
+    assert [f.rule for f in findings] == ["GL009"]
+    assert "missing its justification" in findings[0].message
+
+
+# --- GL010: config drift (dataclass <-> CLI <-> docs) ---------------------
+
+
+_GL010_CONFIG = """
+    from dataclasses import dataclass
+
+    @dataclass
+    class TrainConfig:
+        epochs: int = 1
+        snapshot_every: int = 50
+
+    @dataclass
+    class ServeConfig:
+        max_batch: int = 4
+"""
+
+_GL010_CLI = """
+    import argparse
+
+    def build_parser():
+        p = argparse.ArgumentParser()
+        p.add_argument("--epochs", type=int, default=1)
+        p.add_argument("--snapshot_every", type=int, default=50)
+        p.add_argument("--serve_max_batch", type=int, default=4)
+        return p
+
+    def config_from_args(args):
+        return {
+            "train.epochs": args.epochs,
+            "train.snapshot_every": args.snapshot_every,
+            "serve.max_batch": args.serve_max_batch,
+        }
+"""
+
+_GL010_DOC = "`epochs` and `--snapshot_every` and `serve.max_batch`\n"
+
+
+def _gl010_sandbox(tmp_path, cfg_src=_GL010_CONFIG, cli_src=_GL010_CLI,
+                   doc=_GL010_DOC):
+    import textwrap as _tw
+
+    pkg = tmp_path / "gnot_tpu"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "config.py").write_text(_tw.dedent(cfg_src))
+    (pkg / "main.py").write_text(_tw.dedent(cli_src))
+    docs = tmp_path / "docs"
+    docs.mkdir(exist_ok=True)
+    (docs / "serving.md").write_text(doc)
+    (docs / "robustness.md").write_text("")
+    (docs / "observability.md").write_text("")
+    cfg = LintConfig(enable=["GL010"])
+    return run_analysis(["gnot_tpu"], root=str(tmp_path), config=cfg)[0]
+
+
+def test_gl010_fully_wired_config_is_clean(tmp_path):
+    assert _gl010_sandbox(tmp_path) == []
+
+
+def test_gl010_unwired_field_is_caught(tmp_path):
+    cli = _GL010_CLI.replace(
+        '            "train.snapshot_every": args.snapshot_every,\n', ""
+    )
+    findings = _gl010_sandbox(tmp_path, cli_src=cli)
+    assert [f.rule for f in findings] == ["GL010"]
+    assert "train.snapshot_every has no CLI wiring" in findings[0].message
+    assert findings[0].path == "gnot_tpu/config.py"
+    assert findings[0].project_level
+
+
+def test_gl010_mapping_reads_undeclared_flag(tmp_path):
+    cli = _GL010_CLI.replace(
+        '        p.add_argument("--snapshot_every", type=int, default=50)\n',
+        "",
+    )
+    findings = _gl010_sandbox(tmp_path, cli_src=cli)
+    msgs = " | ".join(f.message for f in findings)
+    assert "reads args.snapshot_every but no --snapshot_every flag" in msgs
+
+
+def test_gl010_undocumented_field_is_caught(tmp_path):
+    findings = _gl010_sandbox(
+        tmp_path, doc="`epochs` and `--snapshot_every`\n"
+    )
+    assert [f.rule for f in findings] == ["GL010"]
+    assert "serve.max_batch is not documented" in findings[0].message
+
+
+def test_gl010_ghost_mapping_key_is_caught(tmp_path):
+    cli = _GL010_CLI.replace(
+        '"serve.max_batch": args.serve_max_batch,',
+        '"serve.max_batch": args.serve_max_batch,\n'
+        '            "serve.ghost": args.serve_max_batch,',
+    )
+    findings = _gl010_sandbox(tmp_path, cli_src=cli)
+    msgs = " | ".join(f.message for f in findings)
+    assert "'serve.ghost' does not match any field" in msgs
+    assert all(f.path == "gnot_tpu/main.py" for f in findings)
+
+
+def test_gl010_suppression_at_field_declaration(tmp_path):
+    cfg = _GL010_CONFIG.replace(
+        "max_batch: int = 4",
+        "max_batch: int = 4  # graftlint: disable=GL010 — fixture: "
+        "library-only knob",
+    )
+    cli = _GL010_CLI.replace(
+        '            "serve.max_batch": args.serve_max_batch,\n', ""
+    )
+    findings = _gl010_sandbox(tmp_path, cfg_src=cfg, cli_src=cli)
+    assert findings == []
+
+
+def test_gl010_real_tree_config_is_wired():
+    """Every TrainConfig/ServeConfig field reaches a --flag and a doc
+    mention right now (isolated from the whole-tree gate so a drift
+    failure names the rule)."""
+    cfg = load_config(REPO)
+    cfg.enable = ["GL010"]
+    findings, _ = run_analysis(["gnot_tpu"], root=REPO, config=cfg)
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_gl008_real_tree_lock_graph_is_acyclic():
+    """The live acquires-while-holding graph: cycle-free, and big
+    enough that an accidentally-neutered resolver would fail loudly
+    (the lockmap artifact pins the same numbers)."""
+    from gnot_tpu.analysis.core import FileContext, iter_python_files
+    from gnot_tpu.analysis.lockorder import build_lock_graph
+
+    cfg = load_config(REPO)
+    contexts = []
+    for rel in iter_python_files(cfg.paths, REPO, cfg):
+        with open(os.path.join(REPO, rel), encoding="utf-8") as fh:
+            contexts.append(FileContext(REPO, rel, fh.read(), cfg))
+    nodes, edges, cycles = build_lock_graph(contexts)
+    assert cycles == []
+    assert len(nodes) >= 20  # the serving/obs/federation lock census
+    assert len(edges) >= 10
+    # The chains the serving layer actually relies on are resolved —
+    # a resolver regression that silently dropped call-mediated edges
+    # would make the cycle check vacuous.
+    assert ("AutoscaleController._tick_lock", "ReplicaRouter._lock") in edges
+    assert ("ReplicaRouter._reload_lock", "ReplicaRouter._lock") in edges
+    # The federation discipline, verified statically: the cluster
+    # RLock is NEVER held across a link send or host call-out.
+    assert not any(a == "ClusterRouter._lock" for a, _ in edges)

@@ -604,3 +604,53 @@ def test_federation_ab_artifact_schema():
     assert summary["remigrated"] == chaos["remigrated"]
     assert summary["max_abs_diff"] <= summary["bar_numeric"] == 1e-5
     assert summary["single_host_byte_identical"] is True
+
+
+def test_lockmap_artifact_schema():
+    """The committed lock map (tools/lockmap_report.py): every lock
+    identity as a node record, every acquires-while-holding edge with
+    its file:line witness chain, and a summary pinned to the shippable
+    state — zero cycles over a census of at least 20 locks (the
+    serving/obs/federation planes). A locking change regenerates the
+    artifact; this test keeps a stale or cyclic map out of the tree."""
+    path = os.path.join(ARTIFACT_DIR, "lockmap.jsonl")
+    with open(path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    by_kind = {}
+    for r in recs:
+        by_kind.setdefault(r["record"], []).append(r)
+    assert set(by_kind) == {"node", "edge", "summary"}
+    nodes = {r["lock"] for r in by_kind["node"]}
+    for r in by_kind["node"]:
+        assert r["kind"] in ("Lock", "RLock", "Condition")
+        assert r["file"].endswith(".py") and r["line"] >= 1
+        assert r["module"]
+    for r in by_kind["edge"]:
+        assert r["held"] != r["acquired"]  # a self-loop IS a cycle
+        assert r["held"] in nodes or r["acquired"] in nodes
+        assert len(r["witness"]) >= 2  # outer hop + inner acquisition
+        assert all(":" in hop for hop in r["witness"])
+    (summary,) = by_kind["summary"]
+    assert summary["schema"] == 1
+    assert summary["cycles"] == []  # THE bar: the graph is acyclic
+    assert summary["locks"] == len(by_kind["node"]) >= 20
+    assert summary["edges"] == len(by_kind["edge"])
+    assert sum(summary["census"].values()) == summary["locks"]
+    # The live tree regenerates to the SAME graph shape (nodes/edges/
+    # cycles) — a committed map that drifted from source is stale.
+    import importlib.util
+
+    repo_root = os.path.normpath(os.path.join(ARTIFACT_DIR, "..", ".."))
+    spec = importlib.util.spec_from_file_location(
+        "gnot_lockmap_cli",
+        os.path.join(repo_root, "tools", "lockmap_report.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    live_lines, n_cycles = mod.lockmap_lines(repo_root)
+    assert n_cycles == 0
+    live = [json.loads(l) for l in live_lines]
+    assert [r for r in live if r["record"] == "summary"] == [summary]
+    assert sorted(
+        (r["held"], r["acquired"]) for r in live if r["record"] == "edge"
+    ) == sorted((r["held"], r["acquired"]) for r in by_kind["edge"])

@@ -1,0 +1,200 @@
+"""Runtime deadlock witness (utils/lockguard.py, ISSUE 19).
+
+The contract, mode by mode:
+
+* off is BYTE-IDENTICAL: ``threading.Lock``/``RLock`` are the original
+  factory objects (identity, not equality — the sanitizer's off-mode
+  proof pattern), and constructions return raw ``_thread`` primitives.
+* witness wraps project-scoped constructions, records the
+  happened-before graph, and the first cycle-closing acquisition warns
+  ONCE with both stacks (the acquiring stack and the first reverse
+  witness).
+* strict raises :class:`LockOrderViolation` BEFORE the real acquire —
+  the inversion fails fast instead of wedging the suite.
+
+The inversion fixtures are deterministic: two threads run one after
+the other (start/join, never concurrent), so the edge order — and
+therefore which acquisition closes the cycle — is fixed.
+"""
+
+import os
+import threading
+import warnings
+
+import pytest
+
+from gnot_tpu.utils import lockguard
+
+
+@pytest.fixture
+def guard_mode():
+    """Set a GNOT_LOCK_GUARD mode for one test; restore the tier-1
+    default (witness, via conftest) and drop the graph afterwards."""
+    prev = os.environ.get("GNOT_LOCK_GUARD")
+
+    def set_mode(mode: str) -> None:
+        os.environ["GNOT_LOCK_GUARD"] = mode
+        lockguard.install()
+        lockguard.reset()
+
+    yield set_mode
+    if prev is None:
+        os.environ.pop("GNOT_LOCK_GUARD", None)
+    else:
+        os.environ["GNOT_LOCK_GUARD"] = prev
+    lockguard.install()
+    lockguard.reset()
+
+
+def test_off_mode_is_byte_identical(guard_mode):
+    guard_mode("off")
+    # Identity, not wrapper shims: the very objects captured at import.
+    assert threading.Lock is lockguard._ORIG_LOCK
+    assert threading.RLock is lockguard._ORIG_RLOCK
+    lock = threading.Lock()
+    assert type(lock).__module__ == "_thread"
+    assert lockguard.installed_mode() == "off"
+
+
+def test_witness_wraps_project_constructions(guard_mode):
+    guard_mode("witness")
+    lock = threading.Lock()
+    assert isinstance(lock, lockguard._LockGuard)
+    assert lock.site.startswith("tests/test_lockguard.py:")
+    with lock:
+        assert lock.locked()
+    assert not lock.locked()
+
+
+def test_consistent_order_stays_silent(guard_mode):
+    guard_mode("witness")
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def nested():
+        with a:
+            with b:
+                pass
+
+    for _ in range(3):
+        t = threading.Thread(target=nested)
+        t.start()
+        t.join()
+    assert lockguard.inversions() == []
+    assert lockguard.edge_count() == 1  # a -> b, recorded once
+
+
+def test_inversion_warns_once_with_both_stacks(guard_mode):
+    guard_mode("witness")
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def forward():  # witnesses a -> b
+        with a:
+            with b:
+                pass
+
+    def backward():  # closes the cycle: b -> a
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=forward)
+    t1.start()
+    t1.join()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        t2 = threading.Thread(target=backward)
+        t2.start()
+        t2.join()
+        t3 = threading.Thread(target=backward)  # same inversion again
+        t3.start()
+        t3.join()
+    msgs = [str(w.message) for w in caught if "GNOT_LOCK_GUARD" in str(w.message)]
+    assert len(msgs) == 1, msgs  # first inversion only, never spam
+    msg = msgs[0]
+    assert "lock-order inversion" in msg
+    # Both stacks, labeled, each pointing into this file's fixtures.
+    assert "--- this acquisition ---" in msg
+    assert "--- first reverse witness" in msg
+    assert msg.count("test_lockguard.py") >= 2
+    assert "backward" in msg and "forward" in msg
+    (rec,) = lockguard.inversions()
+    assert rec["kind"] == "inversion"
+    assert len(rec["cycle"]) == 3  # b -> a -> b (both sites + closure)
+    assert len(rec["stacks"]) == 2
+
+
+def test_strict_raises_before_acquire(guard_mode):
+    guard_mode("strict")
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=forward)
+    t.start()
+    t.join()
+    with b:
+        with pytest.raises(lockguard.LockOrderViolation):
+            with a:
+                pass
+    # The raise happened BEFORE the real acquire: a is free.
+    assert a.acquire(blocking=False)
+    a.release()
+
+
+def test_self_deadlock_reported_not_hung(guard_mode):
+    guard_mode("witness")
+    lock = threading.Lock()
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        with lock:
+            # Non-blocking, so the test cannot hang even if the guard
+            # missed: the report must fire regardless of blocking.
+            assert not lock.acquire(blocking=False)  # graftlint: disable=GL008 — deliberate self-deadlock fixture the witness must catch
+    msgs = [str(w.message) for w in caught if "GNOT_LOCK_GUARD" in str(w.message)]
+    assert len(msgs) == 1
+    assert "re-acquired by its holding thread" in msgs[0]
+
+
+def test_rlock_reentrancy_is_silent(guard_mode):
+    guard_mode("witness")
+    rlock = threading.RLock()
+    with rlock:
+        with rlock:  # legal reentrancy: no self-deadlock report
+            pass
+    assert lockguard.inversions() == []
+
+
+def test_same_site_siblings_form_no_edge(guard_mode):
+    guard_mode("witness")
+    # Two instances from ONE construction site (the per-replica-lock
+    # shape): nested acquisition must not self-edge into a false
+    # positive.
+    siblings = [threading.Lock() for _ in range(2)]
+    with siblings[0]:
+        with siblings[1]:
+            pass
+    assert lockguard.inversions() == []
+    assert lockguard.edge_count() == 0
+
+
+def test_timeout_and_nonblocking_acquire_pass_through(guard_mode):
+    guard_mode("witness")
+    lock = threading.Lock()
+    assert lock.acquire(timeout=0.5)
+    lock.release()
+    assert lock.acquire(blocking=False)
+    lock.release()
+
+
+def test_stdlib_constructions_stay_raw(guard_mode):
+    guard_mode("witness")
+    import queue
+
+    q = queue.Queue()  # queue.py constructs its own lock: out of scope
+    assert type(q.mutex).__module__ == "_thread"

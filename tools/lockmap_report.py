@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""Emit the project lock map: every lock, every ordering edge, zero cycles.
+
+The GL008 pass (``gnot_tpu/analysis/lockorder.py``) already builds the
+project-wide acquires-while-holding graph to *gate* on cycles; this
+tool publishes the same graph as a committed artifact,
+``docs/artifacts/lockmap.jsonl`` — the concurrency plane's census,
+alongside the capacity/overhead artifacts. A reviewer reading a
+locking change diffs the lockmap instead of re-deriving the ordering
+discipline from source; ``tests/test_artifacts.py`` pins the schema
+and asserts ``cycles == 0`` and the lock census floor, so the
+committed map can never drift stale or cyclic.
+
+Record shapes (one JSON object per line, ``record`` discriminates):
+
+* ``{"record": "node", "lock", "kind", "file", "line", "module",
+  "class"}`` — one per lock identity (``Class.attr`` /
+  ``module.name`` / ``module.fn.name``).
+* ``{"record": "edge", "held", "acquired", "witness": [...]}`` — one
+  per ordering edge; ``witness`` is the ``file:line`` hop chain from
+  the outer acquisition to the inner one (call-mediated hops carry
+  ``(inside callee)`` markers).
+* ``{"record": "summary", "schema": 1, "locks", "edges", "cycles",
+  "census": {module: lock count}}`` — last line; ``cycles`` is a
+  LIST (shippable state: ``[]``), so a regression is visible in the
+  artifact itself, not only in the exit status.
+
+Usage::
+
+    python tools/lockmap_report.py                     # stdout
+    python tools/lockmap_report.py --out docs/artifacts/lockmap.jsonl
+
+Exit status: 0 when cycle-free, 1 when any cycle exists.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+# Same import shim as tools/lint.py: the analysis package is
+# stdlib-only; skip gnot_tpu/__init__.py's jax import.
+if "gnot_tpu" not in sys.modules:
+    import types
+
+    _stub = types.ModuleType("gnot_tpu")
+    _stub.__path__ = [os.path.join(_REPO_ROOT, "gnot_tpu")]
+    sys.modules["gnot_tpu"] = _stub
+
+from gnot_tpu.analysis.core import (  # noqa: E402
+    FileContext,
+    iter_python_files,
+    load_config,
+)
+from gnot_tpu.analysis.lockorder import build_lock_graph  # noqa: E402
+
+
+def lockmap_lines(root: str) -> tuple[list[str], int]:
+    """The artifact's lines (no trailing newline each) and the cycle
+    count — separated from main() so tests can call it in-process."""
+    cfg = load_config(root)
+    contexts = []
+    for rel in iter_python_files(cfg.paths, root, cfg):
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as f:
+                source = f.read()
+        except OSError:
+            continue
+        contexts.append(FileContext(root, rel, source, cfg))
+    nodes, edges, cycles = build_lock_graph(contexts)
+
+    lines: list[str] = []
+    for lock in sorted(nodes):
+        lines.append(json.dumps({"record": "node", "lock": lock, **nodes[lock]}))
+    for held, acquired in sorted(edges):
+        lines.append(
+            json.dumps(
+                {
+                    "record": "edge",
+                    "held": held,
+                    "acquired": acquired,
+                    "witness": edges[(held, acquired)],
+                }
+            )
+        )
+    census: dict[str, int] = {}
+    for meta in nodes.values():
+        census[meta["module"]] = census.get(meta["module"], 0) + 1
+    lines.append(
+        json.dumps(
+            {
+                "record": "summary",
+                "schema": 1,
+                "locks": len(nodes),
+                "edges": len(edges),
+                "cycles": cycles,
+                "census": dict(sorted(census.items())),
+            }
+        )
+    )
+    return lines, len(cycles)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=_REPO_ROOT)
+    ap.add_argument(
+        "--out", default="", help="write here instead of stdout"
+    )
+    args = ap.parse_args(argv)
+
+    lines, n_cycles = lockmap_lines(args.root)
+    text = "\n".join(lines) + "\n"
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text)
+    else:
+        sys.stdout.write(text)
+    if n_cycles:
+        print(f"lockmap: {n_cycles} cycle(s) — NOT shippable", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
