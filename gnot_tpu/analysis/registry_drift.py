@@ -1,10 +1,13 @@
-"""GL005 — event/fault/wire registry drift.
+"""GL005 — event/fault/wire/span registry drift.
 
-Three central registries exist so the observability and protocol
+Four central registries exist so the observability and protocol
 surfaces cannot rot silently:
 
 * ``gnot_tpu/obs/events.py`` — every event kind a ``MetricsSink``
   record may carry (name, required payload fields, emitting module);
+* ``gnot_tpu/obs/events.py::SPANS`` — every tracer span kind
+  (``obs/tracing.py`` / ``obs/dtrace.py`` — the taxonomy
+  ``tools/trace_report.py`` groups by);
 * ``gnot_tpu/resilience/faults.py::FAULT_KINDS`` — every injectable
   fault kind;
 * ``gnot_tpu/serve/federation.py::MESSAGES`` — every federation wire
@@ -12,10 +15,15 @@ surfaces cannot rot silently:
 
 The rule enforces, per file: every event kind passed to
 ``sink.log(event=...)`` / ``self._event(...)`` / ``on_event(event=...)``
-resolves to an events-registry entry, and every wire kind passed to
+resolves to an events-registry entry, every wire kind passed to
 ``wire(X, ...)`` resolves to a MESSAGES entry (string literals and
-module-constant references both). Project-wide: every registry entry
-appears in the user-facing docs (``docs/observability.md`` for events,
+module-constant references both), and every LITERAL span name passed
+to a tracer span site (``span``/``add_span``/``timed_iter``/
+``_trace_span``/``_tspan``) resolves to a SPANS entry — in library and
+tool code only: tests construct toy spans by design, so ``tests/`` is
+exempt from the span-site check (events and wire kinds stay checked
+there). Project-wide: every registry entry appears in the user-facing
+docs (``docs/observability.md`` for events AND spans,
 ``docs/robustness.md`` for fault kinds, ``docs/serving.md`` for wire
 messages) — the docs are part of the contract, so adding a kind
 without documenting it fails tier-1.
@@ -141,6 +149,72 @@ def _emitted_kinds(
     return sites
 
 
+def _parse_spans(path: str) -> tuple[dict[str, int], bool]:
+    """``(kinds, declared)``: ``SPANS`` literal-dict keys → declaration
+    lines from the events registry module, plus whether a top-level
+    ``SPANS`` assignment exists at all. Kept separate from
+    ``_parse_registry`` on purpose: span kinds are a sibling namespace
+    to event kinds, not a subset — merging them would let a span name
+    silence a missing-event finding (and vice versa). ``declared``
+    distinguishes a registry that predates SPANS (fixture sandboxes:
+    the span checks are simply vacuous) from one whose SPANS table
+    fails to parse (a loud project finding)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return {}, False
+    kinds: dict[str, int] = {}
+    declared = False
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            names = {t.id for t in node.targets if isinstance(t, ast.Name)}
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            names = {node.target.id}
+        else:
+            continue
+        if node.value is None or "SPANS" not in names:
+            continue
+        declared = True
+        if isinstance(node.value, ast.Dict):
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    kinds[k.value] = k.lineno
+    return kinds, declared
+
+
+# Span-recording call sites and which positional argument carries the
+# span NAME. ``span``/``add_span`` take it first; ``timed_iter`` takes
+# (iterable, name); the ``_trace_span``/``_tspan`` helpers in
+# server.py/trainer.py take (trace, name).
+_SPAN_CALLS = {
+    "span": 0,
+    "add_span": 0,
+    "timed_iter": 1,
+    "_trace_span": 1,
+    "_tspan": 1,
+}
+
+
+def _span_sites(ctx: FileContext) -> list[_EmitSite]:
+    """Literal span names this file records via a tracer span site.
+    Dynamic names (variables, f-strings) are skipped — they are checked
+    at their own literal origin, same as event emit sites."""
+    sites: list[_EmitSite] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        pos = _SPAN_CALLS.get(terminal_name(node.func))
+        if pos is None or len(node.args) <= pos:
+            continue
+        expr = node.args[pos]
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            sites.append(_EmitSite(expr.value, expr.lineno))
+    return sites
+
+
 def _wire_sites(ctx: FileContext, constants: dict[str, str]) -> list[_EmitSite]:
     """Wire message kinds this file passes to ``wire(X, ...)`` — the
     federation protocol's frame builder. ``X`` may be a string literal
@@ -171,7 +245,7 @@ class RegistryDrift(Rule):
     id = "GL005"
     title = "registry-drift"
     hint = (
-        "add the kind to gnot_tpu/obs/events.py (events), "
+        "add the kind to gnot_tpu/obs/events.py (events/SPANS), "
         "resilience/faults.py::FAULT_KINDS (faults) or "
         "serve/federation.py::MESSAGES (wire), and document it in "
         "docs/observability.md / docs/robustness.md / docs/serving.md"
@@ -182,6 +256,7 @@ class RegistryDrift(Rule):
         self._constants: dict[str, dict[str, str]] = {}
         self._msg_kinds: dict[str, dict[str, int]] = {}
         self._msg_constants: dict[str, dict[str, str]] = {}
+        self._span_kinds: dict[str, tuple[dict[str, int], bool]] = {}
 
     def _registry(self, root: str, cfg) -> tuple[dict[str, int], dict[str, str]]:
         key = root
@@ -203,6 +278,14 @@ class RegistryDrift(Rule):
             self._msg_constants[key] = constants
         return self._msg_kinds[key], self._msg_constants[key]
 
+    def _spans(self, root: str, cfg) -> tuple[dict[str, int], bool]:
+        key = root
+        if key not in self._span_kinds:
+            self._span_kinds[key] = _parse_spans(
+                os.path.join(root, cfg.events_registry)
+            )
+        return self._span_kinds[key]
+
     def check_file(self, ctx: FileContext) -> list[Finding]:
         kinds, constants = self._registry(ctx.root, ctx.config)
         findings: list[Finding] = []
@@ -217,6 +300,29 @@ class RegistryDrift(Rule):
                             message=(
                                 f"event kind {site.kind!r} is not in the "
                                 f"central registry ({ctx.config.events_registry})"
+                            ),
+                            hint=self.hint,
+                        )
+                    )
+        span_kinds, _ = self._spans(ctx.root, ctx.config)
+        rel = ctx.path.replace(os.sep, "/")
+        # tests/ is exempt from the SPAN-site check only: test suites
+        # construct toy spans ("outer", "orphan", ...) to exercise the
+        # tracer itself. Event and wire checks still apply there.
+        if span_kinds and not (
+            rel.startswith("tests/") or "/tests/" in rel
+        ):
+            for site in _span_sites(ctx):
+                if site.kind not in span_kinds:
+                    findings.append(
+                        Finding(
+                            rule=self.id,
+                            path=ctx.path,
+                            line=site.line,
+                            message=(
+                                f"span kind {site.kind!r} is not in the "
+                                f"SPANS registry "
+                                f"({ctx.config.events_registry})"
                             ),
                             hint=self.hint,
                         )
@@ -275,6 +381,35 @@ class RegistryDrift(Rule):
                 project.root, cfg.events_registry, kinds, cfg.docs_events
             )
         )
+        span_kinds, spans_declared = self._spans(project.root, cfg)
+        if spans_declared and not span_kinds:
+            # Same loudness contract as EVENTS/MESSAGES: a declared
+            # SPANS table that fails to parse as a literal dict would
+            # silently disable every span-site check — surface it. A
+            # registry with NO SPANS assignment (fixture sandboxes)
+            # simply has the span plane vacuous.
+            findings.append(
+                Finding(
+                    rule=self.id,
+                    path=cfg.events_registry,
+                    line=1,
+                    message=(
+                        "SPANS is not parseable as a literal dict of "
+                        "string keys — GL005 cannot check span sites "
+                        "against it"
+                    ),
+                    hint="keep SPANS a literal {str: SpanSpec} dict",
+                )
+            )
+        elif span_kinds:
+            findings.extend(
+                self._docs_coverage(
+                    project.root,
+                    cfg.events_registry,
+                    span_kinds,
+                    cfg.docs_events,
+                )
+            )
         fault_kinds, _ = _parse_registry(
             os.path.join(project.root, cfg.faults_registry)
         )

@@ -508,6 +508,13 @@ class ServeConfig:
     heartbeat_interval_s: float = 0.5
     suspect_after_s: float = 2.0
     dead_after_s: float = 6.0
+    # Anomaly flight recorder (obs/dtrace.py, docs/observability.md
+    # "Distributed tracing"): keep the last N seconds of ALL spans and
+    # events — sampled or not — in a bounded per-host ring, dumped
+    # atomically on trigger edges (slo_alert fire, breaker_open,
+    # host_dead, non_finite_loss, lockguard inversion). 0 = off (no
+    # recorder objects exist; the span paths carry no shadow ids).
+    flight_recorder_s: float = 0.0
 
     def __post_init__(self) -> None:
         if self.max_batch < 1:
@@ -630,6 +637,11 @@ class ServeConfig:
                 "failure detector needs 0 < suspect_after_s < "
                 "dead_after_s (the suspicion dwell), got "
                 f"{self.suspect_after_s}/{self.dead_after_s}"
+            )
+        if self.flight_recorder_s < 0:
+            raise ValueError(
+                "flight_recorder_s must be >= 0 (0 = off), got "
+                f"{self.flight_recorder_s}"
             )
         if self.hosts > 1 and self.autoscale:
             raise ValueError(

@@ -318,6 +318,16 @@ def build_parser() -> argparse.ArgumentParser:
              "suspicion dwell absorbs GC pauses and slow heartbeats)"
     )
     p.add_argument(
+        "--flight_recorder_s", type=float, default=0.0,
+        help="anomaly flight recorder (obs/dtrace.py, "
+             "docs/observability.md 'Distributed tracing'): keep the "
+             "last N seconds of ALL spans/events — sampled or not — in "
+             "a bounded per-host ring, dumped atomically beside the "
+             "trace/metrics path on trigger edges (slo_alert fire, "
+             "breaker_open, host_dead, non_finite_loss, lockguard "
+             "inversion); 0 = off"
+    )
+    p.add_argument(
         "--autoscale", action="store_true",
         help="serving: self-healing elastic pool (serve/autoscaler.py, "
              "docs/serving.md 'Elastic capacity') — an "
@@ -615,6 +625,7 @@ def config_from_args(args: argparse.Namespace) -> Config:
             "serve.heartbeat_interval_s": args.heartbeat_interval_s,
             "serve.suspect_after_s": args.suspect_after_s,
             "serve.dead_after_s": args.dead_after_s,
+            "serve.flight_recorder_s": args.flight_recorder_s,
             "serve.autoscale": args.autoscale,
             "serve.autoscale_min": args.autoscale_min,
             "serve.autoscale_max": args.autoscale_max,
@@ -881,7 +892,12 @@ def main(argv=None) -> float:
                 },
             )
         tracer = None
-        if cfg.train.trace_path and jax.process_index() == 0:
+        federated = args.serve and cfg.serve.hosts > 1
+        if cfg.train.trace_path and jax.process_index() == 0 and not federated:
+            # Federated serving builds its own cluster + per-host
+            # tracers inside _run_serve_federated and writes the MERGED
+            # multi-process file at cluster drain — a top-level exit
+            # flush here would overwrite it with controller-only spans.
             # Process-0-only like the sink: other hosts would pay span
             # recording for a buffer nothing ever flushes (one trace
             # file per run, written below by process 0).
@@ -1597,6 +1613,49 @@ def _run_serve_federated(
     # router): the single-fire gate inside the injector keeps an
     # armed `host_kill@3` from killing all N hosts at once.
     chaos = {h: fi for h in host_ids} if fi is not None else None
+    # Cluster-scoped distributed tracing + flight recorder
+    # (obs/dtrace.py, docs/observability.md "Distributed tracing"):
+    # the sampling decision lives in the CLUSTER tracer; per-host
+    # tracers only adopt it from the wire trace_ctx. --trace_path gets
+    # the MERGED multi-process file (controller + every host's spans
+    # rebased by the heartbeat clock offsets), written at drain.
+    cluster_tracer = None
+    tracer_factory = None
+    recorders = None
+    if sc.flight_recorder_s > 0:
+        from gnot_tpu.obs import dtrace
+
+        flight_dir = (
+            os.path.dirname(cfg.train.trace_path)
+            or os.path.dirname(cfg.train.metrics_path)
+            or "."
+        )
+        recorders = {
+            h: dtrace.FlightRecorder(
+                flight_dir, window_s=sc.flight_recorder_s, host=h
+            )
+            for h in ["controller", *host_ids]
+        }
+        # The controller's ring is the cluster black box: host_dead
+        # fires HERE (a dead host cannot dump its own box), and the
+        # lockguard hook is process-global so one registrant suffices.
+        recorders["controller"].watch_lockguard()
+    if cfg.train.trace_path or recorders is not None:
+        from gnot_tpu.obs.tracing import Tracer
+
+        # Without --trace_path nothing exports — rate 0 keeps the
+        # export buffers empty, and the rings still fill with
+        # "!"-prefixed shadow spans (recorder-only black box).
+        rate = cfg.train.trace_sample_rate if cfg.train.trace_path else 0.0
+
+        def _tracer_for(host_id):
+            return Tracer(
+                sample_rate=rate,
+                recorder=(recorders or {}).get(host_id),
+            )
+
+        cluster_tracer = _tracer_for("controller")
+        tracer_factory = _tracer_for
     cluster, agents = build_local_federation(
         groups,
         sink=sink,
@@ -1609,6 +1668,10 @@ def _run_serve_federated(
         series_path=series_path,
         metrics_factory=metrics_factory,
         tcp_base_port=sc.federation_port,
+        tracer_factory=tracer_factory,
+        cluster_tracer=cluster_tracer,
+        trace_path=cfg.train.trace_path or None,
+        recorders=recorders,
         router_kwargs=dict(
             max_batch=sc.max_batch,
             max_wait_ms=sc.max_wait_ms,
@@ -1673,6 +1736,21 @@ def _run_serve_federated(
         f"hosts_dead={summary['hosts_dead']}, "
         f"protocol_errors={summary['protocol_errors']}"
     )
+    if cfg.train.trace_path and cluster.merged_trace is not None:
+        print(
+            f"Wrote merged cluster trace "
+            f"({len(cluster.merged_trace['traceEvents'])} spans, "
+            f"{len(cluster.merged_trace['otherData']['hosts'])} sources) "
+            f"to {cfg.train.trace_path} (open in https://ui.perfetto.dev; "
+            "summarize with tools/trace_report.py)"
+        )
+    if recorders is not None:
+        dumps = [p for r in recorders.values() for p in r.dumps]
+        if dumps:
+            print(
+                f"Flight recorder dumped {len(dumps)} ring(s): "
+                + ", ".join(dumps)
+            )
     if manifest_extra is not None:
         manifest_extra["federation"] = {
             k: v for k, v in summary.items() if k != "per_host"

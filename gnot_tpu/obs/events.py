@@ -222,6 +222,7 @@ EVENTS: dict[str, EventSpec] = {
         optional=(
             "queue_device_by_bucket", "pad_waste_by_bucket", "replica",
             "per_replica", "routing", "dtype", "sessions", "tenants",
+            "trace",
         ),
     ),
     "route": EventSpec(
@@ -414,8 +415,15 @@ EVENTS: dict[str, EventSpec] = {
         "| 'suspect' | 'dead' — the lease view AFTER this round's "
         "ack/silence was folded in; `load` and `pool` carry the "
         "host's reported in-system load and replica count when the "
-        "ack arrived, `rtt_ms` the heartbeat round-trip",
-        optional=("load", "pool", "rtt_ms", "edge"),
+        "ack arrived, `rtt_ms` the heartbeat round-trip; "
+        "`clock_offset_s` ± `clock_err_s` is the midpoint-method "
+        "monotonic-clock alignment estimate obs/dtrace.py derives "
+        "from the stamped heartbeat exchanges (the cross-host span "
+        "rebase the merged trace uses)",
+        optional=(
+            "load", "pool", "rtt_ms", "edge", "clock_offset_s",
+            "clock_err_s",
+        ),
     ),
     "host_dead": EventSpec(
         fields=("host", "silent_s", "sessions"),
@@ -452,8 +460,11 @@ EVENTS: dict[str, EventSpec] = {
         "request/session accounting, the per-host breakdown "
         "(`per_host`), and the failure-detector ledger — the "
         "cross-check target for tools/metrics_report.py's per-host "
-        "slicing",
-        optional=("per_host", "lost", "protocol_errors"),
+        "slicing; with cluster tracing on, `trace_coverage` carries "
+        "per-host sampled/total counters, dropped-span counts and the "
+        "clock-offset ± uncertainty each host's spans were rebased by",
+        optional=("per_host", "lost", "protocol_errors",
+                  "trace_coverage"),
     ),
     "capacity_snapshot": EventSpec(
         fields=("programs", "pool"),
@@ -470,6 +481,9 @@ EVENTS: dict[str, EventSpec] = {
 
 # A constant and a dict key drifting apart would defeat the registry;
 # cheap to assert once at import (stdlib only, no jax in the loop).
+# (Span kinds deliberately have NO module constants — span sites pass
+# the name to the tracer as a literal, which is what GL005 resolves —
+# so the constant sweep below sees only event kinds.)
 _CONSTANT_KINDS = {
     v for k, v in vars().items() if k.isupper() and isinstance(v, str)
 }
@@ -477,6 +491,138 @@ assert _CONSTANT_KINDS == set(EVENTS), (
     "obs/events.py constants and EVENTS keys drifted: "
     f"{sorted(_CONSTANT_KINDS ^ set(EVENTS))}"
 )
+
+
+@dataclasses.dataclass(frozen=True)
+class SpanSpec:
+    """One tracer span kind: the module that records it and the
+    one-line description docs/observability.md renders. The span
+    analogue of :class:`EventSpec` — GL005 resolves every literal
+    span name at a ``Tracer`` call site against this dict and checks
+    the docs row, so span names cannot drift the way event kinds
+    already cannot."""
+
+    module: str
+    doc: str
+
+
+#: span kind -> spec. Keys are string literals ON PURPOSE (GL005
+#: AST-parses this dict without importing). The serve/train taxonomy
+#: tuples in ``obs/tracing.py`` (SERVE_SPANS & co) stay the ordering
+#: contract; this registry is the DRIFT GUARD — ``tests/test_obs.py``
+#: pins the two views equal.
+SPANS: dict[str, SpanSpec] = {
+    "admission": SpanSpec(
+        module="gnot_tpu/serve/server.py",
+        doc="admission decision at submit (`reason` = admitted or the "
+        "shed/reject verdict); the root of every serve request chain",
+    ),
+    "queue_wait": SpanSpec(
+        module="gnot_tpu/serve/server.py",
+        doc="admission close to dispatch pop — time spent queued "
+        "(terminal rejects record it with the reject `reason`)",
+    ),
+    "batch_assembly": SpanSpec(
+        module="gnot_tpu/serve/server.py",
+        doc="pad/pack of the dispatch's batch, once per traced member",
+    ),
+    "dispatch": SpanSpec(
+        module="gnot_tpu/serve/server.py",
+        doc="the whole engine dispatch window (queue pop to result "
+        "publishable); `member_trace_ids` links co-dispatched riders",
+    ),
+    "device": SpanSpec(
+        module="gnot_tpu/serve/server.py",
+        doc="device execution inside the dispatch (engine phase stamp)",
+    ),
+    "unpad": SpanSpec(
+        module="gnot_tpu/serve/server.py",
+        doc="host-side unpad/scatter of the batch outputs",
+    ),
+    "resolve": SpanSpec(
+        module="gnot_tpu/serve/server.py",
+        doc="result resolution (`reason`, `latency_ms`) — the chain's "
+        "terminal span",
+    ),
+    "compile": SpanSpec(
+        module="gnot_tpu/serve/server.py",
+        doc="fresh-signature jit dispatch paid its XLA compile inside "
+        "the device window (AOT / warm-jit dispatches never emit it)",
+    ),
+    "reload": SpanSpec(
+        module="gnot_tpu/serve/server.py",
+        doc="hot weight reload lifecycle (aux stream `r` — never "
+        "consumes a request sampling slot)",
+    ),
+    "replica_warm": SpanSpec(
+        module="gnot_tpu/serve/router.py",
+        doc="one replica's warm-to-serve-ready window (snapshot "
+        "hydration or cold compile; aux stream `r`)",
+    ),
+    "epoch": SpanSpec(
+        module="gnot_tpu/train/trainer.py",
+        doc="one training epoch — the root of each train trace",
+    ),
+    "data_iter": SpanSpec(
+        module="gnot_tpu/train/trainer.py",
+        doc="one batch pull from the input pipeline (prefetch wait)",
+    ),
+    "step": SpanSpec(
+        module="gnot_tpu/train/trainer.py",
+        doc="one optimizer step (host view)",
+    ),
+    "host_to_device": SpanSpec(
+        module="gnot_tpu/train/trainer.py",
+        doc="device_put of the step's batch",
+    ),
+    "step_dispatch": SpanSpec(
+        module="gnot_tpu/train/trainer.py",
+        doc="the jitted step dispatch inside `step`",
+    ),
+    "telemetry_drain": SpanSpec(
+        module="gnot_tpu/train/trainer.py",
+        doc="end-of-epoch telemetry queue drain",
+    ),
+    "eval": SpanSpec(
+        module="gnot_tpu/train/trainer.py",
+        doc="held-out evaluation pass",
+    ),
+    "checkpoint_save": SpanSpec(
+        module="gnot_tpu/train/trainer.py",
+        doc="checkpoint write (atomic tmp+rename)",
+    ),
+    "placement": SpanSpec(
+        module="gnot_tpu/serve/federation.py",
+        doc="one controller→host placement frame of a cluster request "
+        "or session (`host`, `kind` = place | hedge | redeliver | "
+        "remigrate | reconcile | restart; non-place kinds carry "
+        "`link_to` = the first placement's span id — hedged "
+        "duplicates, age-based re-deliveries and re-migrations appear "
+        "as LINKED spans of the same trace, never a second chain)",
+    ),
+    "cluster_request": SpanSpec(
+        module="gnot_tpu/serve/federation.py",
+        doc="one one-shot's whole cluster lifecycle, submit to "
+        "resolution (`reason`; recorded at resolve on the controller)",
+    ),
+    "cluster_rollout": SpanSpec(
+        module="gnot_tpu/serve/federation.py",
+        doc="one rollout session's whole cluster lifecycle, placement "
+        "to terminal resolution (`reason`, `migrations`)",
+    ),
+}
+
+
+def spans_markdown_table() -> str:
+    """The docs/observability.md span table, generated from ``SPANS``
+    the same way :func:`markdown_table` renders ``EVENTS``."""
+    lines = [
+        "| span | recorded by | meaning |",
+        "|---|---|---|",
+    ]
+    for kind, spec in SPANS.items():
+        lines.append(f"| `{kind}` | `{spec.module}` | {spec.doc} |")
+    return "\n".join(lines)
 
 
 def validate_record(record: dict) -> list[str]:

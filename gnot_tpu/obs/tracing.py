@@ -127,6 +127,13 @@ class Tracer:
     never blocks); ``annotate=True`` mirrors spans onto the jax
     profiler timeline (lazy import — only pay for it under
     ``--profile_dir``).
+
+    ``recorder`` attaches an ``obs/dtrace.FlightRecorder``: every
+    closed span is ALSO copied into its bounded ring, and sampled-OUT
+    traces stop being invisible — :meth:`start_trace` hands them a
+    shadow id (``"!"``-prefixed) whose spans go ONLY to the recorder,
+    never the export buffer, so the trailing window is complete at any
+    sample rate while the exported file keeps its sampling contract.
     """
 
     def __init__(
@@ -137,6 +144,7 @@ class Tracer:
         max_spans: int = 100_000,
         clock: Callable[[], float] = time.monotonic,
         annotate: bool = False,
+        recorder=None,
     ):
         if not 0.0 <= sample_rate <= 1.0:
             raise ValueError(
@@ -149,6 +157,7 @@ class Tracer:
         self.max_spans = max_spans
         self._clock = clock
         self._annotate = annotate
+        self._recorder = recorder
         self._t0 = clock()
         self._lock = threading.Lock()
         self._spans: list[Span] = []  #: guarded_by _lock
@@ -159,6 +168,12 @@ class Tracer:
         # keeps.
         self._stream_seen: dict[str, int] = {}  #: guarded_by _lock
         self._stream_kept: dict[str, int] = {}  #: guarded_by _lock
+        # Adoption ledger (cluster propagation): unique trace ids this
+        # tracer ADOPTED rather than decided, and how many of those
+        # were sampled — per-host coverage honesty when the sampling
+        # authority lives at the ClusterRouter. guarded_by _lock
+        self._adopted_ids: set[str] = set()
+        self._adopted_kept = 0
         self._next_span = 0  #: guarded_by _lock
         self._current: contextvars.ContextVar[Span | None] = (
             contextvars.ContextVar("gnot_trace_span", default=None)
@@ -176,7 +191,13 @@ class Tracer:
         each stream counts (and floor-samples) independently, so e.g.
         serve reloads (stream ``"r"``) never consume a request keep
         slot — the documented request contract (rate 0.25 keeps
-        requests 4, 8, 12, …) holds regardless of aux traffic."""
+        requests 4, 8, 12, …) holds regardless of aux traffic.
+
+        With a flight recorder attached, a sampled-OUT trace returns a
+        SHADOW id (``"!"``-prefixed, from the seen counter so ids stay
+        unique) instead of None: its spans record only into the
+        recorder's ring — the export buffer, kept counters and the
+        sampling contract are untouched."""
         with self._lock:
             n = self._stream_seen.get(stream, 0) + 1
             self._stream_seen[stream] = n
@@ -184,10 +205,40 @@ class Tracer:
                 (n - 1) * self.sample_rate
             )
             if not keep:
+                if self._recorder is not None:
+                    return f"!{stream}{n:06d}"
                 return None
             kept = self._stream_kept.get(stream, 0) + 1
             self._stream_kept[stream] = kept
             return f"{stream}{kept:06d}"
+
+    def adopt(self, ctx) -> str | None:
+        """The receiving side of trace-context propagation
+        (``obs/dtrace.TraceContext``): return the LOCAL trace id to
+        thread through span sites for a propagated context, honoring
+        the sender's sampling decision — this tracer's own counters
+        are never consulted, so the head decision made once at the
+        cluster holds identically on every host. A sampled context
+        keeps its id verbatim; an unsampled one shadow-records when a
+        flight recorder is attached (shadow prefix preserved across
+        hops) and is a no-op (None) otherwise."""
+        if ctx is None or not ctx.trace_id:
+            return None
+        tid = ctx.trace_id
+        sampled = ctx.sampled and not tid.startswith("!")
+        bare = tid.lstrip("!")
+        with self._lock:
+            # Unique-id ledger: a session's steps adopt the SAME ctx
+            # once per step — one trace, one coverage unit.
+            if bare not in self._adopted_ids:
+                self._adopted_ids.add(bare)
+                if sampled:
+                    self._adopted_kept += 1
+        if sampled:
+            return tid
+        if self._recorder is not None:
+            return tid if tid.startswith("!") else f"!{tid}"
+        return None
 
     def _new_span_id(self) -> str:
         with self._lock:
@@ -290,6 +341,12 @@ class Tracer:
             yield item
 
     def _store(self, s: Span) -> None:
+        if self._recorder is not None:
+            # The black box sees EVERYTHING — sampled spans on their
+            # way to the buffer and shadow spans of sampled-out traces.
+            self._recorder.record_span(s)
+        if s.trace_id.startswith("!"):
+            return  # shadow: ring-only, never the export buffer
         with self._lock:
             if len(self._spans) < self.max_spans:
                 self._spans.append(s)
@@ -306,6 +363,23 @@ class Tracer:
     def dropped(self) -> int:
         with self._lock:
             return self._dropped
+
+    def coverage(self) -> dict:
+        """Honest sampling/coverage counters for summaries: traces
+        seen vs kept (all streams), spans dropped to the buffer bound,
+        and the configured rate — the numbers that stop a trace file
+        from LOOKING complete when it is not (serve_summary /
+        cluster_summary surface these)."""
+        with self._lock:
+            return {
+                "seen": sum(self._stream_seen.values())
+                + len(self._adopted_ids),
+                "kept": sum(self._stream_kept.values())
+                + self._adopted_kept,
+                "adopted": len(self._adopted_ids),
+                "dropped": self._dropped,
+                "sample_rate": self.sample_rate,
+            }
 
     def export(self) -> dict:
         """The buffered spans as a Chrome trace-event JSON object
@@ -353,6 +427,11 @@ class Tracer:
                 "traces_seen": seen,
                 "traces_kept": kept,
                 "spans_dropped": dropped,
+                # The rebase origin in this tracer's raw clock — what
+                # obs/dtrace.merge_traces needs to map the rebased
+                # timestamps back into an absolute clock frame before
+                # applying a cross-host offset.
+                "clock_t0_s": t0,
             },
         }
 

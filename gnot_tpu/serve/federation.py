@@ -67,7 +67,7 @@ from typing import Callable
 import numpy as np
 
 from gnot_tpu.data.batch import MeshSample
-from gnot_tpu.obs import events
+from gnot_tpu.obs import dtrace, events
 from gnot_tpu.serve.rollout import RolloutResult
 from gnot_tpu.serve.server import ServeResult
 
@@ -192,6 +192,7 @@ DRAIN = "drain"
 STATS = "stats"
 PREWARM = "prewarm"
 SCALE = "scale"
+TRACE_PULL = "trace_pull"
 # Agent→controller kinds.
 HELLO_OK = "hello_ok"
 HELLO_REJECT = "hello_reject"
@@ -204,6 +205,7 @@ DRAIN_OK = "drain_ok"
 STATS_OK = "stats_ok"
 PREWARM_OK = "prewarm_ok"
 SCALE_OK = "scale_ok"
+TRACE_OK = "trace_ok"
 ERROR = "error"
 
 #: The wire-schema registry. Same contract as ``obs/events.py::EVENTS``:
@@ -231,19 +233,27 @@ MESSAGES: dict[str, MessageSpec] = {
     ),
     "heartbeat": MessageSpec(
         fields=("seq",),
-        doc="Controller lease probe, monotonically sequenced per host.",
+        doc="Controller lease probe, monotonically sequenced per host; "
+        "`t` stamps the controller's send clock for the obs/dtrace.py "
+        "clock-alignment exchange.",
+        optional=("t",),
     ),
     "heartbeat_ack": MessageSpec(
         fields=("seq", "host", "load"),
         doc="Agent lease renewal: echoes seq, reports queue load; "
-        "feeds the FailureDetector and cluster autoscaling.",
-        optional=("pool", "sessions", "depth"),
+        "feeds the FailureDetector and cluster autoscaling. `t` echoes "
+        "the probe's controller send stamp and `agent_t` adds the "
+        "agent's own clock — one midpoint-method clock-offset sample "
+        "per round (docs/observability.md 'Distributed tracing').",
+        optional=("pool", "sessions", "depth", "t", "agent_t"),
     ),
     "submit": MessageSpec(
         fields=("id", "sample"),
         doc="Place one one-shot request (base64 array codec) on the "
-        "agent's local router.",
-        optional=("deadline_ms", "tenant"),
+        "agent's local router. `trace_ctx` propagates the cluster's "
+        "head-sampling decision (trace id, parent span, sampled flag, "
+        "tenant) — the host NEVER re-decides sampling.",
+        optional=("deadline_ms", "tenant", "trace_ctx"),
     ),
     "result": MessageSpec(
         fields=("id", "ok"),
@@ -254,7 +264,10 @@ MESSAGES: dict[str, MessageSpec] = {
     "submit_rollout": MessageSpec(
         fields=("id", "steps"),
         doc="Place (resume=false) or re-migrate (resume=true, from the "
-        "persisted SessionStore snapshot) a rollout session.",
+        "persisted SessionStore snapshot) a rollout session. "
+        "`trace_ctx` carries the session's ORIGINAL trace context on "
+        "every placement — a re-migrated session's resumed steps join "
+        "the trace its first step started.",
         optional=(
             "sample",
             "name",
@@ -262,6 +275,7 @@ MESSAGES: dict[str, MessageSpec] = {
             "deadline_ms",
             "rollout_deadline_ms",
             "tenant",
+            "trace_ctx",
         ),
     ),
     "placed": MessageSpec(
@@ -326,6 +340,20 @@ MESSAGES: dict[str, MessageSpec] = {
         fields=("host", "ok", "pool"),
         doc="Scale order outcome with the host's new pool size.",
         optional=("detail",),
+    ),
+    "trace_pull": MessageSpec(
+        fields=("seq",),
+        doc="Collect the agent's span buffer for cross-host stitching "
+        "(sent by ClusterRouter.drain before the merged trace file is "
+        "written).",
+    ),
+    "trace_ok": MessageSpec(
+        fields=("seq", "host", "trace"),
+        doc="Trace-pull reply: the host tracer's Chrome export object "
+        "(empty when the host runs untraced) plus its sampled/total/"
+        "dropped `coverage` counters — obs/dtrace.merge_traces rebases "
+        "and stitches these into one file.",
+        optional=("coverage",),
     ),
     "error": MessageSpec(
         fields=("reason",),
@@ -737,6 +765,8 @@ class HostAgent:
         scale_cb: Callable[[str], int] | None = None,
         version: int = PROTOCOL_VERSION,
         topology: str | None = None,
+        tracer=None,
+        clock: Callable[[], float] = time.monotonic,
     ) -> None:
         self.host_id = host_id
         self.router = router
@@ -747,6 +777,12 @@ class HostAgent:
         self.scale_cb = scale_cb
         self.version = version
         self.topology = topology
+        # This host's span tracer (usually the SAME object its local
+        # router/servers record into): trace_pull exports it, and
+        # inbound trace_ctx fields are adopted against it — the host
+        # honors the cluster's sampling decision, never its own.
+        self.tracer = tracer
+        self._clock = clock
         self.alive = True
         self.errors = 0  # inbound messages refused with ERROR
         self._n_in = 0  #: guarded_by _lock
@@ -841,6 +877,22 @@ class HostAgent:
                 )
             elif kind == SCALE:
                 self._on_scale(msg, reply)
+            elif kind == TRACE_PULL:
+                if self.tracer is not None:
+                    export = self.tracer.export()
+                    coverage = self.tracer.coverage()
+                else:
+                    export = {"traceEvents": [], "otherData": {}}
+                    coverage = {}
+                reply(
+                    wire(
+                        TRACE_OK,
+                        seq=msg["seq"],
+                        host=self.host_id,
+                        trace=export,
+                        coverage=coverage,
+                    )
+                )
             else:
                 # Agent→controller kinds arriving here are a peer bug.
                 self.errors += 1
@@ -892,15 +944,20 @@ class HostAgent:
     def _on_heartbeat(self, msg: dict, reply) -> None:
         with self._lock:
             self._hb_seq_seen = max(self._hb_seq_seen, int(msg["seq"]))
-        reply(
-            wire(
-                HEARTBEAT_ACK,
-                seq=int(msg["seq"]),
-                host=self.host_id,
-                load=self._load(),
-                pool=len(self.router.pool()),
-            )
+        out = wire(
+            HEARTBEAT_ACK,
+            seq=int(msg["seq"]),
+            host=self.host_id,
+            load=self._load(),
+            pool=len(self.router.pool()),
         )
+        # Clock-alignment exchange (obs/dtrace.ClockSync): echo the
+        # controller's send stamp, add our own clock. The agent does
+        # NO arithmetic — the controller owns the midpoint estimate.
+        if "t" in msg:
+            out["t"] = msg["t"]
+            out["agent_t"] = self._clock()
+        reply(out)
 
     def _load(self) -> float:
         """The placement signal: live queue depth across the pool."""
@@ -931,6 +988,7 @@ class HostAgent:
             sample,
             deadline_ms=msg.get("deadline_ms"),
             tenant=msg.get("tenant"),
+            trace_ctx=dtrace.TraceContext.from_wire(msg.get("trace_ctx")),
         )
 
         def _done(f: Future) -> None:
@@ -1009,6 +1067,9 @@ class HostAgent:
                 deadline_ms=msg.get("deadline_ms"),
                 rollout_deadline_ms=msg.get("rollout_deadline_ms"),
                 on_step=_on_step,
+                trace_ctx=dtrace.TraceContext.from_wire(
+                    msg.get("trace_ctx")
+                ),
             )
         else:
             fut = self.router.submit_rollout(
@@ -1019,6 +1080,9 @@ class HostAgent:
                 on_step=_on_step,
                 name=name,
                 tenant=msg.get("tenant"),
+                trace_ctx=dtrace.TraceContext.from_wire(
+                    msg.get("trace_ctx")
+                ),
             )
         reply(wire(PLACED, id=rid, host=self.host_id, at_step=at_step))
 
@@ -1154,6 +1218,9 @@ class _Pending:
     future: Future
     hosts: set[str] = field(default_factory=set)
     last_sent: float = 0.0  # clock of the last placement frame
+    trace: str | None = None  # cluster trace id ("!"-prefixed = shadow)
+    root_span: str | None = None  # first placement's span id (link anchor)
+    t0: float = 0.0  # submit clock (the cluster_request span's start)
 
 
 @dataclass
@@ -1180,6 +1247,9 @@ class _ClusterSession:
     last_sent: float = 0.0  # clock of the last placement frame
     acked: bool = False  # PLACED seen for the CURRENT placement
     last_resume: bool = False  # how the current placement was sent
+    trace: str | None = None  # cluster trace id ("!"-prefixed = shadow)
+    root_span: str | None = None  # first placement's span id (link anchor)
+    t0: float = 0.0  # submit clock (the cluster_rollout span's start)
 
 
 @dataclass
@@ -1191,6 +1261,7 @@ class _HostState:
     hb_seq: int = 0
     last_series: dict = field(default_factory=dict)
     placed: int = 0  # placements routed here (hedges included)
+    rtt_ms: float | None = None  # last heartbeat round-trip
 
 
 class ClusterRouter:
@@ -1216,12 +1287,25 @@ class ClusterRouter:
         manifests: dict[str, dict] | None = None,
         series_path: str | None = None,
         failover: bool = True,
+        tracer=None,
+        trace_path: str | None = None,
     ) -> None:
         self.sink = sink
         self.failover = failover  # False: the A/B twin — a dead host's
         # work resolves lost instead of re-placing (tools/federation_ab.py
         # measures what failover is worth against this baseline)
         self._clock = clock
+        # Cluster-scoped tracing (obs/dtrace.py): the controller's
+        # tracer owns the ONE head-sampling decision per request and
+        # records the placement/hedge/redeliver/remigrate span chain;
+        # trace_path is where drain() writes the stitched multi-host
+        # file. clocks accumulates per-host offset estimates from the
+        # stamped heartbeat exchanges whether or not tracing is on —
+        # host_heartbeat reports them either way.
+        self._tracer = tracer
+        self._trace_path = trace_path
+        self.clocks = dtrace.ClockSync()
+        self.merged_trace: dict | None = None  # drain()'s stitched trace
         self.detector = FailureDetector(
             suspect_after_s=suspect_after_s,
             dead_after_s=dead_after_s,
@@ -1367,6 +1451,15 @@ class ClusterRouter:
             deadline_ms=deadline_ms,
             tenant=tenant,
             future=fut,
+            # Head sampling decided HERE, once — every host this
+            # request touches (placement, hedge, re-delivery) honors
+            # this id via the propagated trace_ctx.
+            trace=(
+                self._tracer.start_trace()
+                if self._tracer is not None
+                else None
+            ),
+            t0=self._clock(),
         )
         with self._lock:
             self.counts["requests"] += 1
@@ -1381,7 +1474,44 @@ class ClusterRouter:
             )
         return fut
 
-    def _place_oneshot(self, pend: _Pending) -> bool:
+    def _record_placement(
+        self, trace: str | None, root_span: str | None, *,
+        host: str, kind: str, **extra,
+    ) -> str | None:
+        """One controller-side ``placement`` span (instant — the frame
+        send). The FIRST placement's span id becomes the link anchor:
+        every later placement of the same request (hedge, re-delivery,
+        re-migration, reconcile, restart) carries ``link_to`` pointing
+        at it — linked spans of ONE trace, never a duplicate chain."""
+        if self._tracer is None or trace is None:
+            return None
+        now = self._clock()
+        args = {"host": host, "kind": kind, **extra}
+        if root_span is not None:
+            args["link_to"] = root_span
+        return self._tracer.add_span(
+            "placement", now, now, trace=trace,
+            parent_id=root_span, args=args,
+        )
+
+    def _wire_ctx(
+        self, trace: str | None, span_id: str | None, tenant: str | None
+    ) -> dict | None:
+        """The ``trace_ctx`` wire field for one placement, or None
+        when cluster tracing is off for this request (an unsampled
+        request with no flight recorder propagates nothing — the host
+        must not start its own trace for it, and with no tracer at the
+        controller there is no decision to honor)."""
+        if trace is None:
+            return None
+        return dtrace.TraceContext(
+            trace_id=trace,
+            span_id=span_id,
+            sampled=not trace.startswith("!"),
+            tenant=tenant,
+        ).to_wire()
+
+    def _place_oneshot(self, pend: _Pending, kind: str = "place") -> bool:
         host = self._pick_host(exclude=pend.hosts)
         if host is None:
             return False
@@ -1394,7 +1524,15 @@ class ClusterRouter:
             msg["deadline_ms"] = pend.deadline_ms
         if pend.tenant is not None:
             msg["tenant"] = pend.tenant
+        sid = self._record_placement(
+            pend.trace, pend.root_span, host=host.host_id, kind=kind
+        )
+        ctx = self._wire_ctx(pend.trace, sid or pend.root_span, pend.tenant)
+        if ctx is not None:
+            msg["trace_ctx"] = ctx
         with self._lock:
+            if pend.root_span is None:
+                pend.root_span = sid
             pend.hosts.add(host.host_id)
             pend.last_sent = self._clock()
             host.placed += 1
@@ -1431,6 +1569,13 @@ class ClusterRouter:
             rollout_deadline_ms=rollout_deadline_ms,
             tenant=tenant,
             sample=sample,
+            # One trace id for the session's WHOLE cluster lifetime:
+            # the first placement, every re-migration after a host
+            # death, even a restart-from-zero all append to this id —
+            # the resumed steps join the original trace.
+            trace=(self._tracer.start_trace("r")
+                   if self._tracer is not None else None),
+            t0=self._clock(),
         )
         host = self._pick_host()
         with self._lock:
@@ -1452,6 +1597,7 @@ class ClusterRouter:
         *,
         sample: MeshSample | None,
         resume: bool,
+        kind: str = "place",
     ) -> None:
         msg = wire(
             SUBMIT_ROLLOUT,
@@ -1468,7 +1614,15 @@ class ClusterRouter:
             msg["rollout_deadline_ms"] = sess.rollout_deadline_ms
         if sess.tenant is not None:
             msg["tenant"] = sess.tenant
+        sid = self._record_placement(
+            sess.trace, sess.root_span, host=host.host_id, kind=kind
+        )
+        ctx = self._wire_ctx(sess.trace, sid or sess.root_span, sess.tenant)
+        if ctx is not None:
+            msg["trace_ctx"] = ctx
         with self._lock:
+            if sess.root_span is None:
+                sess.root_span = sid
             sess.owner = host.host_id
             sess.last_sent = self._clock()
             sess.acked = False  # each placement needs a fresh PLACED
@@ -1490,11 +1644,26 @@ class ClusterRouter:
         kind = msg["kind"]
         if kind == HEARTBEAT_ACK:
             was = self.detector.ack(host_id)
+            now = self._clock()
+            if "t" in msg and "agent_t" in msg:
+                # Midpoint clock alignment: the probe's send stamp was
+                # echoed back, the agent stamped its own clock while
+                # handling it. One sample per round trip; offset() uses
+                # the min-RTT sample in the window, so a congested ack
+                # widens the error bound instead of skewing the offset.
+                self.clocks.observe(
+                    host_id, float(msg["t"]), now, float(msg["agent_t"])
+                )
+            # Read outside _lock: ClusterRouter._lock must never be
+            # held across another acquire (ClockSync has its own lock).
+            rtt = self.clocks.rtt_ms(host_id)
             with self._lock:
                 h = self._hosts.get(host_id)
                 if h is not None:
                     h.load = float(msg["load"])
                     h.pool = int(msg.get("pool", h.pool))
+                    if rtt is not None:
+                        h.rtt_ms = rtt
             if was != ALIVE:
                 # Revival (partition healed / slow host caught up):
                 # frames were lost BOTH ways while the link was down —
@@ -1527,6 +1696,15 @@ class ClusterRouter:
                 h = self._hosts.get(host_id)
                 if h is not None:
                     h.last_series = dict(msg["series"])
+        elif kind == TRACE_OK:
+            # Stashed next to the series snapshots: drain()'s waiter
+            # polls for "_trace" exactly as it polls "_drain_summary".
+            with self._lock:
+                h = self._hosts.get(host_id)
+                if h is not None:
+                    h.last_series["_trace"] = msg["trace"]
+                    if "coverage" in msg:
+                        h.last_series["_trace_coverage"] = msg["coverage"]
         elif kind in (DRAIN_OK, PREWARM_OK, SCALE_OK, ERROR, HELLO_OK,
                       HELLO_REJECT):
             # DRAIN_OK is consumed by drain()'s waiter; the others are
@@ -1591,7 +1769,8 @@ class ClusterRouter:
             host = self._pick_host()
             if host is not None:
                 self._send_rollout(
-                    sess, host, sample=sess.sample, resume=False
+                    sess, host, sample=sess.sample, resume=False,
+                    kind="restart",
                 )
                 return
         self._resolve_session(
@@ -1613,6 +1792,16 @@ class ClusterRouter:
                 self.counts["suppressed"] += 1
                 return
             self.counts["completed" if res.ok else "shed"] += 1
+        if self._tracer is not None and pend.trace is not None:
+            self._tracer.add_span(
+                "cluster_request", pend.t0, self._clock(),
+                trace=pend.trace, parent_id=None,
+                args={
+                    "ok": res.ok, "reason": res.reason or "ok",
+                    "placements": len(pend.hosts),
+                    "hosts": sorted(pend.hosts),
+                },
+            )
         pend.future.set_result(res)
 
     def _resolve_session(
@@ -1647,6 +1836,18 @@ class ClusterRouter:
                 if step not in sess.outputs and enc is not None:
                     sess.outputs[step] = _dec_arr(enc)
             outputs = [sess.outputs[k] for k in sorted(sess.outputs)]
+        if self._tracer is not None and sess.trace is not None:
+            self._tracer.add_span(
+                "cluster_rollout", sess.t0, self._clock(),
+                trace=sess.trace, parent_id=None,
+                args={
+                    "ok": ok, "reason": str(reason or ("ok" if ok else "error")),
+                    "session": sess.name,
+                    "steps_completed": steps_completed or sess.streamed,
+                    "migrations": sess.migrations + local_migrations,
+                    "restarts": sess.restarts,
+                },
+            )
         sess.future.set_result(
             RolloutResult(
                 ok=ok,
@@ -1681,7 +1882,9 @@ class ClusterRouter:
             # probe starts the suspicion dwell from here, not from
             # whenever the controller last had time to tick.
             self.detector.probe(h.host_id)
-            h.link.send(wire(HEARTBEAT, seq=seq))
+            # The send stamp rides the probe; its echo in the ack is
+            # one clock-alignment sample (see _on_message).
+            h.link.send(wire(HEARTBEAT, seq=seq, t=self._clock()))
         edges = self.detector.sweep()
         for host_id, old, new in edges:
             if new == SUSPECT:
@@ -1690,6 +1893,7 @@ class ClusterRouter:
                 self._on_host_dead(host_id)
         self._redrive_stale()
         for h in hosts:
+            off = self.clocks.offset(h.host_id)
             self._event(
                 events.HOST_HEARTBEAT,
                 host=h.host_id,
@@ -1700,6 +1904,14 @@ class ClusterRouter:
                 edge=next(
                     (f"{o}->{n}" for hid, o, n in edges if hid == h.host_id),
                     None,
+                ),
+                **(
+                    {
+                        "clock_offset_s": round(off[0], 6),
+                        "clock_err_s": round(off[1], 6),
+                    }
+                    if off is not None
+                    else {}
                 ),
             )
         self._publish_series(hosts)
@@ -1749,6 +1961,12 @@ class ClusterRouter:
                     msg["deadline_ms"] = p.deadline_ms
                 if p.tenant is not None:
                     msg["tenant"] = p.tenant
+                sid = self._record_placement(
+                    p.trace, p.root_span, host=host_id, kind="redeliver"
+                )
+                ctx = self._wire_ctx(p.trace, sid or p.root_span, p.tenant)
+                if ctx is not None:
+                    msg["trace_ctx"] = ctx
                 host.link.send(msg)
         for s in stale_sess:
             if self.detector.state(s.owner) == DEAD:
@@ -1766,6 +1984,7 @@ class ClusterRouter:
                 host,
                 sample=None if s.last_resume else s.sample,
                 resume=s.last_resume,
+                kind="redeliver",
             )
 
     def _reconcile(self, host_id: str) -> None:
@@ -1793,11 +2012,19 @@ class ClusterRouter:
                 msg["deadline_ms"] = p.deadline_ms
             if p.tenant is not None:
                 msg["tenant"] = p.tenant
+            sid = self._record_placement(
+                p.trace, p.root_span, host=host_id, kind="reconcile"
+            )
+            ctx = self._wire_ctx(p.trace, sid or p.root_span, p.tenant)
+            if ctx is not None:
+                msg["trace_ctx"] = ctx
             with self._lock:
                 p.last_sent = self._clock()
             host.link.send(msg)
         for s in sessions:
-            self._send_rollout(s, host, sample=None, resume=True)
+            self._send_rollout(
+                s, host, sample=None, resume=True, kind="reconcile"
+            )
 
     def _hedge_around(self, host_id: str) -> None:
         """SUSPECT reaction: duplicate this host's in-flight one-shots
@@ -1812,7 +2039,10 @@ class ClusterRouter:
                 if host_id in p.hosts and not p.future.done()
             ]
         for pend in pending:
-            self._place_oneshot(pend)
+            # The hedge is a LINKED placement of the SAME trace — the
+            # merged view shows one request fanning out, never a
+            # second request chain (satellite 4's continuity check).
+            self._place_oneshot(pend, kind="hedge")
 
     def _on_host_dead(self, host_id: str) -> None:
         """DEAD reaction: the dwell expired. Re-place every one-shot
@@ -1839,7 +2069,9 @@ class ClusterRouter:
             reason="lease_expired",
         )
         for pend in sole_pending:
-            if not self.failover or not self._place_oneshot(pend):
+            if not self.failover or not self._place_oneshot(
+                pend, kind="redeliver"
+            ):
                 self._resolve_oneshot(
                     pend.rid,
                     ServeResult(
@@ -1864,7 +2096,9 @@ class ClusterRouter:
             with self._lock:
                 sess.migrations += 1
                 self.counts["remigrated"] += 1
-            self._send_rollout(sess, survivor, sample=None, resume=True)
+            self._send_rollout(
+                sess, survivor, sample=None, resume=True, kind="remigrate"
+            )
             self._event(
                 events.SESSION_REMIGRATE,
                 session=sess.name,
@@ -1953,8 +2187,64 @@ class ClusterRouter:
                 rid, ok=False, reason="drained", detail="cluster drained"
             )
         summary = self._summary(per_host)
+        if self._tracer is not None:
+            summary["trace_coverage"] = self._stitch_traces(hosts)
         self._event(events.CLUSTER_SUMMARY, **summary)
         return summary
+
+    def _stitch_traces(self, hosts: list[_HostState]) -> dict:
+        """Drain-time trace assembly: pull every live host's export
+        over ``TRACE_PULL``, rebase remote spans into the controller's
+        clock frame via the heartbeat offset estimates, write ONE
+        merged trace file, and return the per-source coverage stats
+        (sampled/total plus clock offset ± uncertainty) that land in
+        ``cluster_summary.trace_coverage``. Called AFTER the leftover
+        futures resolved, so the controller's terminal
+        ``cluster_request``/``cluster_rollout`` spans are included."""
+        with self._lock:
+            self._stats_seq += 1
+            tseq = self._stats_seq
+        live = [
+            h for h in hosts if self.detector.state(h.host_id) != DEAD
+        ]
+        for h in live:
+            h.link.flush()
+            h.link.send(wire(TRACE_PULL, seq=tseq))
+        tr_deadline = self._clock() + 5.0
+        while self._clock() < tr_deadline:
+            with self._lock:
+                missing = [
+                    h for h in live if "_trace" not in h.last_series
+                ]
+            if not missing:
+                break
+            time.sleep(0.02)
+        exports = {"controller": self._tracer.export()}
+        coverage: dict[str, dict] = {
+            "controller": self._tracer.coverage()
+        }
+        offsets: dict[str, tuple[float, float]] = {}
+        clock_meta = self.clocks.snapshot()
+        with self._lock:
+            for h in hosts:
+                tr = h.last_series.get("_trace")
+                if tr is not None:
+                    exports[h.host_id] = tr
+                cov = h.last_series.get("_trace_coverage")
+                if cov is not None:
+                    coverage[h.host_id] = dict(cov)
+        for host_id, meta in clock_meta.items():
+            offsets[host_id] = (
+                meta["clock_offset_s"], meta["clock_err_s"]
+            )
+            coverage.setdefault(host_id, {}).update(meta)
+        merged = dtrace.merge_traces(
+            exports, offsets=offsets, controller="controller"
+        )
+        if self._trace_path is not None:
+            dtrace.write_trace(self._trace_path, merged)
+        self.merged_trace = merged
+        return coverage
 
     def _summary(self, per_host: dict | None = None) -> dict:
         with self._lock:
@@ -2025,6 +2315,10 @@ def build_local_federation(
     metrics_factory: Callable | None = None,
     tcp_base_port: int = 0,
     failover: bool = True,
+    tracer_factory: Callable[[str], object] | None = None,
+    cluster_tracer=None,
+    trace_path: str | None = None,
+    recorders: dict[str, "dtrace.FlightRecorder"] | None = None,
 ) -> tuple[ClusterRouter, dict[str, "HostAgent"]]:
     """Wire a whole loopback federation in one call: one
     ``ReplicaRouter`` + ``HostAgent`` per replica group, in-proc links
@@ -2038,6 +2332,17 @@ def build_local_federation(
     of in-proc links: ``host<i>`` listens on ``tcp_base_port + i`` and
     the controller connects a ``TcpLink`` to it (chaos hooks are
     in-proc-only — ``link_faults`` is rejected here).
+
+    Cluster tracing (obs/dtrace.py): ``cluster_tracer`` makes the
+    controller the head-sampling authority and records the placement
+    chain; ``tracer_factory(host_id)`` builds each host's local tracer
+    (drained over ``TRACE_PULL`` and stitched into ``trace_path``);
+    ``recorders[host_id]`` wraps that host's sink in a
+    :class:`~gnot_tpu.obs.dtrace.FlightRecorderSink` so anomaly events
+    dump the host's black box. A ``recorders["controller"]`` entry
+    wraps the CONTROLLER's sink the same way — ``host_dead`` (and any
+    other trigger event the controller emits) fires there, since a
+    dead host can no longer dump its own black box.
     """
     from gnot_tpu.serve.router import ReplicaRouter
 
@@ -2046,21 +2351,39 @@ def build_local_federation(
             "link_faults are in-proc chaos hooks; the TCP transport "
             "(tcp_base_port) has none — drop one or the other"
         )
+    ctrl_recorder = (recorders or {}).get("controller")
     cluster = ClusterRouter(
-        sink=sink,
+        sink=(
+            dtrace.FlightRecorderSink(sink, ctrl_recorder)
+            if ctrl_recorder is not None
+            else sink
+        ),
         clock=clock,
         failover=failover,
         suspect_after_s=suspect_after_s,
         dead_after_s=dead_after_s,
         manifests=manifests,
         series_path=series_path,
+        tracer=cluster_tracer,
+        trace_path=trace_path,
     )
     agents: dict[str, HostAgent] = {}
     kwargs = dict(router_kwargs or {})
     for i, replicas in enumerate(replica_groups):
         host_id = f"host{i}"
-        host_sink = _HostSink(sink, host_id) if sink is not None else None
+        host_sink: object = (
+            _HostSink(sink, host_id) if sink is not None else None
+        )
+        recorder = (recorders or {}).get(host_id)
+        if recorder is not None:
+            host_sink = dtrace.FlightRecorderSink(host_sink, recorder)
         metrics = metrics_factory() if metrics_factory is not None else None
+        tracer = (
+            tracer_factory(host_id) if tracer_factory is not None else None
+        )
+        host_kwargs = dict(kwargs)
+        if tracer is not None:
+            host_kwargs["tracer"] = tracer
         router = ReplicaRouter(
             replicas,
             sink=host_sink,
@@ -2068,7 +2391,7 @@ def build_local_federation(
             session_store=session_store,
             persist_snapshots=session_store is not None,
             metrics=metrics,
-            **kwargs,
+            **host_kwargs,
         )
         agent = HostAgent(
             host_id,
@@ -2078,6 +2401,8 @@ def build_local_federation(
             session_store=session_store,
             metrics=metrics,
             topology=topology_key(len(replica_groups), len(replicas)),
+            tracer=tracer,
+            clock=clock,
         )
         if tcp_base_port:
             port = agent.listen(tcp_base_port + i)

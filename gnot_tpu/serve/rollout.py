@@ -230,6 +230,14 @@ class RolloutSession:
         #: (``fn(session, reason, detail, from_replica)``); None on a
         #: standalone server — step failures then resolve the future.
         self.migrate_cb: Callable | None = None
+        #: Propagated cluster trace context (``obs/dtrace.TraceContext``)
+        #: installed by the router on federated placements: every step
+        #: request this session enqueues adopts the SAME cluster-made
+        #: sampling decision, so steps resumed after a migration stay
+        #: spans of the original trace. None = locally-placed session,
+        #: whose steps run untraced (local spans belong to requests the
+        #: local tracer sampled itself).
+        self.trace_ctx = None
         self._lock = threading.Lock()
         self._sample = sample  #: guarded_by _lock
         self._cursor = 0  #: guarded_by _lock

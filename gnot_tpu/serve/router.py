@@ -567,13 +567,17 @@ class ReplicaRouter:
         *,
         deadline_ms: float | None = None,
         tenant: str | None = None,
+        trace_ctx=None,
     ) -> Future:
         """Route one request to a replica and submit it there. The
         returned Future resolves exactly as a single server's would —
         the router adds placement, never a new failure mode. ``tenant``
         tags the request for the isolation plane (quota/WFQ/priority at
         the placed replica); placement itself is tenant-blind — fairness
-        is enforced where queues live, not where routing happens."""
+        is enforced where queues live, not where routing happens.
+        ``trace_ctx`` (an ``obs/dtrace.TraceContext``) carries a
+        cluster-made sampling decision — the placed server adopts it
+        instead of consulting its own sampling counter."""
         key, label = self._bucket_of(sample)
         replica, reason = self._place(key)
         with self._lock:
@@ -593,7 +597,8 @@ class ReplicaRouter:
             dtype=self._dtype,
         )
         return replica.server.submit(
-            sample, deadline_ms=deadline_ms, tenant=tenant
+            sample, deadline_ms=deadline_ms, tenant=tenant,
+            trace_ctx=trace_ctx,
         )
 
     def _note_route(self, reason: str) -> None:
@@ -742,6 +747,7 @@ class ReplicaRouter:
         on_step=None,
         name: str | None = None,
         tenant: str | None = None,
+        trace_ctx=None,
     ) -> RolloutFuture:
         """Place one autoregressive rollout session. The FIRST step
         routes like any request (health gate + affinity/policy — one
@@ -789,6 +795,11 @@ class ReplicaRouter:
         )
         session.named = name is not None
         session.migrate_cb = self._session_failed
+        # The cluster's sampling decision rides the session object:
+        # every step this host runs (including after a local migration)
+        # adopts the same trace id, so resumed steps join the ORIGINAL
+        # trace instead of starting fresh chains.
+        session.trace_ctx = trace_ctx
         self._place_session(session, sample)
         return session.future
 
@@ -799,6 +810,7 @@ class ReplicaRouter:
         deadline_ms: float | None = None,
         rollout_deadline_ms: float | None = None,
         on_step=None,
+        trace_ctx=None,
     ) -> RolloutFuture:
         """Client-visible resume across restarts: load the named
         session's persisted final carry snapshot (written by the
@@ -843,6 +855,10 @@ class ReplicaRouter:
         with self._lock:
             self._sessions_started += 1
         session.migrate_cb = self._session_failed
+        # A cross-host re-migration arrives here: the propagated ctx
+        # re-attaches the resumed steps to the session's original
+        # cluster trace (ISSUE 20's continuity requirement).
+        session.trace_ctx = trace_ctx
         self._place_session(session, session.sample)
         return session.future
 
@@ -1198,6 +1214,11 @@ class ReplicaRouter:
                 }
                 for t, agg in sorted(tenants_roll.items())
             }
+        if self._tracer is not None:
+            # Pool trace coverage: the replicas share ONE tracer, so
+            # its counters already ARE the pool view (ISSUE 20 — same
+            # honesty denominator as the per-replica summaries).
+            summary["trace"] = self._tracer.coverage()
         if sessions_started:
             summary["sessions"] = {
                 "started": sessions_started,

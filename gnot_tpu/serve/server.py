@@ -471,6 +471,7 @@ class InferenceServer:
         *,
         deadline_ms: float | None = None,
         tenant: str | None = None,
+        trace_ctx=None,
     ) -> Future:
         """Admit one request. Fast-fails (resolved Future, degraded
         reason) on: draining, full queue (load shedding at the door),
@@ -481,15 +482,28 @@ class InferenceServer:
         its index named instead of NaN-ing a whole batch of innocent
         neighbors). ``tenant`` names the submitter (None = untagged;
         with no TenantPolicy configured the tag is carried for
-        per-tenant accounting only)."""
+        per-tenant accounting only). ``trace_ctx`` (an
+        ``obs/dtrace.TraceContext``) is a sampling decision already
+        made upstream — the cluster controller's — which this server
+        ADOPTS instead of consulting its own counter, so one federated
+        request is sampled identically on every host it touches."""
         fut: Future = Future()
         now = self._clock()
         # trace_id assignment happens AT SUBMIT (head sampling decides
-        # once, here); every later span/event for this request reuses
-        # it, so even a shed request's events correlate to its trace.
+        # once — here for local requests, at the ClusterRouter for
+        # propagated ones); every later span/event for this request
+        # reuses it, so even a shed request's events correlate.
         trace = (
-            self._tracer.start_trace() if self._tracer is not None else None
+            (
+                self._tracer.adopt(trace_ctx)
+                if trace_ctx is not None
+                else self._tracer.start_trace()
+            )
+            if self._tracer is not None
+            else None
         )
+        if tenant is None and trace_ctx is not None:
+            tenant = trace_ctx.tenant
         with self._lock:
             self._submitted += 1
         if self._c_requests is not None:
@@ -809,6 +823,18 @@ class InferenceServer:
                     session=session,
                     rollout_ordinal=self._rollout_steps,
                     tenant=session.tenant,
+                    # A federated session's steps all adopt the
+                    # cluster's ONE sampling decision (session.trace_ctx
+                    # — survives migration/resume, so resumed steps are
+                    # spans of the ORIGINAL trace). Locally-placed
+                    # sessions keep their historical behavior: steps
+                    # run untraced.
+                    trace=(
+                        self._tracer.adopt(session.trace_ctx)
+                        if self._tracer is not None
+                        and getattr(session, "trace_ctx", None) is not None
+                        else None
+                    ),
                 )
                 self._inbound.put(req)
         if raced_shutdown:
@@ -1362,6 +1388,7 @@ class InferenceServer:
                     if r.deadline is not None
                     else {}
                 ),
+                **({"tenant": r.tenant} if r.tenant is not None else {}),
             )
         # Pad waste of this dispatch's static shape: real node tokens
         # vs the compiled program's token capacity (padded path: rows x
@@ -1497,7 +1524,8 @@ class InferenceServer:
                 ServeResult(ok=True, reason="ok", output=o, latency_ms=lat),
             )
             self._trace_span(
-                r.trace, "resolve", done, reason="ok", latency_ms=lat
+                r.trace, "resolve", done, reason="ok", latency_ms=lat,
+                **({"tenant": r.tenant} if r.tenant is not None else {}),
             )
 
     def _trace_batch_phases(
@@ -1532,11 +1560,15 @@ class InferenceServer:
         for r in live:
             if r.trace is None:
                 continue
-            self._trace_span(r.trace, "dispatch", start, done, **link)
+            # Tenant rides every per-member phase span so a trace file
+            # alone supports the per-tenant queue-vs-device breakdown
+            # (tools/trace_report.py) without consulting the sink.
+            ten = {"tenant": r.tenant} if r.tenant is not None else {}
+            self._trace_span(r.trace, "dispatch", start, done, **link, **ten)
             for phase in ("batch_assembly", "device", "unpad"):
                 if phase in timings:
                     t0, t1 = timings[phase]
-                    self._trace_span(r.trace, phase, t0, t1, **link)
+                    self._trace_span(r.trace, phase, t0, t1, **link, **ten)
             if compile_span:
                 t0, t1 = timings["device"]
                 self._trace_span(
@@ -2012,6 +2044,11 @@ class InferenceServer:
                 }
                 for key, st in sorted(bucket_stats.items())
             }
+            # Trace-coverage stats (ISSUE 20 satellite): how much of
+            # the traffic the sampled trace file actually represents,
+            # plus what sampling silently dropped — a trace_report
+            # number without this denominator overclaims.
+            summary["trace"] = self._tracer.coverage()
         summary.update(
             # Serving compute dtype (models/precision.py): every rollup
             # names the precision it measured — a bench artifact from a

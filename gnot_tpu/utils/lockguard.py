@@ -185,9 +185,24 @@ def _reaches(src: str, dst: str) -> list[str] | None:
     return None
 
 
+#: Optional observer for inversion reports — ``obs/dtrace.py``'s
+#: flight recorder registers here (``FlightRecorder.watch_lockguard``)
+#: so a runtime lock-order warning triggers a black-box dump. Called
+#: AFTER the record is appended, never under any guard's lock; a
+#: raising observer is swallowed (reporting must not add failure
+#: modes to the thing being reported on).
+on_report = None
+
+
 def _report(kind: str, message: str, record: dict) -> None:
     record = {"kind": kind, "message": message, **record}
     _inversions.append(record)
+    cb = on_report
+    if cb is not None:
+        try:
+            cb(dict(record))
+        except Exception:
+            pass
     if _mode == "strict":
         raise LockOrderViolation(message)
     warnings.warn(f"GNOT_LOCK_GUARD: {message}", stacklevel=4)

@@ -71,6 +71,56 @@ def test_tracing_ab_artifact_schema():
     assert summary["ms_per_step_on"] == arms["tracing_on"]["ms_per_step"]
 
 
+def test_dtrace_ab_artifact_schema():
+    """The committed distributed-tracing A/B (tools/dtrace_ab.py):
+    federated per-request latency with the cluster tracing plane +
+    flight recorders off vs on, plus a summary whose overhead_frac
+    meets the <=2% acceptance bar (the ISSUE 20 criterion)."""
+    path = os.path.join(ARTIFACT_DIR, "dtrace_overhead_ab.jsonl")
+    with open(path) as f:
+        recs = [json.loads(l) for l in f if l.strip()]
+    arms = {r["arm"]: r for r in recs if "arm" in r}
+    assert set(arms) == {"dtrace_off", "dtrace_on"}
+    for r in arms.values():
+        assert r["ms_per_request"] > 0 and r["hosts"] == 2
+        assert r["sample_rate"] == 1.0  # every request traced on the ON arm
+        assert r["flight_recorder_s"] > 0  # recorder armed, not a no-op arm
+    (summary,) = [r for r in recs if r.get("summary") == "dtrace_overhead"]
+    assert isinstance(summary["overhead_frac"], float)
+    assert summary["overhead_frac"] <= 0.02
+    assert summary["ms_per_request_on"] == arms["dtrace_on"]["ms_per_request"]
+
+
+def test_federated_trace_example_schema():
+    """The committed stitched cluster trace (docs/observability.md
+    "Distributed tracing"): spans from >=2 host sources plus the
+    controller, a host-kill remigration recorded as a LINKED placement
+    span on the ORIGINAL rollout trace, and per-source clock metadata."""
+    path = os.path.join(ARTIFACT_DIR, "federated_trace_example.json")
+    with open(path) as f:
+        m = json.load(f)
+    hosts = m["otherData"]["hosts"]
+    assert "controller" in hosts and len(hosts) >= 3
+    for meta in hosts.values():
+        assert "clock_offset_s" in meta and "clock_err_s" in meta
+    spans = [e for e in m["traceEvents"] if e.get("ph") == "X"]
+    (roll,) = [s for s in spans if s["name"] == "cluster_rollout"]
+    tid = roll["args"]["trace_id"]
+    placements = [
+        s for s in spans
+        if s["name"] == "placement" and s["args"]["trace_id"] == tid
+    ]
+    kinds = {p["args"]["kind"] for p in placements}
+    assert "remigrate" in kinds
+    remig = next(p for p in placements if p["args"]["kind"] == "remigrate")
+    assert remig["args"]["link_to"]  # linked span, not a second chain
+    served = {
+        s["args"].get("host") for s in spans
+        if s["args"].get("trace_id") == tid and s["args"].get("host")
+    }
+    assert len(served) >= 2  # the SAME trace crossed hosts
+
+
 def test_metrics_ab_artifact_schema():
     """The committed metrics-plane overhead A/B (tools/metrics_ab.py):
     interleaved serve-storm arms with the registry + publisher +

@@ -147,7 +147,7 @@ def test_wire_refuses_unregistered_kind():
 
 
 def test_messages_registry_shape():
-    assert len(MESSAGES) == 20
+    assert len(MESSAGES) == 22
     for kind, spec in MESSAGES.items():
         assert spec.doc, f"{kind} has no doc line"
         assert isinstance(spec.fields, tuple)
@@ -602,10 +602,12 @@ def test_dropped_submit_on_healthy_host_is_redriven(setup, tmp_path):
     model, params, samples, _engine = setup
     with sink:
         # Arm AFTER the handshake so each link's next outbound frame —
-        # the submit itself — is the chaos victim.
+        # the submit itself — is the chaos victim. Frame ordinals are
+        # absolute per link and the hello was frame 1, so the submit
+        # is frame 2 (msg_drop@1 would never fire post-handshake).
         for host_id in ("host0", "host1"):
             cluster._hosts[host_id].link.arm(
-                FaultInjector.from_spec("msg_drop@1")
+                FaultInjector.from_spec("msg_drop@2")
             )
         futs = [cluster.submit(s) for s in samples[:4]]
         stop = threading.Event()
@@ -640,8 +642,9 @@ def test_dropped_session_submit_is_redriven_with_sample(setup, tmp_path):
     model, params, samples, engine = setup
     steps = 3
     with sink:
+        # Frame 1 was the handshake hello; the rollout submit is #2.
         cluster._hosts["host0"].link.arm(
-            FaultInjector.from_spec("msg_drop@1")
+            FaultInjector.from_spec("msg_drop@2")
         )
         fut = cluster.submit_rollout(samples[0], steps, name="redrive")
         stop = threading.Event()

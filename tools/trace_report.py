@@ -137,6 +137,46 @@ def replica_breakdown(spans: list[dict]) -> dict[str, dict]:
     return out
 
 
+def tenant_breakdown(spans: list[dict]) -> dict[str, dict]:
+    """tenant -> queue-wait vs device-time percentiles (multi-tenant
+    serve traces: phase spans carry a ``tenant`` arg). The populations
+    match ``serve_summary.tenants`` request-for-request over the traced
+    subset, so a noisy-neighbor story told by the trace file can be
+    cross-checked against the drain rollup. Empty when no request
+    carried a tenant tag."""
+    return _queue_device_stats(spans, "tenant")
+
+
+def host_breakdown(spans: list[dict]) -> dict[str, dict]:
+    """host -> queue-wait vs device-time percentiles plus placement
+    count (merged FEDERATED traces: ``obs/dtrace.merge_traces`` stamps
+    every remote span with a ``host`` arg, and controller ``placement``
+    spans name their target host). Agrees with ``metrics_report.py``'s
+    per-host view on which host is queue-bound vs device-bound. Empty
+    for single-host traces."""
+    placements: dict[str, int] = {}
+    for s in spans:
+        if s["name"] == "placement":
+            h = s["args"].get("host")
+            if h is not None:
+                placements[str(h)] = placements.get(str(h), 0) + 1
+    out = {}
+    for host, st in _queue_device_stats(spans, "host").items():
+        out[host] = {**st, "placements": placements.get(host, 0)}
+    for host, n in placements.items():
+        # A host that only ever RECEIVED placements (all its frames
+        # lost / it died before exporting) still shows up honestly.
+        out.setdefault(
+            host,
+            {
+                "requests": 0, "queue_p50_ms": None, "queue_p99_ms": None,
+                "device_p50_ms": None, "device_p99_ms": None,
+                "placements": n,
+            },
+        )
+    return dict(sorted(out.items()))
+
+
 def critical_path(spans: list[dict]) -> dict | None:
     """The slowest request (serve) or step (train), phase by phase.
 
@@ -217,6 +257,8 @@ def report(path: str) -> dict:
         "kinds": kind_stats(spans),
         "buckets": bucket_breakdown(spans),
         "replicas": replica_breakdown(spans),
+        "tenants": tenant_breakdown(spans),
+        "hosts": host_breakdown(spans),
         "critical_path": critical_path(spans),
     }
 
@@ -255,6 +297,30 @@ def print_report(rep: dict) -> None:
         for rid, st in rep["replicas"].items():
             print(
                 f"  {rid:<8} {st['requests']:>5} {st['dispatches']:>5} "
+                f"{_fmt(st['queue_p50_ms'])} {_fmt(st['queue_p99_ms'])} "
+                f" {_fmt(st['device_p50_ms'])}  {_fmt(st['device_p99_ms'])}"
+            )
+    if rep.get("tenants"):
+        print("\nqueue-wait vs device-time per tenant (ms):")
+        print(
+            f"  {'tenant':<12} {'reqs':>5} {'queue p50':>10} "
+            f"{'queue p99':>10} {'device p50':>11} {'device p99':>11}"
+        )
+        for t, st in rep["tenants"].items():
+            print(
+                f"  {t:<12} {st['requests']:>5} "
+                f"{_fmt(st['queue_p50_ms'])} {_fmt(st['queue_p99_ms'])} "
+                f" {_fmt(st['device_p50_ms'])}  {_fmt(st['device_p99_ms'])}"
+            )
+    if rep.get("hosts"):
+        print("\nqueue-wait vs device-time per host (ms, merged trace):")
+        print(
+            f"  {'host':<12} {'reqs':>5} {'place':>5} {'queue p50':>10} "
+            f"{'queue p99':>10} {'device p50':>11} {'device p99':>11}"
+        )
+        for h, st in rep["hosts"].items():
+            print(
+                f"  {h:<12} {st['requests']:>5} {st['placements']:>5} "
                 f"{_fmt(st['queue_p50_ms'])} {_fmt(st['queue_p99_ms'])} "
                 f" {_fmt(st['device_p50_ms'])}  {_fmt(st['device_p99_ms'])}"
             )
